@@ -1,0 +1,43 @@
+"""h2o3_tpu — a TPU-native, JAX/XLA/Pallas-based rebuild of the H2O-3 ML platform.
+
+Architecture (vs. the reference, /root/reference — H2O-3, a JVM cluster):
+
+  * H2O's peer-to-peer cloud (water/Paxos.java) is replaced by a single-controller
+    JAX runtime driving a `jax.sharding.Mesh` of TPU chips ("the cloud").
+  * H2O's distributed K/V store (water/DKV.java) becomes a controller-side object
+    registry whose values are sharded `jax.Array`s living in TPU HBM.
+  * H2O's MRTask map/reduce over chunks (water/MRTask.java) becomes jitted,
+    sharded computations whose reduces are XLA collectives over ICI.
+  * H2O's Fluid-Vec data plane (water/fvec/) becomes a columnar Frame/Vec store
+    of dtype-packed, row-sharded device arrays.
+
+Public surface mirrors the reference's Python client (h2o-py/h2o/h2o.py).
+"""
+
+from h2o3_tpu.parallel.mesh import init, cloud, shutdown, cluster_info
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.io.parser import import_file, parse_setup, upload_frame
+from h2o3_tpu.core.jobs import Job
+
+__version__ = "0.1.0"
+
+
+def get_frame(key):
+    """Fetch a Frame by key from the registry (h2o.get_frame)."""
+    return DKV.get(key)
+
+
+def get_model(key):
+    """Fetch a Model by key from the registry (h2o.get_model)."""
+    return DKV.get(key)
+
+
+def remove(key):
+    """Remove an object from the registry (h2o.remove)."""
+    DKV.remove(key)
+
+
+def ls():
+    """List all registered keys (h2o.ls)."""
+    return DKV.keys()

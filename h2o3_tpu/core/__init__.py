@@ -1,0 +1,3 @@
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.core.jobs import Job

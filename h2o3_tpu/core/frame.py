@@ -1,0 +1,443 @@
+"""Columnar Frame/Vec data plane — H2O's "Fluid Vectors" rebuilt for TPU HBM.
+
+Reference: water/fvec/Frame.java:64 (named set of Vecs), water/fvec/Vec.java:157
+(typed distributed column; ESPC row layout Vec.java:163-171; type system
+Vec.java:207-212), water/fvec/Chunk.java + ~20 compression codecs
+(C0D/C0L/C1/C1S/C2/C2S/C4/C8/CBS/CStr/CXI/…), water/fvec/NewChunk.java (write
+buffer that picks the best codec on close), water/fvec/RollupStats.java:30
+(lazy per-Vec min/max/mean/sigma/NA stats).
+
+TPU-native design:
+  * A Vec is ONE row-sharded, padded jax.Array in HBM, dtype-packed by a codec
+    chosen at ingest (const / int8 / int16 / int32 / float32, with integer
+    bias), plus an optional uint8 NA mask side-plane. This keeps the codec
+    benefits of Chunk compression (HBM footprint, bandwidth) while staying a
+    dense static-shape array XLA can tile.  Decoding (cast·scale+bias, NA→NaN)
+    happens inside consumer jits, where XLA fuses it into the first kernel
+    for free — the moral equivalent of Chunk.atd() inlined into the map loop.
+  * Rows are padded to a multiple of (row-shards × 8) — H2O's uneven ESPC
+    chunking becomes even tiling + a padding mask.
+  * Strings/UUIDs stay on the host (numpy object arrays): every H2O compute
+    path over strings is row-local munging, which we run host-side; numeric /
+    categorical / time columns live in HBM.
+  * Rollups are computed lazily in one fused jit pass and cached, invalidated
+    on write — same contract as RollupStats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.parallel import mesh as _mesh
+from h2o3_tpu.parallel import mrtask as _mr
+
+# ---------------------------------------------------------------------------
+# Vec types (Vec.java:207-212)
+T_NUM = "num"
+T_CAT = "enum"
+T_TIME = "time"
+T_STR = "str"
+T_UUID = "uuid"
+T_BAD = "bad"  # all-NA column
+
+
+# ---------------------------------------------------------------------------
+# Codecs (the NewChunk "pick best compression on close" logic)
+@dataclasses.dataclass(frozen=True)
+class Codec:
+    kind: str           # "const" | "i8" | "i16" | "i32" | "f32"
+    bias: float = 0.0   # value = stored + bias   (integer kinds)
+    const_val: float = float("nan")  # for kind == "const"
+
+    @property
+    def np_dtype(self):
+        return {"i8": np.int8, "i16": np.int16, "i32": np.int32,
+                "f32": np.float32, "const": np.int8}[self.kind]
+
+
+def _choose_codec(col: np.ndarray, mask: np.ndarray):
+    """Pick the narrowest storage for a float64 host column (NewChunk.close).
+
+    Returns (packed ndarray, Codec). NAs are stored as 0 in packed form; the
+    mask side-plane is authoritative.
+    """
+    valid = col[~mask]
+    if valid.size == 0:
+        return np.zeros(col.shape, np.int8), Codec("const", const_val=float("nan"))
+    vmin, vmax = float(valid.min()), float(valid.max())
+    if vmin == vmax:  # constant col; NAs (incl. padding) live in the mask
+        return np.zeros(col.shape, np.int8), Codec("const", const_val=vmin)
+    filled = np.where(mask, 0.0, col)
+    is_int = np.all(np.floor(valid) == valid) and np.isfinite(valid).all()
+    if is_int:
+        span = vmax - vmin
+        for kind, lim, dt in (("i8", 254, np.int8), ("i16", 65534, np.int16)):
+            if span <= lim:
+                bias = math.floor(vmin + span // 2 + 1)  # center into signed range
+                packed = np.where(mask, 0, filled - bias).astype(dt)
+                return packed, Codec(kind, bias=bias)
+        if -2**31 < vmin and vmax < 2**31 - 1:
+            packed = np.where(mask, 0, filled).astype(np.int32)
+            return packed, Codec("i32")
+    packed = np.where(mask, 0.0, filled).astype(np.float32)
+    return packed, Codec("f32")
+
+
+def _decode_f32(data: jax.Array, codec: Codec, mask: Optional[jax.Array]):
+    """Decode packed storage to f32 with NaN NAs. Call inside jit; fuses."""
+    if codec.kind == "const":
+        x = jnp.full(data.shape, codec.const_val, jnp.float32)
+    else:
+        x = data.astype(jnp.float32)
+        if codec.bias:
+            x = x + jnp.float32(codec.bias)
+    if mask is not None:
+        x = jnp.where(mask != 0, jnp.float32(jnp.nan), x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class Rollups:
+    """RollupStats.java:30 — cached per-Vec stats."""
+    min: float
+    max: float
+    mean: float
+    sigma: float
+    nas: int
+    zeros: int
+    is_int: bool
+
+
+class Vec:
+    """A typed, row-sharded, dtype-packed column resident in TPU HBM."""
+
+    def __init__(self, data, codec: Codec, mask, nrows: int, type: str = T_NUM,
+                 domain: Optional[np.ndarray] = None, host_data=None):
+        self.data = data            # jax.Array (padded,) packed — or None for str
+        self.codec = codec
+        self.mask = mask            # jax.Array uint8 (padded,) or None
+        self.nrows = nrows
+        self.type = type
+        self.domain = domain        # np.ndarray[str] for T_CAT
+        self.host_data = host_data  # np object array for T_STR/T_UUID
+        self._rollups: Optional[Rollups] = None
+
+    # ---- construction ---------------------------------------------------
+    @staticmethod
+    def from_numpy(col: np.ndarray, type: Optional[str] = None,
+                   domain=None) -> "Vec":
+        """Ingest one host column, inferring type (ParseSetup column typing)."""
+        c = _mesh.cloud()
+        if col.dtype == object or col.dtype.kind in "US":
+            return Vec._from_strings(col, force_type=type, domain=domain)
+        if np.issubdtype(col.dtype, np.datetime64):
+            ms = col.astype("datetime64[ms]").astype(np.int64).astype(np.float64)
+            nat = np.isnat(col.astype("datetime64[ms]"))
+            return Vec._from_floats(np.where(nat, 0.0, ms), nat, T_TIME)
+        if col.dtype == bool:
+            col = col.astype(np.float64)
+        col = col.astype(np.float64, copy=False)
+        mask = np.isnan(col)
+        vtype = type or (T_CAT if domain is not None else T_NUM)
+        return Vec._from_floats(col, mask, vtype, domain)
+
+    @staticmethod
+    def _from_floats(col, mask, vtype, domain=None) -> "Vec":
+        c = _mesh.cloud()
+        n = len(col)
+        pad = c.padded_rows(n)
+        colp = np.zeros(pad, np.float64)
+        colp[:n] = np.where(mask, 0.0, col)
+        maskp = np.ones(pad, bool)       # padding rows are NA
+        maskp[:n] = mask
+        packed, codec = _choose_codec(colp, maskp)
+        data = _mr.device_put_rows(packed)
+        dmask = _mr.device_put_rows(maskp.astype(np.uint8)) if maskp.any() else None
+        dom = np.asarray(domain, dtype=object) if domain is not None else None
+        if dmask is None and n < pad:   # padding must always be masked
+            m = np.zeros(pad, np.uint8); m[n:] = 1
+            dmask = _mr.device_put_rows(m)
+        return Vec(data, codec, dmask, n, vtype, dom)
+
+    @staticmethod
+    def _from_strings(col: np.ndarray, force_type=None, domain=None) -> "Vec":
+        """Strings parse to categorical by default (CsvParser enum detection);
+        T_STR keeps raw host strings."""
+        n = len(col)
+        sarr = np.asarray(col, dtype=object)
+        na = np.array([s is None or (isinstance(s, float) and math.isnan(s))
+                       or (isinstance(s, str) and s == "") for s in sarr])
+        if force_type == T_STR:
+            v = Vec(None, Codec("const"), None, n, T_STR, host_data=sarr)
+            return v
+        if domain is None:
+            uniq = sorted({str(s) for s, bad in zip(sarr, na) if not bad})
+            domain = np.asarray(uniq, dtype=object)
+        lookup = {s: i for i, s in enumerate(domain)}
+        codes = np.array([-1 if bad else lookup.get(str(s), -1)
+                          for s, bad in zip(sarr, na)], np.float64)
+        mask = codes < 0
+        return Vec._from_floats(np.where(mask, 0.0, codes), mask, T_CAT, domain)
+
+    # ---- access ---------------------------------------------------------
+    @property
+    def padded_len(self) -> int:
+        return int(self.data.shape[0]) if self.data is not None else len(self.host_data)
+
+    def as_f32(self) -> jax.Array:
+        """Decoded f32 view (NaN NAs, padding = NaN). Materializes; prefer
+        Frame.matrix() for multi-column consumers."""
+        if self.type == T_STR:
+            raise TypeError("string Vec has no numeric view")
+        return jax.jit(_decode_f32, static_argnums=1)(self.data, self.codec, self.mask)
+
+    def to_numpy(self) -> np.ndarray:
+        if self.type == T_STR:
+            return self.host_data.copy()
+        x = np.asarray(self.as_f32())[: self.nrows]
+        return x
+
+    def levels(self):
+        return list(self.domain) if self.domain is not None else None
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain is not None else 0
+
+    # ---- rollups (lazy, cached) -----------------------------------------
+    def rollups(self) -> Rollups:
+        if self._rollups is None:
+            self._rollups = self._compute_rollups()
+        return self._rollups
+
+    def _compute_rollups(self) -> Rollups:
+        if self.type == T_STR:
+            na = sum(1 for s in self.host_data if s is None)
+            return Rollups(math.nan, math.nan, math.nan, math.nan, na, 0, False)
+        stats = _rollup_kernel(self.data, self.codec, self.mask)
+        cnt, s, s2, mn, mx, nas, zeros, frac = (float(v) for v in stats)
+        n_real_na = int(nas) - (self.padded_len - self.nrows)
+        mean = s / cnt if cnt else math.nan
+        var = max(0.0, s2 / cnt - mean * mean) if cnt > 1 else 0.0
+        # sample sigma like RollupStats (n-1)
+        sigma = math.sqrt(var * cnt / (cnt - 1)) if cnt > 1 else 0.0
+        return Rollups(mn if cnt else math.nan, mx if cnt else math.nan,
+                       mean, sigma, n_real_na, int(zeros), frac == 0.0)
+
+    def invalidate_rollups(self):
+        self._rollups = None
+
+    # convenience accessors (Vec.min()/max()/mean()/sigma()/naCnt())
+    def min(self): return self.rollups().min
+    def max(self): return self.rollups().max
+    def mean(self): return self.rollups().mean
+    def sigma(self): return self.rollups().sigma
+    def na_cnt(self): return self.rollups().nas
+    def is_int(self): return self.rollups().is_int
+
+    def __len__(self):
+        return self.nrows
+
+
+@jax.jit
+def _rollup_kernel_impl(x):
+    """One fused pass: count, sum, sum², min, max, NA count, zeros, frac-part."""
+    isna = jnp.isnan(x)
+    w = (~isna).astype(jnp.float32)
+    xz = jnp.where(isna, 0.0, x)
+    cnt = w.sum()
+    s = xz.sum()
+    s2 = (xz * xz).sum()
+    mn = jnp.where(isna, jnp.inf, x).min()
+    mx = jnp.where(isna, -jnp.inf, x).max()
+    nas = isna.sum()
+    zeros = ((xz == 0.0) & ~isna).sum()
+    frac = jnp.abs(xz - jnp.round(xz)).sum()
+    return jnp.stack([cnt, s, s2, mn, mx, nas.astype(jnp.float32),
+                      zeros.astype(jnp.float32), frac])
+
+
+def _rollup_kernel(data, codec, mask):
+    def f(d, m):
+        return _rollup_kernel_impl(_decode_f32(d, codec, m))
+    m = mask if mask is not None else jnp.zeros((), jnp.uint8)
+    if mask is None:
+        return jax.jit(lambda d: _rollup_kernel_impl(_decode_f32(d, codec, None)))(data)
+    return jax.jit(f)(data, mask)
+
+
+# ---------------------------------------------------------------------------
+class Frame:
+    """A named, ordered set of equal-length Vecs (Frame.java:64)."""
+
+    def __init__(self, names: Sequence[str], vecs: Sequence[Vec],
+                 key: Optional[str] = None):
+        assert len(names) == len(vecs)
+        ns = {v.nrows for v in vecs}
+        assert len(ns) <= 1, f"ragged frame: row counts {ns}"
+        self.names = list(names)
+        self.vecs = list(vecs)
+        self.key = key or DKV.make_key("frame")
+        self._matrix_cache: dict = {}
+        DKV.put(self.key, self)
+
+    # ---- construction ---------------------------------------------------
+    @staticmethod
+    def from_dict(cols: dict, key: Optional[str] = None,
+                  column_types: Optional[dict] = None) -> "Frame":
+        names, vecs = [], []
+        for name, col in cols.items():
+            t = (column_types or {}).get(name)
+            names.append(str(name))
+            vecs.append(Vec.from_numpy(np.asarray(col), type=t))
+        return Frame(names, vecs, key)
+
+    @staticmethod
+    def from_numpy(mat: np.ndarray, names: Optional[Sequence[str]] = None,
+                   key: Optional[str] = None) -> "Frame":
+        mat = np.asarray(mat)
+        if mat.ndim == 1:
+            mat = mat[:, None]
+        names = list(names) if names else [f"C{i+1}" for i in range(mat.shape[1])]
+        return Frame(names, [Vec.from_numpy(mat[:, j]) for j in range(mat.shape[1])], key)
+
+    @staticmethod
+    def from_pandas(df, key=None) -> "Frame":
+        return Frame.from_dict({c: df[c].to_numpy() for c in df.columns}, key)
+
+    # ---- shape ----------------------------------------------------------
+    @property
+    def nrows(self) -> int:
+        return self.vecs[0].nrows if self.vecs else 0
+
+    @property
+    def ncols(self) -> int:
+        return len(self.vecs)
+
+    @property
+    def padded_len(self) -> int:
+        return self.vecs[0].padded_len if self.vecs else 0
+
+    @property
+    def shape(self):
+        return (self.nrows, self.ncols)
+
+    @property
+    def types(self) -> dict:
+        return {n: v.type for n, v in zip(self.names, self.vecs)}
+
+    def vec(self, name: str) -> Vec:
+        return self.vecs[self.names.index(name)]
+
+    def col_idx(self, name: str) -> int:
+        return self.names.index(name)
+
+    # ---- column select / mutation ---------------------------------------
+    def __getitem__(self, sel):
+        if isinstance(sel, str):
+            return Frame([sel], [self.vec(sel)])
+        if isinstance(sel, (list, tuple)):
+            if all(isinstance(s, str) for s in sel):
+                return Frame(list(sel), [self.vec(s) for s in sel])
+            return Frame([self.names[i] for i in sel], [self.vecs[i] for i in sel])
+        raise KeyError(sel)
+
+    def __setitem__(self, name: str, value):
+        if isinstance(value, Frame):
+            value = value.vecs[0]
+        if isinstance(value, np.ndarray):
+            value = Vec.from_numpy(value)
+        if not isinstance(value, Vec):
+            value = Vec.from_numpy(np.asarray(value))
+        assert value.nrows == self.nrows or self.ncols == 0
+        if name in self.names:
+            self.vecs[self.names.index(name)] = value
+        else:
+            self.names.append(name)
+            self.vecs.append(value)
+        self._matrix_cache.clear()
+
+    def drop(self, names) -> "Frame":
+        if isinstance(names, str):
+            names = [names]
+        keep = [n for n in self.names if n not in names]
+        return self[keep]
+
+    # ---- dense matrix view (the DataInfo feed) --------------------------
+    def matrix(self, cols: Optional[Sequence[str]] = None,
+               dtype=jnp.float32) -> jax.Array:
+        """(padded_rows, k) row-sharded dense matrix; NAs/padding → NaN.
+
+        Cached per column-tuple. This is the hand-off point from the packed
+        columnar store to MXU-shaped compute.
+        """
+        cols = tuple(cols if cols is not None else self.names)
+        ck = (cols, str(dtype))
+        hit = self._matrix_cache.get(ck)
+        if hit is not None:
+            return hit
+        vs = [self.vec(c) for c in cols]
+        datas = [v.data for v in vs]
+        masks = [v.mask for v in vs]
+        codecs = tuple(v.codec for v in vs)
+
+        def build(datas, masks):
+            cols_f32 = [_decode_f32(d, c, m)
+                        for d, c, m in zip(datas, codecs, masks)]
+            return jnp.stack(cols_f32, axis=1).astype(dtype)
+
+        out_sh = _mesh.cloud().rows_sharding(2)
+        m = jax.jit(build, out_shardings=out_sh)(datas, masks)
+        self._matrix_cache[ck] = m
+        return m
+
+    # ---- host round-trip -------------------------------------------------
+    def to_numpy(self, cols=None) -> np.ndarray:
+        cols = cols if cols is not None else self.names
+        return np.column_stack([self.vec(c).to_numpy() for c in cols])
+
+    def as_data_frame(self):
+        import pandas as pd
+        out = {}
+        for n, v in zip(self.names, self.vecs):
+            x = v.to_numpy()
+            if v.type == T_CAT:
+                dom = v.domain
+                x = np.array([None if np.isnan(c) else dom[int(c)] for c in x],
+                             dtype=object)
+            out[n] = x
+        return pd.DataFrame(out)
+
+    def head(self, n=10):
+        return self.as_data_frame().head(n)
+
+    # ---- summary (REST /3/Frames summary) --------------------------------
+    def summary(self) -> dict:
+        out = {}
+        for n, v in zip(self.names, self.vecs):
+            if v.type == T_STR:
+                out[n] = {"type": v.type}
+                continue
+            r = v.rollups()
+            out[n] = {"type": v.type, "min": r.min, "max": r.max,
+                      "mean": r.mean, "sigma": r.sigma, "missing": r.nas,
+                      "zeros": r.zeros,
+                      "cardinality": v.cardinality}
+        return out
+
+    def _on_remove(self):
+        self._matrix_cache.clear()
+        for v in self.vecs:
+            v.data = None
+            v.mask = None
+
+    def __repr__(self):
+        return f"<Frame {self.key} {self.nrows}x{self.ncols} {self.names[:8]}>"

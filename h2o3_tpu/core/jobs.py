@@ -1,0 +1,130 @@
+"""Job system: async work units with progress/cancel/exception propagation.
+
+Reference: water/Job.java:23 — keyed job objects polled via REST /3/Jobs
+(water/api/JobsHandler.java); exceptions from the distributed F/J tree
+propagate into the job (water/MRThrow semantics).
+
+TPU-native design: jobs run on controller threads (model builds are
+controller-orchestrated loops launching jitted device programs); progress is a
+plain float the work loop updates; cancellation is a cooperative flag checked
+between device steps — the same contract Job.stop_requested() gives MRTasks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from h2o3_tpu.core.kvstore import DKV
+
+RUNNING = "RUNNING"
+DONE = "DONE"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+CREATED = "CREATED"
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class Job:
+    """An async job keyed in the registry (water/Job.java:23)."""
+
+    def __init__(self, description: str = "", dest: Optional[str] = None):
+        self.key = DKV.make_key("job")
+        self.description = description
+        self.dest = dest              # key of the object being built
+        self.status = CREATED
+        self.progress = 0.0
+        self.progress_msg = ""
+        self.exception: Optional[BaseException] = None
+        self.traceback: Optional[str] = None
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self._stop_requested = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        DKV.put(self.key, self)
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self, work: Callable[["Job"], object], background: bool = True) -> "Job":
+        """Run `work(job)`; its return value is DKV-put under self.dest."""
+        self.status = RUNNING
+        self.start_time = time.time()
+
+        def _run():
+            try:
+                result = work(self)
+                if result is not None and self.dest:
+                    DKV.put(self.dest, result)
+                self.progress = 1.0
+                self.status = DONE
+            except JobCancelled:
+                self.status = CANCELLED
+            except BaseException as e:  # propagate like MRThrow
+                self.exception = e
+                self.traceback = traceback.format_exc()
+                self.status = FAILED
+            finally:
+                self.end_time = time.time()
+                self._done.set()
+
+        if background:
+            self._thread = threading.Thread(target=_run, daemon=True,
+                                            name=f"job-{self.key}")
+            self._thread.start()
+        else:
+            _run()
+        return self
+
+    def join(self, timeout: Optional[float] = None):
+        """Block until done; re-raise the job's exception (Job.get())."""
+        self._done.wait(timeout)
+        if self.exception is not None:
+            raise self.exception
+        if self.dest:
+            return DKV.get(self.dest)
+        return None
+
+    # ---- progress & cancellation ---------------------------------------
+    def update(self, progress: float, msg: str = ""):
+        self.progress = float(progress)
+        if msg:
+            self.progress_msg = msg
+        if self._stop_requested.is_set():
+            raise JobCancelled()
+
+    def stop(self):
+        """Request cooperative cancellation (Job.stop())."""
+        self._stop_requested.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested.is_set()
+
+    @property
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def run_time_ms(self) -> int:
+        end = self.end_time or time.time()
+        return int(1000 * (end - self.start_time)) if self.start_time else 0
+
+    def to_dict(self) -> dict:
+        """REST /3/Jobs schema."""
+        return {
+            "key": self.key, "description": self.description,
+            "status": self.status, "progress": self.progress,
+            "progress_msg": self.progress_msg, "dest": self.dest,
+            "msec": self.run_time_ms,
+            "exception": repr(self.exception) if self.exception else None,
+            "stacktrace": self.traceback,
+        }
+
+
+def jobs_list() -> list[dict]:
+    return [DKV.get(k).to_dict() for k in DKV.keys() if k.startswith("job_")]

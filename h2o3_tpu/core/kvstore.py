@@ -1,0 +1,97 @@
+"""Controller-side object registry replacing H2O's distributed K/V store.
+
+Reference: water/DKV.java, water/Key.java (key→home-node hashing, Key.java:169),
+water/Value.java (byte[]/POJO duality), water/Atomic.java (home-node CAS),
+water/Lockable.java (read/write locks on Frames/Models).
+
+TPU-native design: JAX is single-controller, so the *control plane* needs no
+distribution at all — one registry maps keys to Python objects whose heavy
+payloads (Vec data) are sharded jax.Arrays already resident in device HBM.
+What survives from DKV's design:
+  * keys as the universal handle between subsystems (frames, models, jobs);
+  * write-locking of keyed objects while a job mutates them (Lockable);
+  * atomic read-modify-write (Atomic) — here a plain lock, since there is
+    exactly one writer process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class _DKV:
+    def __init__(self):
+        self._store: dict[str, Any] = {}
+        self._locks: dict[str, str] = {}  # key -> job/owner name holding write lock
+        self._mutex = threading.RLock()
+        self._counter = 0
+
+    # ---- basic ops (DKV.put/get/remove) ---------------------------------
+    def put(self, key: str, value: Any) -> str:
+        with self._mutex:
+            self._store[key] = value
+        return key
+
+    def get(self, key: str, default=None):
+        with self._mutex:
+            return self._store.get(key, default)
+
+    def __contains__(self, key: str) -> bool:
+        with self._mutex:
+            return key in self._store
+
+    def remove(self, key: str):
+        with self._mutex:
+            v = self._store.pop(key, None)
+            self._locks.pop(key, None)
+        if v is not None and hasattr(v, "_on_remove"):
+            v._on_remove()
+
+    def keys(self) -> list[str]:
+        with self._mutex:
+            return sorted(self._store.keys())
+
+    def clear(self):
+        with self._mutex:
+            self._store.clear()
+            self._locks.clear()
+
+    # ---- atomic update (water/Atomic.java:10) ---------------------------
+    def atomic(self, key: str, fn):
+        """Atomically apply fn(old_value) -> new_value under the registry lock."""
+        with self._mutex:
+            nv = fn(self._store.get(key))
+            if nv is None:
+                self._store.pop(key, None)
+            else:
+                self._store[key] = nv
+            return nv
+
+    # ---- write locks (water/Lockable.java) ------------------------------
+    def write_lock(self, key: str, owner: str):
+        with self._mutex:
+            holder = self._locks.get(key)
+            if holder is not None and holder != owner:
+                raise RuntimeError(
+                    f"key {key!r} is write-locked by {holder!r}")
+            self._locks[key] = owner
+
+    def unlock(self, key: str, owner: str):
+        with self._mutex:
+            if self._locks.get(key) == owner:
+                del self._locks[key]
+
+    def is_locked(self, key: str) -> bool:
+        with self._mutex:
+            return key in self._locks
+
+    # ---- key minting (water/Key.make) -----------------------------------
+    def make_key(self, prefix: str = "obj") -> str:
+        with self._mutex:
+            self._counter += 1
+            return f"{prefix}_{self._counter:04d}_{int(time.time()) % 100000}"
+
+
+DKV = _DKV()

@@ -1,0 +1,52 @@
+"""Temp-key tracking: automatic cleanup of intermediate frames/models.
+
+Reference: water/Scope.java — a per-thread stack of "tracked" keys; everything
+tracked inside enter()/exit() that isn't explicitly kept is removed, so
+MRTask-heavy algorithms don't leak Vecs. The test harness leak-checker
+(water/runner/CheckKeysTask.java) is built on the same idea.
+
+Here: a context manager; on exit every key created inside (and not kept) is
+dropped from the registry, freeing its HBM-backed arrays.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from h2o3_tpu.core.kvstore import DKV
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def track(key: str) -> str:
+    if _stack():
+        _stack()[-1].add(key)
+    return key
+
+
+def untrack(key: str):
+    for fr in _stack():
+        fr.discard(key)
+
+
+@contextlib.contextmanager
+def scope(keep=()):
+    """with scope(keep=[model.key]): ... — everything else created is freed."""
+    before = set(DKV.keys())
+    frame: set = set()
+    _stack().append(frame)
+    try:
+        yield frame
+    finally:
+        _stack().pop()
+        created = (set(DKV.keys()) - before) | frame
+        keepset = set(keep if not isinstance(keep, str) else [keep])
+        for k in created - keepset:
+            DKV.remove(k)

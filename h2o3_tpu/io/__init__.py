@@ -1,0 +1,1 @@
+from h2o3_tpu.io.parser import import_file, parse_setup, upload_frame

@@ -1,0 +1,333 @@
+"""Data ingest: 2-phase parse (setup guess → typed columnar load → HBM).
+
+Reference: water/parser/ParseDataset.java:31,127 — phase 1 `ParseSetup.guessSetup`
+sniffs separator/header/types on a sample; phase 2 `MultiFileParseTask` is an
+MRTask over file chunks whose per-chunk parsers emit NewChunks, with
+categorical levels merged cluster-wide then renumbered
+(ParseDataset.java:356-440). Formats: CSV (CsvParser.java), ARFF
+(ARFFParser.java), SVMLight (SVMLightParser.java), gzip/zip (ZipUtil.java).
+
+TPU-native design: parsing is host work; the device is only involved at the
+end (`device_put` of packed columns with a row sharding). Phase 2 here
+tokenizes with a C-backed fast path when the native extension is built
+(native/fastcsv.cpp), falling back to Python's csv module; column typing and
+categorical renumbering happen once on the controller — there is no
+cluster-wide level merge because there is one parse process.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import zipfile
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
+
+NA_TOKENS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
+_SEPARATORS = [",", "\t", ";", "|", " "]
+
+
+# ---------------------------------------------------------------------------
+@dataclass
+class ParseSetup:
+    """Result of phase-1 guessing (water/parser/ParseSetup.java)."""
+    separator: str = ","
+    header: bool = True
+    column_names: list = field(default_factory=list)
+    column_types: list = field(default_factory=list)  # "num"|"enum"|"str"|"time"
+    parse_type: str = "CSV"  # CSV | ARFF | SVMLight
+    na_strings: set = field(default_factory=lambda: set(NA_TOKENS))
+
+
+def _open_text(path: str) -> io.TextIOBase:
+    """Transparent gzip/zip handling (water/parser/ZipUtil.java)."""
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8", newline="")
+    if path.endswith(".zip"):
+        zf = zipfile.ZipFile(path)
+        inner = zf.namelist()[0]
+        return io.TextIOWrapper(zf.open(inner), encoding="utf-8", newline="")
+    return open(path, "r", encoding="utf-8", newline="")
+
+
+def _is_num(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def parse_setup(path: str, sample_lines: int = 200) -> ParseSetup:
+    """Phase 1: sniff separator, header, and column types from a sample."""
+    with _open_text(path) as f:
+        sample = [line.rstrip("\r\n") for _, line in zip(range(sample_lines), f)]
+    sample = [l for l in sample if l]
+    if not sample:
+        raise ValueError(f"empty file: {path}")
+    if sample[0].lstrip().startswith("@relation") or path.lower().endswith(".arff"):
+        return _arff_setup(path)
+    if path.lower().endswith(".svm") or path.lower().endswith(".svmlight"):
+        return ParseSetup(parse_type="SVMLight")
+    # separator: the one yielding a consistent, maximal column count
+    best_sep, best_cols = ",", 1
+    for sep in _SEPARATORS:
+        counts = {len(_split(l, sep)) for l in sample[:50]}
+        if len(counts) == 1:
+            (c,) = counts
+            if c > best_cols:
+                best_sep, best_cols = sep, c
+    sep = best_sep
+    rows = [_split(l, sep) for l in sample]
+    ncol = max(len(r) for r in rows)
+    # header: first row all non-numeric, and some later row has a numeric
+    first_nonnum = all(not _is_num(t) for t in rows[0] if t not in NA_TOKENS)
+    later_num = any(_is_num(t) for r in rows[1:] for t in r)
+    header = first_nonnum and later_num and len(rows) > 1
+    names = ([t.strip('"') for t in rows[0]] if header
+             else [f"C{i+1}" for i in range(ncol)])
+    body = rows[1:] if header else rows
+    types = _guess_types(body, ncol)
+    return ParseSetup(separator=sep, header=header, column_names=names,
+                      column_types=types)
+
+
+def _split(line: str, sep: str) -> list:
+    """Quote-aware split (CsvParser handles embedded separators in quotes)."""
+    if '"' not in line:
+        return line.split(sep)
+    out, cur, q = [], [], False
+    for ch in line:
+        if ch == '"':
+            q = not q
+        elif ch == sep and not q:
+            out.append("".join(cur)); cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+def _guess_types(rows: Sequence[Sequence[str]], ncol: int) -> list:
+    types = []
+    for j in range(ncol):
+        col = [r[j].strip() for r in rows if j < len(r)]
+        vals = [t for t in col if t not in NA_TOKENS]
+        if not vals:
+            types.append(T_NUM)
+        elif all(_is_num(t) for t in vals):
+            types.append(T_NUM)
+        elif all(_looks_time(t) for t in vals[:20]) and vals:
+            types.append(T_TIME)
+        else:
+            types.append(T_CAT)
+    return types
+
+
+def _looks_time(tok: str) -> bool:
+    if len(tok) < 8 or not tok[:4].isdigit():
+        return False
+    return ("-" in tok or "/" in tok) and any(c.isdigit() for c in tok)
+
+
+# ---------------------------------------------------------------------------
+def parse(path: str, setup: Optional[ParseSetup] = None,
+          destination_frame: Optional[str] = None,
+          col_types: Optional[dict] = None) -> Frame:
+    """Phase 2: full tokenize → typed columns → packed sharded Vecs."""
+    setup = setup or parse_setup(path)
+    if setup.parse_type == "ARFF":
+        return _parse_arff(path, setup, destination_frame)
+    if setup.parse_type == "SVMLight":
+        return _parse_svmlight(path, destination_frame)
+    cols = _tokenize_csv(path, setup)
+    names = list(setup.column_names)
+    types = list(setup.column_types)
+    # pad short rows / extend names if data is wider than the sample suggested
+    while len(names) < len(cols):
+        names.append(f"C{len(names)+1}")
+        types.append(T_CAT)
+    if col_types:
+        for k, v in col_types.items():
+            if k in names:
+                types[names.index(k)] = v
+    vecs = [_column_to_vec(cols[j], types[j]) for j in range(len(cols))]
+    return Frame(names[: len(vecs)], vecs, destination_frame)
+
+
+def _tokenize_csv(path: str, setup: ParseSetup) -> list:
+    """Return list of per-column python lists of token strings."""
+    native = _try_native_tokenizer(path, setup)
+    if native is not None:
+        return native
+    import csv
+    cols: list[list] = []
+    with _open_text(path) as f:
+        rdr = csv.reader(f, delimiter=setup.separator)
+        it = iter(rdr)
+        if setup.header:
+            next(it, None)
+        for row in it:
+            if not row:
+                continue
+            if len(cols) < len(row):
+                depth = len(cols[0]) if cols else 0
+                for _ in range(len(row) - len(cols)):
+                    cols.append([""] * depth)
+            for j in range(len(cols)):
+                cols[j].append(row[j].strip() if j < len(row) else "")
+    return cols
+
+
+def _try_native_tokenizer(path: str, setup: ParseSetup):
+    """Use the C++ fast tokenizer if built (native/fastcsv.cpp)."""
+    try:
+        from h2o3_tpu.io import fastcsv
+        return fastcsv.tokenize(path, setup.separator, setup.header)
+    except Exception:
+        return None
+
+
+def _column_to_vec(tokens: list, vtype: str) -> Vec:
+    n = len(tokens)
+    if vtype == T_NUM or vtype == T_TIME:
+        out = np.empty(n, np.float64)
+        for i, t in enumerate(tokens):
+            if t in NA_TOKENS:
+                out[i] = np.nan
+            else:
+                try:
+                    out[i] = float(t) if vtype == T_NUM else _parse_time_ms(t)
+                except ValueError:
+                    out[i] = np.nan
+        return Vec.from_numpy(out, type=vtype)
+    if vtype == T_STR:
+        arr = np.array([None if t in NA_TOKENS else t for t in tokens], object)
+        return Vec.from_numpy(arr, type=T_STR)
+    # enum; promote to str if nearly-unique (CsvParser enum→string promotion)
+    arr = np.array([None if t in NA_TOKENS else t for t in tokens], object)
+    uniq = {t for t in tokens if t not in NA_TOKENS}
+    if n > 100 and len(uniq) > 0.95 * n:
+        return Vec.from_numpy(arr, type=T_STR)
+    return Vec.from_numpy(arr)
+
+
+def _parse_time_ms(tok: str) -> float:
+    from datetime import datetime
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%Y/%m/%d", "%m/%d/%Y",
+                "%Y-%m-%dT%H:%M:%S"):
+        try:
+            return datetime.strptime(tok, fmt).timestamp() * 1000.0
+        except ValueError:
+            continue
+    raise ValueError(tok)
+
+
+# ---------------------------------------------------------------------------
+# ARFF (water/parser/ARFFParser.java)
+def _arff_setup(path: str) -> ParseSetup:
+    names, types = [], []
+    with _open_text(path) as f:
+        for line in f:
+            l = line.strip()
+            if l.lower().startswith("@attribute"):
+                parts = l.split(None, 2)
+                names.append(parts[1].strip("'\""))
+                t = parts[2].strip()
+                if t.startswith("{"):
+                    types.append(T_CAT)
+                elif t.lower() in ("numeric", "real", "integer"):
+                    types.append(T_NUM)
+                elif t.lower() == "date":
+                    types.append(T_TIME)
+                else:
+                    types.append(T_STR)
+            elif l.lower().startswith("@data"):
+                break
+    return ParseSetup(separator=",", header=False, column_names=names,
+                      column_types=types, parse_type="ARFF")
+
+
+def _parse_arff(path: str, setup: ParseSetup, dest) -> Frame:
+    rows = []
+    with _open_text(path) as f:
+        in_data = False
+        for line in f:
+            l = line.strip()
+            if not in_data:
+                if l.lower().startswith("@data"):
+                    in_data = True
+                continue
+            if l and not l.startswith("%"):
+                rows.append(_split(l, ","))
+    ncol = len(setup.column_names)
+    cols = [[r[j].strip() if j < len(r) else "" for r in rows] for j in range(ncol)]
+    vecs = [_column_to_vec(cols[j], setup.column_types[j]) for j in range(ncol)]
+    return Frame(setup.column_names, vecs, dest)
+
+
+# ---------------------------------------------------------------------------
+# SVMLight (water/parser/SVMLightParser.java) — densified on load
+def _parse_svmlight(path: str, dest) -> Frame:
+    targets, entries, max_idx = [], [], 0
+    with _open_text(path) as f:
+        for line in f:
+            l = line.split("#")[0].strip()
+            if not l:
+                continue
+            parts = l.split()
+            targets.append(float(parts[0]))
+            row = {}
+            for kv in parts[1:]:
+                k, v = kv.split(":")
+                k = int(k)
+                row[k] = float(v)
+                max_idx = max(max_idx, k)
+            entries.append(row)
+    n = len(targets)
+    mat = np.zeros((n, max_idx + 1), np.float64)
+    for i, row in enumerate(entries):
+        for k, v in row.items():
+            mat[i, k] = v
+    names = ["target"] + [f"C{j+1}" for j in range(max_idx + 1)]
+    vecs = [Vec.from_numpy(np.asarray(targets))]
+    vecs += [Vec.from_numpy(mat[:, j]) for j in range(max_idx + 1)]
+    return Frame(names, vecs, dest)
+
+
+# ---------------------------------------------------------------------------
+def import_file(path: str, destination_frame: Optional[str] = None,
+                col_types: Optional[dict] = None,
+                header: Optional[bool] = None,
+                sep: Optional[str] = None) -> Frame:
+    """h2o.import_file analog: setup-guess then parse in one call."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    setup = parse_setup(path)
+    if header is not None:
+        setup.header = header
+    if sep is not None:
+        setup.separator = sep
+    return parse(path, setup, destination_frame, col_types)
+
+
+def upload_frame(data, destination_frame: Optional[str] = None) -> Frame:
+    """h2o.H2OFrame(python_obj) analog: ingest in-memory host data."""
+    if isinstance(data, Frame):
+        return data
+    if isinstance(data, dict):
+        return Frame.from_dict(data, destination_frame)
+    if isinstance(data, np.ndarray):
+        return Frame.from_numpy(data, key=destination_frame)
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return Frame.from_pandas(data, destination_frame)
+    except ImportError:
+        pass
+    raise TypeError(f"cannot ingest {type(data)}")

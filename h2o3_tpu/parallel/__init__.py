@@ -1,0 +1,2 @@
+from h2o3_tpu.parallel.mesh import Cloud, init, cloud, shutdown
+from h2o3_tpu.parallel.mrtask import map_reduce, shard_sum, map_chunks

@@ -1,0 +1,131 @@
+"""The "cloud": a device mesh replacing H2O-3's gossip/Paxos cluster.
+
+Reference: water/Paxos.java, water/H2O.java:1845 (startLocalNode),
+water/HeartBeatThread.java. H2O forms a cloud of symmetric JVM peers via UDP
+gossip and freezes membership at the first DKV write (Paxos.java:145).
+
+TPU-native design: JAX is single-controller — one Python process drives every
+chip. "Cloud formation" is simply constructing a `jax.sharding.Mesh` over the
+visible devices; there is no consensus protocol to run, no heartbeats, no
+flatfiles. Membership is fixed by construction (the moral equivalent of
+`Paxos.lockCloud`), and "nodes" are mesh shards addressed by named axes.
+
+Axes:
+  * "rows"  — the data axis. Frames are row-sharded over it; every MRTask-like
+              reduce becomes a psum over this axis riding ICI.
+  * "model" — optional second axis for tensor/model parallelism (DeepLearning
+              wide layers, batched tree-building, grid-search fan-out).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS = "rows"
+MODEL = "model"
+
+_lock = threading.Lock()
+_CLOUD: "Cloud | None" = None
+
+
+@dataclass
+class Cloud:
+    """A formed cloud == a live device mesh plus derived shardings."""
+
+    mesh: Mesh
+    name: str = "h2o3-tpu"
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def n_rows_shards(self) -> int:
+        return self.mesh.shape[ROWS]
+
+    @property
+    def n_model_shards(self) -> int:
+        return self.mesh.shape.get(MODEL, 1)
+
+    # ---- shardings ------------------------------------------------------
+    def rows_sharding(self, ndim: int = 1) -> NamedSharding:
+        """Row-sharded: dim 0 split over the data axis, rest replicated."""
+        spec = P(ROWS, *([None] * (ndim - 1)))
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    # ---- row padding ----------------------------------------------------
+    # H2O lays rows out via ESPC (Vec.java:163-171): uneven chunks per node.
+    # XLA wants even, static shapes: we pad the row count up to a multiple of
+    # (row-shards × sublane granule) and carry the logical nrows separately.
+    ROW_GRANULE = 8  # f32 sublane granularity on TPU
+
+    def padded_rows(self, nrows: int) -> int:
+        g = self.n_rows_shards * self.ROW_GRANULE
+        return max(g, int(math.ceil(nrows / g)) * g)
+
+    def describe(self) -> dict:
+        return {
+            "cloud_name": self.name,
+            "cloud_size": self.n_devices,
+            "mesh_shape": dict(self.mesh.shape),
+            "devices": [str(d) for d in self.mesh.devices.flat],
+            "platform": self.mesh.devices.flat[0].platform if self.n_devices else "?",
+            "consensus": "locked",  # single-controller: always formed, always locked
+        }
+
+
+def init(n_rows_shards: int | None = None, n_model_shards: int = 1,
+         devices=None, name: str = "h2o3-tpu") -> Cloud:
+    """Form the cloud (h2o.init analog). Idempotent unless shape changes."""
+    global _CLOUD
+    with _lock:
+        devices = list(devices if devices is not None else jax.devices())
+        total = len(devices)
+        if n_rows_shards is None:
+            n_rows_shards = total // n_model_shards
+        use = n_rows_shards * n_model_shards
+        if use > total:
+            raise ValueError(
+                f"requested {use} devices ({n_rows_shards}x{n_model_shards}) "
+                f"but only {total} visible")
+        dev_grid = np.array(devices[:use]).reshape(n_rows_shards, n_model_shards)
+        mesh = Mesh(dev_grid, (ROWS, MODEL))
+        _CLOUD = Cloud(mesh=mesh, name=name)
+        return _CLOUD
+
+
+def cloud() -> Cloud:
+    """Return the formed cloud, forming a default one on first use."""
+    global _CLOUD
+    if _CLOUD is None:
+        with _lock:
+            if _CLOUD is None:
+                init()
+    return _CLOUD
+
+
+def shutdown():
+    """Tear down the cloud and the registry (h2o.cluster().shutdown())."""
+    global _CLOUD
+    from h2o3_tpu.core.kvstore import DKV
+    with _lock:
+        DKV.clear()
+        _CLOUD = None
+
+
+def cluster_info() -> dict:
+    """REST /3/Cloud analog."""
+    return cloud().describe()

@@ -1,0 +1,94 @@
+"""The compute substrate: H2O's MRTask re-imagined for XLA.
+
+Reference: water/MRTask.java:65 — serialize a task, fan it out over nodes in a
+binary RPC tree (MRTask.java:690-754), fork-join down to one chunk per task,
+run `map(Chunk[])`, then `reduce` partial POJOs back up two trees
+(MRTask.java:850-921).
+
+TPU-native design: there is no task serialization, no RPC tree and no explicit
+reduce plumbing. A "map over chunks + tree reduce" is exactly what XLA compiles
+a jitted computation over a row-sharded array into: the map runs shard-local,
+and any cross-shard reduction (sum/min/max/…) lowers to an ICI collective
+(all-reduce) with optimal scheduling. Two entry points:
+
+  * map_reduce(fn, ...)  — jit `fn` over sharded inputs with replicated (small)
+    outputs. The common case: XLA inserts the collectives. This is the moral
+    equivalent of `new MRTask(){map;reduce}.doAll(frame)`.
+  * map_chunks(fn, ...)  — `shard_map` when per-shard (per-"node") semantics
+    are required: fn sees its local row block and may call lax.psum etc.
+    Equivalent of MRTask with setupLocal/postLocal node-level hooks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from h2o3_tpu.parallel import mesh as _mesh
+
+
+def map_reduce(fn, *arrays, donate=()):
+    """Jit `fn` over row-sharded arrays; outputs get whatever sharding XLA
+    propagates (scalars/small reductions come back replicated).
+
+    `fn` is traced once and cached per shape/dtype signature by jax.jit.
+    """
+    jfn = jax.jit(fn, donate_argnums=donate)
+    return jfn(*arrays)
+
+
+def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False):
+    """shard_map `fn` over the rows axis: fn runs once per shard ("node"),
+    seeing only its local rows, and may use lax.psum/ppermute over "rows".
+
+    in_specs/out_specs default to row-sharded in, replicated out.
+    """
+    c = _mesh.cloud()
+    if in_specs is None:
+        in_specs = tuple(P(_mesh.ROWS, *([None] * (a.ndim - 1))) for a in arrays)
+    if out_specs is None:
+        out_specs = P()
+    smapped = jax.shard_map(
+        fn, mesh=c.mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma)
+    return jax.jit(smapped)(*arrays)
+
+
+def shard_sum(x, axis_name=_mesh.ROWS):
+    """psum helper for use inside map_chunks bodies."""
+    return jax.lax.psum(x, axis_name)
+
+
+def device_put_rows(host_array, ndim=None):
+    """Place a host array onto the mesh row-sharded (dim 0 over "rows")."""
+    c = _mesh.cloud()
+    nd = host_array.ndim if ndim is None else ndim
+    return jax.device_put(host_array, c.rows_sharding(nd))
+
+
+def device_put_replicated(host_array):
+    c = _mesh.cloud()
+    return jax.device_put(host_array, c.replicated())
+
+
+def jit_rows(fn=None, *, static_argnums=(), donate_argnums=()):
+    """Decorator: jit a function whose first args are row-sharded arrays.
+
+    Just jax.jit — named for intent at call sites (an "MRTask definition").
+    """
+    if fn is None:
+        return functools.partial(jit_rows, static_argnums=static_argnums,
+                                 donate_argnums=donate_argnums)
+    return jax.jit(fn, static_argnums=static_argnums,
+                   donate_argnums=donate_argnums)
+
+
+def row_mask(padded_len: int, nrows: int, dtype=jnp.float32):
+    """1.0 for real rows, 0.0 for padding — the ESPC-padding guard.
+
+    Built inside jit from scalars so it fuses into consumers.
+    """
+    return (jnp.arange(padded_len) < nrows).astype(dtype)

@@ -1,0 +1,45 @@
+"""Test harness: an 8-device virtual cloud in one process.
+
+Reference test strategy (SURVEY.md §4): H2O tests boot an N-node
+cluster-in-a-process (water/TestUtil.java:32 stall_till_cloudsize) and
+leak-check keys after every test (water/runner/CheckKeysTask.java).
+
+Here: 8 virtual CPU devices via XLA_FLAGS, a formed mesh per session, and a
+registry leak-check fixture.
+"""
+
+import os
+
+# Must happen before the XLA CPU client initializes. NOTE: this image's
+# sitecustomize imports jax at interpreter start, so JAX_PLATFORMS in
+# os.environ is read too late — use jax.config.update instead.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def cloud8():
+    """stall_till_cloudsize(8) analog: form the 8-shard cloud once."""
+    import h2o3_tpu
+    c = h2o3_tpu.init(n_rows_shards=8)
+    assert c.n_devices == 8
+    yield c
+
+
+@pytest.fixture()
+def leak_check():
+    """CheckKeysTask analog: assert no keys leak across a test."""
+    from h2o3_tpu.core.kvstore import DKV
+    before = set(DKV.keys())
+    yield
+    after = set(DKV.keys())
+    leaked = after - before
+    for k in leaked:
+        DKV.remove(k)
+    assert not leaked, f"leaked keys: {sorted(leaked)}"

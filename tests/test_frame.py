@@ -1,0 +1,87 @@
+"""Frame/Vec data-plane tests (mirrors h2o-core fvec tests: rollups, codecs,
+types, NA handling)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Frame, Vec, T_CAT, T_NUM, T_STR
+
+
+def test_vec_roundtrip_ints():
+    x = np.array([1, 2, 3, 250, -5], dtype=np.float64)
+    v = Vec.from_numpy(x)
+    assert v.codec.kind in ("i8", "i16")
+    np.testing.assert_allclose(v.to_numpy(), x)
+
+
+def test_vec_roundtrip_floats_and_nas():
+    x = np.array([1.5, np.nan, -2.25, 1e6])
+    v = Vec.from_numpy(x)
+    out = v.to_numpy()
+    np.testing.assert_allclose(out[[0, 2, 3]], x[[0, 2, 3]])
+    assert np.isnan(out[1])
+    assert v.na_cnt() == 1
+
+
+def test_vec_constant():
+    v = Vec.from_numpy(np.full(100, 7.0))
+    assert v.codec.kind == "const"
+    assert v.min() == v.max() == 7.0
+
+
+def test_rollups():
+    x = np.array([1.0, 2.0, 3.0, 4.0, np.nan, 0.0])
+    v = Vec.from_numpy(x)
+    r = v.rollups()
+    assert r.min == 0.0 and r.max == 4.0
+    np.testing.assert_allclose(r.mean, 2.0)
+    np.testing.assert_allclose(r.sigma, np.std([1, 2, 3, 4, 0], ddof=1), rtol=1e-5)
+    assert r.nas == 1 and r.zeros == 1 and r.is_int
+
+
+def test_categorical_vec():
+    v = Vec.from_numpy(np.array(["b", "a", "b", None, "c"], dtype=object))
+    assert v.type == T_CAT
+    assert v.levels() == ["a", "b", "c"]
+    out = v.to_numpy()
+    np.testing.assert_array_equal(out[[0, 1, 2, 4]], [1.0, 0.0, 1.0, 2.0])
+    assert np.isnan(out[3])
+
+
+def test_frame_matrix_sharded():
+    f = Frame.from_dict({"a": np.arange(100.0), "b": np.arange(100.0) * 2})
+    m = f.matrix()
+    assert m.shape[0] == f.padded_len and m.shape[1] == 2
+    assert m.shape[0] % 8 == 0
+    got = np.asarray(m)[:100]
+    np.testing.assert_allclose(got[:, 1], np.arange(100.0) * 2)
+    # padding rows are NaN
+    assert np.isnan(np.asarray(m)[100:]).all()
+    h2o3_tpu.remove(f.key)
+
+
+def test_frame_select_and_set():
+    f = Frame.from_dict({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    g = f["b"]
+    assert g.names == ["b"] and g.nrows == 2
+    f["c"] = np.array([5.0, 6.0])
+    assert f.ncols == 3
+    np.testing.assert_allclose(f.vec("c").to_numpy(), [5, 6])
+
+
+def test_frame_summary():
+    f = Frame.from_dict({"x": [1.0, 2.0, 3.0], "s": np.array(["a", "b", "a"], object)})
+    s = f.summary()
+    assert s["x"]["mean"] == 2.0
+    assert s["s"]["cardinality"] == 2
+
+
+def test_dkv_and_scope():
+    from h2o3_tpu.core import scope
+    from h2o3_tpu.core.kvstore import DKV
+    with scope.scope() as _:
+        f = Frame.from_dict({"a": [1.0]})
+        key = f.key
+        assert DKV.get(key) is f
+    assert DKV.get(key) is None

@@ -1,0 +1,79 @@
+"""Ingest tests (mirrors testdir_parser pyunits)."""
+
+import gzip
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.io.parser import parse_setup, import_file
+
+
+CSV = """sepal_len,sepal_wid,species,note
+5.1,3.5,setosa,ok
+4.9,3.0,setosa,
+6.2,NA,virginica,bad
+5.9,3.0,versicolor,ok
+"""
+
+
+def _write(tmp_path, name, text, gz=False):
+    p = tmp_path / name
+    if gz:
+        with gzip.open(p, "wt") as f:
+            f.write(text)
+    else:
+        p.write_text(text)
+    return str(p)
+
+
+def test_parse_setup_guess(tmp_path):
+    p = _write(tmp_path, "iris.csv", CSV)
+    s = parse_setup(p)
+    assert s.separator == ","
+    assert s.header
+    assert s.column_names == ["sepal_len", "sepal_wid", "species", "note"]
+    assert s.column_types[:3] == ["num", "num", "enum"]
+
+
+def test_import_file(tmp_path):
+    p = _write(tmp_path, "iris.csv", CSV)
+    f = import_file(p)
+    assert f.shape == (4, 4)
+    np.testing.assert_allclose(f.vec("sepal_len").to_numpy(), [5.1, 4.9, 6.2, 5.9])
+    assert np.isnan(f.vec("sepal_wid").to_numpy()[2])
+    assert f.vec("species").levels() == ["setosa", "versicolor", "virginica"]
+    h2o3_tpu.remove(f.key)
+
+
+def test_import_gzip(tmp_path):
+    p = _write(tmp_path, "iris.csv.gz", CSV, gz=True)
+    f = import_file(p)
+    assert f.shape == (4, 4)
+    h2o3_tpu.remove(f.key)
+
+
+def test_headerless_and_tabs(tmp_path):
+    p = _write(tmp_path, "t.tsv", "1\t2\t3\n4\t5\t6\n")
+    f = import_file(p)
+    assert f.shape == (2, 3)
+    assert f.names == ["C1", "C2", "C3"]
+
+
+def test_svmlight(tmp_path):
+    p = _write(tmp_path, "d.svm", "1 1:0.5 3:2.0\n-1 2:1.5\n")
+    f = import_file(p)
+    assert f.vec("target").to_numpy().tolist() == [1.0, -1.0]
+    assert f.ncols >= 4
+
+
+def test_arff(tmp_path):
+    text = """@relation iris
+@attribute slen numeric
+@attribute cls {a,b}
+@data
+5.1,a
+4.9,b
+"""
+    p = _write(tmp_path, "d.arff", text)
+    f = import_file(p)
+    assert f.shape == (2, 2)
+    assert f.vec("cls").type == "enum"
