@@ -434,10 +434,9 @@ class Frame:
         return out
 
     def _on_remove(self):
+        # Vecs may be shared with other frames (column slices, adapted test
+        # frames) — drop only our caches; device arrays are freed by refcount.
         self._matrix_cache.clear()
-        for v in self.vecs:
-            v.data = None
-            v.mask = None
 
     def __repr__(self):
         return f"<Frame {self.key} {self.nrows}x{self.ncols} {self.names[:8]}>"

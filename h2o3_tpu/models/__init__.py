@@ -1,0 +1,23 @@
+"""Model builders (the hex.* algorithm layer rebuilt TPU-native)."""
+
+from h2o3_tpu.models.kmeans import H2OKMeansEstimator
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator
+from h2o3_tpu.models.tree.drf import H2ORandomForestEstimator
+from h2o3_tpu.models.tree.isofor import H2OIsolationForestEstimator
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
+from h2o3_tpu.models.naive_bayes import H2ONaiveBayesEstimator
+
+ESTIMATORS = {
+    "kmeans": H2OKMeansEstimator,
+    "glm": H2OGeneralizedLinearEstimator,
+    "gbm": H2OGradientBoostingEstimator,
+    "drf": H2ORandomForestEstimator,
+    "isolationforest": H2OIsolationForestEstimator,
+    "deeplearning": H2ODeepLearningEstimator,
+    "pca": H2OPrincipalComponentAnalysisEstimator,
+    "glrm": H2OGeneralizedLowRankEstimator,
+    "naivebayes": H2ONaiveBayesEstimator,
+}

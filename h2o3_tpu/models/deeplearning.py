@@ -1,0 +1,225 @@
+"""DeepLearning — hex/deeplearning rebuilt as synchronous allreduce SGD.
+
+Reference: hex/deeplearning/DeepLearning.java, DeepLearningTask.java:17
+(per-row fwd/bwd :101, Hogwild lock-free updates into node-local weights,
+reduce = model averaging :180), Neurons.java (Rectifier/Tanh/Maxout ± dropout),
+DeepLearningModelInfo.java (flat weight vector), adaptive rate = ADADELTA
+(rho/epsilon), momentum ramp for plain SGD, l1/l2, input dropout.
+
+TPU-native design (BASELINE.json: "Hogwild → synchronous ICI allreduce"):
+one jitted train step = minibatch forward/backward via jax.grad + optimizer
+update; gradients over the row-sharded batch are reduced by XLA collectives —
+the Hogwild races and periodic model-averaging disappear because synchronous
+data-parallel SGD on ICI is strictly stronger hardware-wise. Weights are
+replicated; batch dim is sharded.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.models.model import ModelBase
+
+
+def _activation(name: str):
+    name = (name or "Rectifier").lower()
+    if "rectifier" in name:
+        return jax.nn.relu
+    if "tanh" in name:
+        return jnp.tanh
+    if "maxout" in name:
+        return None  # handled specially (pairs of units)
+    raise ValueError(name)
+
+
+class H2ODeepLearningEstimator(ModelBase):
+    algo = "deeplearning"
+    _defaults = {
+        "hidden": None, "epochs": 10.0, "activation": "Rectifier",
+        "adaptive_rate": True, "rho": 0.99, "epsilon": 1e-8,
+        "rate": 0.005, "rate_annealing": 1e-6, "rate_decay": 1.0,
+        "momentum_start": 0.0, "momentum_ramp": 1e6, "momentum_stable": 0.0,
+        "input_dropout_ratio": 0.0, "hidden_dropout_ratios": None,
+        "l1": 0.0, "l2": 0.0, "loss": "Automatic", "mini_batch_size": 1,
+        "autoencoder": False, "train_samples_per_iteration": -2,
+        "score_interval": 5.0, "initial_weight_distribution": "UniformAdaptive",
+        "initial_weight_scale": 1.0, "stopping_rounds": 5,
+        "stopping_metric": "AUTO", "stopping_tolerance": 0.0,
+        "max_w2": float("inf"), "standardize": True, "reproducible": False,
+        "export_weights_and_biases": False, "shuffle_training_data": False,
+    }
+    supervised = True
+
+    def train(self, x=None, y=None, training_frame=None, **kw):
+        self.supervised = not bool(self.params.get("autoencoder") or
+                                   kw.get("autoencoder"))
+        if not self.supervised:
+            # autoencoder: unsupervised — no response needed
+            return ModelBase.train(self, x=x, y=None,
+                                   training_frame=training_frame, **kw)
+        return ModelBase.train(self, x=x, y=y, training_frame=training_frame,
+                               **kw)
+
+    # ------------------------------------------------------------------
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        X = di.matrix(frame)
+        w = di.weights(frame)
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        autoenc = bool(self.params.get("autoencoder"))
+        if autoenc:
+            Y = Xz
+            out_dim = X.shape[1]
+            loss_kind = "quadratic"
+        else:
+            yv = di.response(frame)
+            w = jnp.where(jnp.isnan(yv), 0.0, w)
+            yz = jnp.where(jnp.isnan(yv), 0.0, yv)
+            if self._is_classifier:
+                out_dim = self.nclasses
+                Y = yz.astype(jnp.int32)
+                loss_kind = "ce"
+            else:
+                out_dim = 1
+                Y = yz
+                loss_kind = "quadratic"
+        hidden = list(self.params.get("hidden") or [200, 200])
+        act = _activation(self.params.get("activation"))
+        maxout = act is None
+        seed = int(self.params.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed > 0 else 0)
+        dims = [X.shape[1]] + hidden + [out_dim]
+        params = []
+        for i in range(len(dims) - 1):
+            key, k1 = jax.random.split(key)
+            fan_in, fan_out = dims[i], dims[i + 1]
+            if maxout and i < len(dims) - 2:
+                fan_out *= 2
+            # UniformAdaptive init (Neurons.java): U(±√(6/(fi+fo)))
+            lim = math.sqrt(6.0 / (dims[i] + dims[i + 1]))
+            W = jax.random.uniform(k1, (fan_in, fan_out), jnp.float32,
+                                   -lim, lim)
+            b = jnp.zeros(fan_out, jnp.float32)
+            params.append((W, b))
+        in_drop = float(self.params.get("input_dropout_ratio") or 0.0)
+        hid_drop = self.params.get("hidden_dropout_ratios")
+        l1 = float(self.params.get("l1") or 0.0)
+        l2 = float(self.params.get("l2") or 0.0)
+        nh = len(hidden)
+
+        def forward(params, xb, rng=None, train=False):
+            h = xb
+            if train and in_drop > 0 and rng is not None:
+                rng, k = jax.random.split(rng)
+                h = h * (jax.random.uniform(k, h.shape) > in_drop)
+            for i, (W, b) in enumerate(params[:-1]):
+                z = h @ W + b
+                if maxout:
+                    z = z.reshape(z.shape[0], -1, 2).max(axis=2)
+                else:
+                    z = act(z)
+                if train and hid_drop and rng is not None:
+                    d = float(hid_drop[i]) if i < len(hid_drop) else 0.0
+                    if d > 0:
+                        rng, k = jax.random.split(rng)
+                        z = z * (jax.random.uniform(k, z.shape) > d) / (1 - d)
+                h = z
+            W, b = params[-1]
+            return h @ W + b
+
+        def loss_fn(params, xb, yb, wb, rng):
+            out = forward(params, xb, rng, train=True)
+            if loss_kind == "ce":
+                ll = optax.softmax_cross_entropy_with_integer_labels(out, yb)
+            else:
+                tgt = yb if autoenc else yb[:, None]
+                pred = out if autoenc else out
+                ll = ((pred - tgt) ** 2).mean(axis=-1) if autoenc \
+                    else ((out[:, 0] - yb) ** 2)
+            base = (wb * ll).sum() / jnp.maximum(wb.sum(), 1e-8)
+            reg = sum(jnp.abs(W).sum() for W, _ in params) * l1 \
+                + sum((W * W).sum() for W, _ in params) * l2
+            return base + reg
+
+        if self.params.get("adaptive_rate", True):
+            opt = optax.adadelta(learning_rate=1.0,
+                                 rho=float(self.params["rho"]),
+                                 eps=float(self.params["epsilon"]))
+        else:
+            sched = optax.exponential_decay(
+                float(self.params["rate"]), 1000,
+                1.0 / (1.0 + float(self.params["rate_annealing"]) * 1000))
+            opt = optax.sgd(sched,
+                            momentum=float(self.params.get("momentum_stable"))
+                            or None)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, xb, yb, wb, rng):
+            l, g = jax.value_and_grad(loss_fn)(params, xb, yb, wb, rng)
+            updates, opt_state = opt.update(g, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l
+
+        n = frame.nrows
+        pad = X.shape[0]
+        epochs = float(self.params.get("epochs") or 10.0)
+        mb = int(self.params.get("mini_batch_size") or 1)
+        if mb <= 1:
+            mb = min(256, max(32, n // 16 or 32))  # sync-SGD friendly batch
+        nsteps = max(1, int(epochs * n / mb))
+        rng_np = np.random.default_rng(seed if seed > 0 else 0)
+        history = []
+        for s in range(nsteps):
+            idx = rng_np.integers(0, n, size=mb)
+            xb = jnp.take(Xz, jnp.asarray(idx), axis=0)
+            yb = jnp.take(Y, jnp.asarray(idx), axis=0)
+            wb = jnp.take(w, jnp.asarray(idx), axis=0)
+            key, k = jax.random.split(key)
+            params, opt_state, l = step(params, opt_state, xb, yb, wb, k)
+            if s % max(1, nsteps // 10) == 0 or s == nsteps - 1:
+                history.append({"samples": (s + 1) * mb,
+                                "epochs": (s + 1) * mb / n,
+                                "training_loss": float(l)})
+                job.update(0.1 + 0.8 * (s + 1) / nsteps,
+                           f"epoch {(s+1)*mb/n:.2f}")
+        self._params_net = params
+        self._forward = forward
+        self._loss_kind = loss_kind
+        self._output.scoring_history = history
+        self._output.model_summary = {
+            "hidden": hidden, "activation": self.params.get("activation"),
+            "epochs_trained": nsteps * mb / n,
+            "weights": [list(W.shape) for W, _ in params],
+        }
+
+    # ------------------------------------------------------------------
+    def _score_matrix(self, X):
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        out = jax.jit(lambda p, x: self._forward(p, x))(self._params_net, Xz)
+        if self.params.get("autoencoder"):
+            return out
+        if self._is_classifier:
+            return jax.nn.softmax(out, axis=1)
+        return out[:, 0]
+
+    def anomaly(self, test_data: Frame) -> Frame:
+        """Autoencoder per-row reconstruction MSE (H2O h2o.anomaly)."""
+        X = self._dinfo.matrix(test_data)
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        rec = self._score_matrix(X)
+        mse = np.asarray(((rec - Xz) ** 2).mean(axis=1))[: test_data.nrows]
+        return Frame(["Reconstruction.MSE"],
+                     [Vec.from_numpy(mse.astype(np.float64))])
+
+    def _score_train_valid(self, frame, valid):
+        if self.params.get("autoencoder"):
+            return
+        ModelBase._score_train_valid(self, frame, valid)

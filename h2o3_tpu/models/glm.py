@@ -1,0 +1,426 @@
+"""GLM — hex/glm/GLM.java rebuilt: IRLS where the Gram is one MXU matmul.
+
+Reference: hex/glm/GLM.java (3775 LoC; fitIRLSM :1733, ADMM :1184, COD :1870,
+multinomial COD :1228, lambda search), hex/glm/GLMTask.java (GLMIterationTask
+:1502 — ONE distributed pass building the weighted Gram XᵀWX and XᵀWz),
+hex/gram/Gram.java (hand-parallelized in-core Cholesky :473),
+hex/optimization/ADMM.java, L_BFGS.java.
+
+TPU-native design:
+  * GLMIterationTask becomes a single jit: Xw = X·w; G = XᵀXw; q = Xᵀ(wz) —
+    blocked dot_generals on the MXU, cross-shard psum by XLA (replacing the
+    MRTask reduce + hand-written Gram accumulation).
+  * Gram.cholesky becomes jnp.linalg solve on the controller-visible (p×p)
+    Gram — p is small; no distributed Cholesky needed.
+  * L1/elastic-net is solved by cyclic coordinate descent ON THE GRAM
+    (the reference's COD solver, GLM.java:1870): O(p²) per sweep on host,
+    no extra device passes.
+  * Multinomial follows the reference's per-class block-coordinate IRLS
+    (GLM.java:1228): per class, softmax working weights/response, one Gram
+    pass per class per sweep.
+  * Lambda search warm-starts down a geometric path from λ_max, like
+    GLM's lambda search.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.models.model import ModelBase
+
+# ---------------------------------------------------------------------------
+# Families / links (hex/glm/GLMModel.GLMParameters.Family)
+GAUSSIAN, BINOMIAL, QUASIBINOMIAL, POISSON, GAMMA, TWEEDIE, NEGBINOMIAL, \
+    MULTINOMIAL, ORDINAL = ("gaussian", "binomial", "quasibinomial", "poisson",
+                            "gamma", "tweedie", "negativebinomial",
+                            "multinomial", "ordinal")
+
+_CANONICAL_LINK = {GAUSSIAN: "identity", BINOMIAL: "logit",
+                   QUASIBINOMIAL: "logit", POISSON: "log", GAMMA: "inverse",
+                   TWEEDIE: "tweedie", NEGBINOMIAL: "log",
+                   MULTINOMIAL: "multinomial"}
+
+
+def _linkinv(link, eta, tweedie_link_power=1.0):
+    if link == "identity":
+        return eta
+    if link == "logit":
+        return jax.nn.sigmoid(eta)
+    if link == "log":
+        return jnp.exp(eta)
+    if link == "inverse":
+        safe = jnp.where(jnp.abs(eta) < 1e-8, jnp.sign(eta) * 1e-8 + 1e-12, eta)
+        return 1.0 / safe
+    if link == "tweedie":
+        lp = tweedie_link_power
+        return jnp.exp(eta) if lp == 0 else jnp.power(jnp.clip(eta, 1e-10), 1.0 / lp)
+    raise ValueError(link)
+
+
+# ---------------------------------------------------------------------------
+@jax.jit
+def _gram_pass(X, w, z):
+    """GLMIterationTask: G = XᵀWX, q = XᵀWz in one fused device program."""
+    Xw = X * w[:, None]
+    G = X.T @ Xw
+    q = Xw.T @ z
+    return G, q
+
+
+def _irls_weights(family, link, eta, y, w_obs, tweedie_var_power=1.5,
+                  theta=1.0):
+    """Working weights and response for one IRLS step (GLMTask computeWeights)."""
+    mu = _linkinv(link, eta)
+    if family == GAUSSIAN:
+        return w_obs, y if link == "identity" else eta + (y - mu)
+    if family in (BINOMIAL, QUASIBINOMIAL):
+        # f32-safe clip: 1-1e-8 rounds to 1.0 in f32 and zeroes the variance
+        mu = jnp.clip(mu, 1e-6, 1 - 1e-6)
+        d = jnp.maximum(mu * (1 - mu), 1e-6)
+        wi = w_obs * d
+        z = eta + (y - mu) / d
+        return wi, z
+    if family == POISSON:
+        mu = jnp.clip(mu, 1e-8)
+        wi = w_obs * mu
+        return wi, eta + (y - mu) / mu
+    if family == GAMMA:  # log link path
+        mu = jnp.clip(mu, 1e-8)
+        if link == "log":
+            return w_obs, eta + (y - mu) / mu
+        wi = w_obs * mu * mu
+        return wi, eta - (y - mu) / (mu * mu)
+    if family == TWEEDIE:
+        p = tweedie_var_power
+        mu = jnp.clip(mu, 1e-8)
+        wi = w_obs * jnp.power(mu, 2.0 - p)
+        return wi, eta + (y - mu) / mu
+    if family == NEGBINOMIAL:
+        mu = jnp.clip(mu, 1e-8)
+        wi = w_obs * mu / (1.0 + theta * mu)
+        return wi, eta + (y - mu) / mu
+    raise ValueError(family)
+
+
+@jax.jit
+def _eta_pass(X, beta):
+    return X @ beta
+
+
+def _soft(x, t):
+    return math.copysign(max(abs(x) - t, 0.0), x)
+
+
+def _cod_solve(G, q, lam, alpha, p_pen, beta0, tol=1e-8, max_sweeps=1000):
+    """Cyclic coordinate descent on the Gram (GLM.java:1870 COD solver).
+
+    Minimizes ½βᵀGβ − qᵀβ + λα‖β_pen‖₁ + ½λ(1−α)‖β_pen‖² — host-side, p small.
+    Column p_pen.. (intercept) unpenalized.
+    """
+    p = len(q)
+    beta = beta0.copy()
+    l1 = lam * alpha
+    l2 = lam * (1 - alpha)
+    for _ in range(max_sweeps):
+        delta = 0.0
+        for j in range(p):
+            gj = q[j] - G[j] @ beta + G[j, j] * beta[j]
+            denom = G[j, j] + (l2 if j < p_pen else 0.0)
+            if denom <= 0:
+                continue
+            nb = _soft(gj, l1) / denom if j < p_pen else gj / denom
+            delta = max(delta, abs(nb - beta[j]))
+            beta[j] = nb
+        if delta < tol:
+            break
+    return beta
+
+
+@dataclass
+class _GLMState:
+    beta: np.ndarray            # (p+1,) or (K, p+1) for multinomial
+    link: str
+    family: str
+
+
+class H2OGeneralizedLinearEstimator(ModelBase):
+    algo = "glm"
+    _defaults = {
+        "family": "AUTO", "link": "family_default", "solver": "AUTO",
+        "alpha": None, "lambda_": None, "lambda_search": False, "nlambdas": 30,
+        "lambda_min_ratio": 1e-4, "max_iterations": 50,
+        "beta_epsilon": 1e-4, "objective_epsilon": 1e-6,
+        "gradient_epsilon": 1e-6, "intercept": True,
+        "tweedie_variance_power": 0.0, "tweedie_link_power": 1.0,
+        "theta": 1e-10, "compute_p_values": False, "remove_collinear_columns": False,
+        "missing_values_handling": "MeanImputation", "non_negative": False,
+        "standardize": True, "prior": -1.0, "max_active_predictors": -1,
+    }
+
+    # ------------------------------------------------------------------
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        fam = self._resolve_family()
+        self._family = fam
+        link = self.params.get("link") or "family_default"
+        if link in ("family_default", None, "AUTO"):
+            link = _CANONICAL_LINK[fam]
+        self._link = link
+        X = di.matrix(frame)                       # standardized, imputed
+        y = di.response(frame)
+        w = di.weights(frame)
+        w = jnp.where(jnp.isnan(y), 0.0, w)
+        yz = jnp.where(jnp.isnan(y), 0.0, y)
+        ones = jnp.ones((X.shape[0], 1), X.dtype)
+        Xi = jnp.concatenate([X, ones], axis=1)    # intercept column last
+        if fam == MULTINOMIAL or (fam == "AUTO_MULTI"):
+            self._fit_multinomial(Xi, yz, w, job)
+        else:
+            self._fit_irls(Xi, yz, w, job)
+        self._build_output(frame)
+
+    def _resolve_family(self) -> str:
+        fam = self.params.get("family", "AUTO")
+        if fam and fam != "AUTO":
+            return fam
+        if self._dinfo.response_domain is None:
+            return GAUSSIAN
+        return BINOMIAL if len(self._dinfo.response_domain) == 2 else MULTINOMIAL
+
+    def _alpha_lambda(self, G, q, p_pen):
+        alpha = self.params.get("alpha")
+        alpha = 0.5 if alpha is None else (alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        lam = self.params.get("lambda_")
+        if isinstance(lam, (list, tuple)):
+            lam = lam[0]
+        if self.params.get("lambda_search"):
+            lam_max = np.abs(q[:p_pen]).max() / max(alpha, 1e-3)
+            lams = np.geomspace(lam_max,
+                                lam_max * self.params["lambda_min_ratio"],
+                                int(self.params["nlambdas"]))
+            return alpha, list(lams)
+        if lam is None:
+            lam = 0.0 if not self.params.get("lambda_search") else None
+        return alpha, [float(lam)]
+
+    # ------------------------------------------------------------------
+    def _fit_irls(self, Xi, y, w, job):
+        fam, link = self._family, self._link
+        p1 = Xi.shape[1]
+        p_pen = p1 - 1 if self.params.get("intercept", True) else p1
+        beta = np.zeros(p1, np.float64)
+        # sensible intercept start
+        wn = np.asarray(w, np.float64)
+        yn = np.asarray(y, np.float64)
+        ybar = float((wn * yn).sum() / max(wn.sum(), 1e-12))
+        if fam in (BINOMIAL, QUASIBINOMIAL):
+            yb = min(max(ybar, 1e-6), 1 - 1e-6)
+            beta[-1] = math.log(yb / (1 - yb))
+        elif fam in (POISSON, GAMMA, TWEEDIE, NEGBINOMIAL):
+            beta[-1] = math.log(max(ybar, 1e-8)) if link == "log" else (
+                1.0 / max(ybar, 1e-8) if link == "inverse" else ybar)
+        else:
+            beta[-1] = ybar
+        # first pass for lambda_max needs the null-model gram
+        eta = _eta_pass(Xi, jnp.asarray(beta, jnp.float32))
+        wi, z = _irls_weights(fam, link, eta, y, w,
+                              self.params["tweedie_variance_power"] or 1.5,
+                              self.params["theta"])
+        G, q = _gram_pass(Xi, wi, z)
+        Gn, qn = np.asarray(G, np.float64), np.asarray(q, np.float64)
+        alpha, lams = self._alpha_lambda(Gn, qn - Gn @ beta, p_pen)
+        max_it = int(self.params["max_iterations"])
+        beps = float(self.params["beta_epsilon"])
+        path = []
+        for lam in lams:
+            for it in range(max(1, max_it)):
+                eta = _eta_pass(Xi, jnp.asarray(beta, jnp.float32))
+                wi, z = _irls_weights(fam, link, eta, y, w,
+                                      self.params["tweedie_variance_power"] or 1.5,
+                                      self.params["theta"])
+                G, q = _gram_pass(Xi, wi, z)
+                Gn = np.asarray(G, np.float64)
+                qn = np.asarray(q, np.float64)
+                if alpha > 0 and lam > 0:
+                    # objective is (1/N)·deviance + λ·pen ⇒ scale λ by Σw
+                    nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha, p_pen, beta)
+                else:
+                    A = Gn + lam * wn.sum() * (1 - alpha) * np.eye(p1)
+                    if p_pen < p1:
+                        A[p1 - 1, p1 - 1] = Gn[p1 - 1, p1 - 1]
+                    nb = np.linalg.solve(A + 1e-10 * np.eye(p1), qn)
+                if self.params.get("non_negative"):
+                    nb[:p_pen] = np.maximum(nb[:p_pen], 0.0)
+                dmax = float(np.max(np.abs(nb - beta)))
+                beta = nb
+                if fam == GAUSSIAN and link == "identity":
+                    break
+                if dmax < beps:
+                    break
+            path.append((lam, beta.copy()))
+            job.update(0.6, f"lambda {lam:.4g}")
+        self._lambda_path = path
+        self._state = _GLMState(beta=beta, link=link, family=fam)
+        self._Gram = Gn
+        self._wsum = float(wn.sum())
+
+    # ------------------------------------------------------------------
+    def _fit_multinomial(self, Xi, y, w, job):
+        """Block-coordinate per-class IRLS (GLM.java:1228)."""
+        K = self.nclasses
+        p1 = Xi.shape[1]
+        p_pen = p1 - 1
+        beta = np.zeros((K, p1), np.float64)
+        wn = np.asarray(w, np.float64)
+        # class priors → intercept init
+        yi = np.asarray(y, np.float64).astype(int)
+        for c in range(K):
+            pc = (wn * (yi == c)).sum() / max(wn.sum(), 1e-12)
+            beta[c, -1] = math.log(max(pc, 1e-6))
+        alpha = self.params.get("alpha")
+        alpha = 0.5 if alpha is None else (alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        lam = self.params.get("lambda_") or 0.0
+        if isinstance(lam, (list, tuple)):
+            lam = lam[0]
+        max_it = int(self.params["max_iterations"])
+        beps = float(self.params["beta_epsilon"])
+
+        @jax.jit
+        def probs_fn(B):
+            return jax.nn.softmax(Xi @ B.T, axis=1)
+
+        @jax.jit
+        def class_gram(B, c, yk):
+            P = jax.nn.softmax(Xi @ B.T, axis=1)
+            pc = jnp.clip(P[:, c], 1e-6, 1 - 1e-6)   # f32-safe
+            d = jnp.maximum(pc * (1 - pc), 1e-6)
+            wi = w * d
+            eta_c = Xi @ B[c]
+            z = eta_c + (yk - pc) / d
+            Xw = Xi * wi[:, None]
+            return Xi.T @ Xw, Xw.T @ z
+
+        @jax.jit
+        def obj_fn(B):
+            P = jax.nn.softmax(Xi @ B.T, axis=1)
+            py = jnp.take_along_axis(P, jnp.asarray(yi)[:, None], 1)[:, 0]
+            return -(w * jnp.log(jnp.clip(py, 1e-12, 1.0))).sum()
+
+        prev_obj = float(obj_fn(jnp.asarray(beta, jnp.float32)))
+        for sweep in range(max_it):
+            dmax = 0.0
+            last_good = beta.copy()
+            for c in range(K):
+                yk = jnp.asarray((yi == c).astype(np.float32))
+                G, q = class_gram(jnp.asarray(beta, jnp.float32),
+                                  c, yk)
+                Gn, qn = np.asarray(G, np.float64), np.asarray(q, np.float64)
+                if alpha > 0 and lam > 0:
+                    nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha, p_pen,
+                                    beta[c].copy())
+                else:
+                    A = Gn + lam * wn.sum() * (1 - alpha) * np.eye(p1)
+                    A[p1 - 1, p1 - 1] = Gn[p1 - 1, p1 - 1]
+                    nb = np.linalg.solve(A + 1e-8 * np.eye(p1), qn)
+                dmax = max(dmax, float(np.max(np.abs(nb - beta[c]))))
+                beta[c] = nb
+            job.update(0.6, f"multinomial sweep {sweep}")
+            obj = float(obj_fn(jnp.asarray(beta, jnp.float32)))
+            if not math.isfinite(obj) or obj > prev_obj + 1e-6 * abs(prev_obj):
+                beta = last_good    # separable-data divergence guard
+                break
+            prev_obj = obj
+            if dmax < beps:
+                break
+        self._state = _GLMState(beta=beta, link="multinomial",
+                                family=MULTINOMIAL)
+
+    # ------------------------------------------------------------------
+    def _score_matrix(self, X):
+        st = self._state
+        ones = jnp.ones((X.shape[0], 1), X.dtype)
+        Xi = jnp.concatenate([jnp.where(jnp.isnan(X), 0.0, X), ones], axis=1)
+        if st.family == MULTINOMIAL:
+            B = jnp.asarray(st.beta, jnp.float32)
+            return jax.jit(lambda Xi: jax.nn.softmax(Xi @ B.T, axis=1))(Xi)
+        b = jnp.asarray(st.beta, jnp.float32)
+        eta = jax.jit(lambda Xi: Xi @ b)(Xi)
+        mu = _linkinv(st.link, eta,
+                      self.params.get("tweedie_link_power") or 1.0)
+        if st.family in (BINOMIAL, QUASIBINOMIAL):
+            return jnp.stack([1.0 - mu, mu], axis=1)
+        return mu
+
+    # ------------------------------------------------------------------
+    def _build_output(self, frame):
+        di = self._dinfo
+        st = self._state
+        names = di.feature_names + ["Intercept"]
+        if st.family == MULTINOMIAL:
+            coefs = {n: st.beta[:, j].tolist() for j, n in enumerate(names)}
+        else:
+            coefs = dict(zip(names, st.beta.tolist()))
+        self._coefficients_std = coefs
+        # de-standardize for user-facing coefficients (H2O reports both)
+        if di.standardize and st.family != MULTINOMIAL:
+            raw = {}
+            icept = st.beta[-1]
+            ncat = sum(di.cardinalities.get(c, 0) for c in di.cat_cols)
+            for j, n in enumerate(di.feature_names):
+                b = st.beta[j]
+                if j >= ncat:  # numeric, was standardized
+                    cname = di.num_cols[j - ncat]
+                    s = max(di.sigmas[cname], 1e-10)
+                    raw[n] = b / s
+                    icept -= b * di.means[cname] / s
+                else:
+                    raw[n] = b
+            raw["Intercept"] = icept
+            self._coefficients = raw
+        else:
+            self._coefficients = coefs
+        self._output.model_summary = {
+            "family": st.family, "link": st.link,
+            "number_of_predictors_total": len(names) - 1,
+            "number_of_active_predictors": int(sum(
+                1 for v in (st.beta.flatten() if st.family == MULTINOMIAL
+                            else st.beta[:-1]) if abs(v) > 1e-10)),
+        }
+        if self.params.get("compute_p_values") and st.family != MULTINOMIAL:
+            self._compute_p_values()
+
+    def _compute_p_values(self):
+        """z-scores/p-values from the inverse Fisher information (GLM.java
+        computePValues) — valid for lambda=0 IRLS."""
+        try:
+            from scipy import stats as sps  # optional
+            have_scipy = True
+        except ImportError:
+            have_scipy = False
+        G = self._Gram
+        try:
+            cov = np.linalg.inv(G + 1e-10 * np.eye(len(G)))
+        except np.linalg.LinAlgError:
+            return
+        se = np.sqrt(np.clip(np.diag(cov), 0, None))
+        z = self._state.beta / np.where(se > 0, se, np.inf)
+        self._std_errors = se
+        self._z_values = z
+        if have_scipy:
+            self._p_values = 2 * (1 - sps.norm.cdf(np.abs(z)))
+        else:
+            self._p_values = 2 * (1 - 0.5 * (1 + np.vectorize(math.erf)(np.abs(z) / math.sqrt(2))))
+
+    # ---- public accessors (h2o-py parity) --------------------------------
+    def coef(self) -> dict:
+        return dict(self._coefficients)
+
+    def coef_norm(self) -> dict:
+        return dict(self._coefficients_std)

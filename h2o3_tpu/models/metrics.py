@@ -1,0 +1,300 @@
+"""Model metrics — hex/ModelMetrics* rebuilt as fused device passes.
+
+Reference: hex/ModelMetrics.java (+~30 subclasses), hex/AUC2.java (streaming
+400-bin threshold histogram), hex/ConfusionMatrix.java, hex/GainsLift.java.
+H2O computes metrics inside the BigScore MRTask pass (hex/Model.java:2077) —
+one sweep over rows, small reduced state.
+
+TPU-native design: same one-sweep structure: each metric family is a single
+jitted function of (actual, predicted, weight) row-sharded arrays returning a
+small replicated state (histograms / sums), finished on the host. The AUC
+follows AUC2's histogram method but with 4096 score bins (still one psum-able
+histogram; finer than the reference's 400, so closer to the exact AUC).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NBINS_AUC = 4096
+GAINS_GROUPS = 16
+
+
+def _wmask(y, w):
+    """Fold NaN rows (padding / missing response) into the weight vector."""
+    valid = ~jnp.isnan(y)
+    w = jnp.where(valid, w, 0.0)
+    y = jnp.where(valid, 0.0, 0.0) + jnp.where(valid, y, 0.0)
+    return y, w
+
+
+# ===========================================================================
+# Regression (hex/ModelMetricsRegression.java)
+@jax.jit
+def _regression_pass(y, p, w):
+    y, w = _wmask(y, w)
+    p = jnp.where(w > 0, p, 0.0)
+    n = w.sum()
+    err = y - p
+    sse = (w * err * err).sum()
+    sae = (w * jnp.abs(err)).sum()
+    # RMSLE guard: only valid when y,p >= 0
+    sle = jnp.log1p(jnp.clip(p, 0.0)) - jnp.log1p(jnp.clip(y, 0.0))
+    ssle = (w * sle * sle).sum()
+    neg = ((w > 0) & ((y < 0) | (p < 0))).sum()
+    sy = (w * y).sum()
+    syy = (w * y * y).sum()
+    return n, sse, sae, ssle, neg, sy, syy
+
+
+@dataclass
+class RegressionMetrics:
+    mse: float
+    rmse: float
+    mae: float
+    rmsle: float
+    mean_residual_deviance: float
+    r2: float
+    nobs: int
+
+    def to_dict(self):
+        return {"MSE": self.mse, "RMSE": self.rmse, "MAE": self.mae,
+                "RMSLE": self.rmsle,
+                "mean_residual_deviance": self.mean_residual_deviance,
+                "r2": self.r2, "nobs": self.nobs}
+
+
+def regression_metrics(y, p, w=None) -> RegressionMetrics:
+    w = jnp.ones_like(y) if w is None else w
+    n, sse, sae, ssle, neg, sy, syy = (float(v) for v in _regression_pass(y, p, w))
+    mse = sse / n if n else math.nan
+    var_y = syy / n - (sy / n) ** 2 if n else math.nan
+    return RegressionMetrics(
+        mse=mse, rmse=math.sqrt(mse) if mse == mse else math.nan,
+        mae=sae / n if n else math.nan,
+        rmsle=math.sqrt(ssle / n) if n and neg == 0 else math.nan,
+        mean_residual_deviance=mse,
+        r2=1.0 - mse / var_y if n and var_y > 0 else math.nan,
+        nobs=int(n))
+
+
+# ===========================================================================
+# Binomial (hex/ModelMetricsBinomial.java + hex/AUC2.java)
+@jax.jit
+def _binomial_pass(y, p, w):
+    """One sweep → logloss sum + per-score-bin pos/neg weight histograms."""
+    y, w = _wmask(y, w)
+    p = jnp.clip(jnp.where(w > 0, p, 0.5), 1e-15, 1 - 1e-15)
+    n = w.sum()
+    ll = -(w * (y * jnp.log(p) + (1 - y) * jnp.log(1 - p))).sum()
+    bins = jnp.clip((p * NBINS_AUC).astype(jnp.int32), 0, NBINS_AUC - 1)
+    pos = jax.ops.segment_sum(w * y, bins, NBINS_AUC)
+    neg = jax.ops.segment_sum(w * (1.0 - y), bins, NBINS_AUC)
+    sse = (w * (y - p) ** 2).sum()
+    return n, ll, sse, pos, neg
+
+
+@dataclass
+class BinomialMetrics:
+    auc: float
+    pr_auc: float
+    gini: float
+    logloss: float
+    mse: float
+    rmse: float
+    mean_per_class_error: float
+    f1: float
+    f2: float
+    f0point5: float
+    accuracy: float
+    precision: float
+    recall: float
+    specificity: float
+    mcc: float
+    max_f1_threshold: float
+    confusion_matrix: np.ndarray  # 2x2 at max-F1 threshold [ [tn, fp], [fn, tp] ]
+    gains_lift: Optional[dict] = None
+    nobs: int = 0
+    domain: Optional[list] = None
+
+    def to_dict(self):
+        d = {k: getattr(self, k) for k in
+             ("auc", "pr_auc", "gini", "logloss", "mse", "rmse",
+              "mean_per_class_error", "f1", "accuracy", "precision", "recall",
+              "mcc", "max_f1_threshold", "nobs")}
+        d["confusion_matrix"] = self.confusion_matrix.tolist()
+        return d
+
+
+def binomial_metrics(y, p, w=None, domain=None) -> BinomialMetrics:
+    w = jnp.ones_like(y) if w is None else w
+    n, ll, sse, pos, neg = _binomial_pass(y, p, w)
+    n, ll, sse = float(n), float(ll), float(sse)
+    pos = np.asarray(pos, np.float64)   # bin b ≈ score (b+.5)/NBINS
+    neg = np.asarray(neg, np.float64)
+    P, N = pos.sum(), neg.sum()
+    # sweep thresholds high→low: cumulative TP/FP above each bin boundary
+    tp = np.cumsum(pos[::-1])[::-1]     # predicted positive at thr = bin edge
+    fp = np.cumsum(neg[::-1])[::-1]
+    # prepend "predict nothing positive" point
+    tp_all = np.concatenate([tp, [0.0]])
+    fp_all = np.concatenate([fp, [0.0]])
+    tpr = tp_all / P if P else np.zeros_like(tp_all)
+    fpr = fp_all / N if N else np.zeros_like(fp_all)
+    auc = float(np.trapezoid(tpr[::-1], fpr[::-1])) if P and N else math.nan
+    # PR-AUC (ModelMetricsBinomial._pr_auc): precision vs recall
+    with np.errstate(invalid="ignore", divide="ignore"):
+        prec = np.where(tp_all + fp_all > 0, tp_all / (tp_all + fp_all), 1.0)
+    pr_auc = float(np.trapezoid(prec[::-1], tpr[::-1])) if P else math.nan
+    # threshold metrics at max F1 (H2O's default CM threshold)
+    fn = P - tp_all
+    tn = N - fp_all
+    with np.errstate(invalid="ignore", divide="ignore"):
+        f1 = 2 * tp_all / (2 * tp_all + fp_all + fn)
+        f1 = np.nan_to_num(f1)
+    bi = int(np.argmax(f1))
+    thr = bi / NBINS_AUC
+    TP, FP, FN, TN = tp_all[bi], fp_all[bi], fn[bi], tn[bi]
+    precision = TP / (TP + FP) if TP + FP else 0.0
+    recall = TP / (TP + FN) if TP + FN else 0.0
+    spec = TN / (TN + FP) if TN + FP else 0.0
+    acc = (TP + TN) / n if n else math.nan
+    beta2, beta05 = 4.0, 0.25
+    f2 = (1 + beta2) * precision * recall / (beta2 * precision + recall) \
+        if precision + recall else 0.0
+    f05 = (1 + beta05) * precision * recall / (beta05 * precision + recall) \
+        if precision + recall else 0.0
+    mcc_den = math.sqrt((TP + FP) * (TP + FN) * (TN + FP) * (TN + FN))
+    mcc = (TP * TN - FP * FN) / mcc_den if mcc_den else 0.0
+    mpce = 0.5 * ((FN / P if P else 0.0) + (FP / N if N else 0.0))
+    gl = _gains_lift(pos, neg)
+    return BinomialMetrics(
+        auc=auc, pr_auc=pr_auc, gini=2 * auc - 1 if auc == auc else math.nan,
+        logloss=ll / n if n else math.nan,
+        mse=sse / n if n else math.nan,
+        rmse=math.sqrt(sse / n) if n else math.nan,
+        mean_per_class_error=mpce,
+        f1=float(f1[bi]), f2=f2, f0point5=f05, accuracy=acc,
+        precision=precision, recall=recall, specificity=spec, mcc=mcc,
+        max_f1_threshold=thr,
+        confusion_matrix=np.array([[TN, FP], [FN, TP]]),
+        gains_lift=gl, nobs=int(n), domain=domain)
+
+
+def _gains_lift(pos, neg) -> dict:
+    """hex/GainsLift.java — 16 quantile groups by predicted score."""
+    P, N = pos.sum(), neg.sum()
+    tot = P + N
+    if tot == 0 or P == 0:
+        return {}
+    cum_w = np.cumsum((pos + neg)[::-1])  # from highest score down
+    cum_p = np.cumsum(pos[::-1])
+    edges = [tot * (g + 1) / GAINS_GROUPS for g in range(GAINS_GROUPS)]
+    rows = []
+    prev_w = prev_p = 0.0
+    for g, e in enumerate(edges):
+        i = int(np.searchsorted(cum_w, e))
+        i = min(i, len(cum_w) - 1)
+        cw, cp = cum_w[i], cum_p[i]
+        grp_w, grp_p = cw - prev_w, cp - prev_p
+        resp_rate = grp_p / grp_w if grp_w else 0.0
+        lift = resp_rate / (P / tot)
+        rows.append({"group": g + 1,
+                     "cumulative_data_fraction": cw / tot,
+                     "response_rate": resp_rate, "lift": lift,
+                     "cumulative_lift": (cp / cw) / (P / tot) if cw else 0.0,
+                     "capture_rate": grp_p / P,
+                     "cumulative_capture_rate": cp / P})
+        prev_w, prev_p = cw, cp
+    return {"groups": rows}
+
+
+# ===========================================================================
+# Multinomial (hex/ModelMetricsMultinomial.java)
+def _multinomial_pass(nclass):
+    @jax.jit
+    def f(y, probs, w):
+        y, w = _wmask(y, w)
+        yi = y.astype(jnp.int32)
+        n = w.sum()
+        py = jnp.take_along_axis(probs, yi[:, None], axis=1)[:, 0]
+        ll = -(w * jnp.log(jnp.clip(py, 1e-15, 1.0))).sum()
+        pred = jnp.argmax(probs, axis=1)
+        cm = jax.ops.segment_sum(w, yi * nclass + pred.astype(jnp.int32),
+                                 nclass * nclass).reshape(nclass, nclass)
+        # top-k hit ratios, k up to min(10, K)
+        kmax = min(10, nclass)
+        _, topk = jax.lax.top_k(probs, kmax)
+        hits = (topk == yi[:, None]).astype(jnp.float32)
+        hit_cum = jnp.cumsum(hits, axis=1)
+        hit_k = (w[:, None] * hit_cum).sum(axis=0)
+        # MSE over the 1-vs-all encoding (H2O: 1 - p_actual squared + sum others)
+        onehot = jax.nn.one_hot(yi, nclass)
+        sse = (w[:, None] * (onehot - probs) ** 2).sum()
+        return n, ll, cm, hit_k, sse
+    return f
+
+
+@dataclass
+class MultinomialMetrics:
+    logloss: float
+    mse: float
+    rmse: float
+    mean_per_class_error: float
+    error: float                # overall classification error
+    confusion_matrix: np.ndarray
+    hit_ratios: list
+    nobs: int
+    domain: Optional[list] = None
+
+    def to_dict(self):
+        return {"logloss": self.logloss, "MSE": self.mse, "RMSE": self.rmse,
+                "mean_per_class_error": self.mean_per_class_error,
+                "error": self.error,
+                "confusion_matrix": self.confusion_matrix.tolist(),
+                "hit_ratios": self.hit_ratios, "nobs": self.nobs}
+
+
+def multinomial_metrics(y, probs, w=None, domain=None) -> MultinomialMetrics:
+    nclass = int(probs.shape[1])
+    w = jnp.ones_like(y) if w is None else w
+    n, ll, cm, hit_k, sse = _multinomial_pass(nclass)(y, probs, w)
+    n, ll, sse = float(n), float(ll), float(sse)
+    cm = np.asarray(cm, np.float64)
+    row_tot = cm.sum(axis=1)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        per_class_err = np.where(row_tot > 0, 1.0 - np.diag(cm) / row_tot, 0.0)
+    seen = row_tot > 0
+    mpce = float(per_class_err[seen].mean()) if seen.any() else math.nan
+    err = 1.0 - np.diag(cm).sum() / n if n else math.nan
+    return MultinomialMetrics(
+        logloss=ll / n if n else math.nan,
+        mse=sse / n if n else math.nan,
+        rmse=math.sqrt(sse / n) if n else math.nan,
+        mean_per_class_error=mpce, error=float(err),
+        confusion_matrix=cm,
+        hit_ratios=[float(h) / n for h in np.asarray(hit_k)] if n else [],
+        nobs=int(n), domain=domain)
+
+
+# ===========================================================================
+# Clustering (hex/ModelMetricsClustering.java)
+@dataclass
+class ClusteringMetrics:
+    tot_withinss: float
+    totss: float
+    betweenss: float
+    size: list
+    withinss: list
+    nobs: int
+
+    def to_dict(self):
+        return {"tot_withinss": self.tot_withinss, "totss": self.totss,
+                "betweenss": self.betweenss, "size": self.size,
+                "withinss": self.withinss, "nobs": self.nobs}

@@ -1,0 +1,498 @@
+"""ModelBuilder / Model framework — hex/ModelBuilder.java + hex/Model.java.
+
+Reference: hex/ModelBuilder.java (param validation `init(expensive)` :1319,
+n-fold CV orchestration `computeCrossValidation` :597, Driver :228),
+hex/Model.java (score :1764, BigScore MRTask :2077, per-row score0 :2244,
+adaptTestForTrain), hex/DataInfo.java:23 (row codec: one-hot expansion,
+standardization, NA imputation).
+
+TPU-native design:
+  * A builder's Driver is a controller loop launching jitted device programs;
+    "BigScore" is one jitted batch scorer over the row-sharded matrix — there
+    is no per-row score0; scoring is vectorized by construction.
+  * DataInfo becomes a matrix-builder: it materializes the (padded_rows ×
+    nfeatures) f32 design matrix ONCE per train/score (one-hot on device via
+    jax.nn.one_hot, standardization/imputation fused in the same jit).
+  * CV builds fold models sequentially on the controller (each a full-mesh
+    jitted program — the TPU analog of H2O building CV models in parallel on
+    idle cluster CPU is keeping the chips busy with one model at a time).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec, T_CAT, T_NUM
+from h2o3_tpu.core.jobs import Job
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.parallel import mesh as _mesh
+
+
+# ===========================================================================
+class DataInfo:
+    """Design-matrix codec (hex/DataInfo.java:23).
+
+    cat_mode:
+      * "onehot" — expand categoricals to indicator columns (GLM/DL/KMeans/PCA)
+      * "label"  — keep categorical codes as one numeric column (tree algos,
+                   which bin them natively)
+    """
+
+    def __init__(self, frame: Frame, x: Sequence[str], y: Optional[str],
+                 cat_mode: str = "onehot", standardize: bool = False,
+                 impute_missing: bool = True, weights: Optional[str] = None,
+                 offset: Optional[str] = None):
+        self.cat_mode = cat_mode
+        self.standardize = standardize
+        self.impute_missing = impute_missing
+        self.response_name = y
+        self.weights_name = weights
+        self.offset_name = offset
+        self.predictors = [c for c in x if c != y and frame.vec(c).type != "str"]
+        self.cat_cols = [c for c in self.predictors if frame.vec(c).type == T_CAT]
+        self.num_cols = [c for c in self.predictors if c not in self.cat_cols]
+        self.domains = {c: list(frame.vec(c).domain) for c in self.cat_cols}
+        self.cardinalities = {c: len(self.domains[c]) for c in self.cat_cols}
+        # response metadata
+        self.response_domain = None
+        if y is not None and frame.vec(y).type == T_CAT:
+            self.response_domain = list(frame.vec(y).domain)
+        # normalization stats from the TRAINING frame
+        self.means = {c: frame.vec(c).mean() for c in self.num_cols}
+        self.sigmas = {c: frame.vec(c).sigma() or 1.0 for c in self.num_cols}
+        # expanded feature names (coefficient_names order: cats first like H2O)
+        self.feature_names: list[str] = []
+        if cat_mode == "onehot":
+            for c in self.cat_cols:
+                self.feature_names += [f"{c}.{l}" for l in self.domains[c]]
+            self.feature_names += self.num_cols
+        else:
+            self.feature_names = list(self.predictors)
+
+    @property
+    def n_features(self) -> int:
+        return len(self.feature_names)
+
+    # ---- device-side matrix build --------------------------------------
+    def matrix(self, frame: Frame) -> jax.Array:
+        """(padded, n_features) f32 row-sharded design matrix. NaN padding rows
+        remain NaN in "label" mode; in onehot mode NAs are imputed/zeroed and
+        callers must use weights() to exclude padding."""
+        frame = self.adapt(frame)
+        if self.cat_mode == "label":
+            return frame.matrix(self.predictors)
+        raw_cat = frame.matrix(self.cat_cols) if self.cat_cols else None
+        raw_num = frame.matrix(self.num_cols) if self.num_cols else None
+        cards = tuple(self.cardinalities[c] for c in self.cat_cols)
+        means = np.array([self.means[c] for c in self.num_cols], np.float32)
+        sigmas = np.array([max(s, 1e-10) for c, s in
+                           ((c, self.sigmas[c]) for c in self.num_cols)],
+                          np.float32)
+        standardize = self.standardize
+
+        def build(raw_cat, raw_num, means, sigmas):
+            parts = []
+            if raw_cat is not None:
+                for j, k in enumerate(cards):
+                    col = raw_cat[:, j]
+                    code = jnp.where(jnp.isnan(col), -1, col).astype(jnp.int32)
+                    parts.append(jax.nn.one_hot(code, k, dtype=jnp.float32))
+            if raw_num is not None:
+                x = raw_num
+                if standardize:
+                    x = (x - means) / sigmas
+                if self.impute_missing:
+                    fill = jnp.zeros_like(means) if standardize else means
+                    x = jnp.where(jnp.isnan(x), fill, x)
+                parts.append(x)
+            return jnp.concatenate(parts, axis=1)
+
+        out_sh = _mesh.cloud().rows_sharding(2)
+        return jax.jit(build, out_shardings=out_sh)(raw_cat, raw_num, means, sigmas)
+
+    def response(self, frame: Frame) -> jax.Array:
+        """(padded,) f32 response; class index for categorical; NaN padding."""
+        return frame.matrix([self.response_name])[:, 0]
+
+    def weights(self, frame: Frame) -> jax.Array:
+        """(padded,) f32 observation weights; 0 on padding rows and rows with
+        missing response (the BigScore skip-NA contract)."""
+        if self.weights_name:
+            w = frame.matrix([self.weights_name])[:, 0]
+            w = jnp.where(jnp.isnan(w), 0.0, w)
+        else:
+            w = jnp.ones(frame.padded_len, jnp.float32)
+        n = frame.nrows
+
+        @jax.jit
+        def mask(w):
+            idx = jnp.arange(w.shape[0])
+            return jnp.where(idx < n, w, 0.0)
+        return mask(w)
+
+    def offset(self, frame: Frame):
+        if not self.offset_name:
+            return None
+        o = frame.matrix([self.offset_name])[:, 0]
+        return jnp.where(jnp.isnan(o), 0.0, o)
+
+    # ---- test-frame adaptation (Model.adaptTestForTrain) ----------------
+    def adapt(self, frame: Frame) -> Frame:
+        """Remap categorical domains to training domains; add missing columns
+        as all-NA. Returns the original frame when nothing needs adapting."""
+        needed = list(self.predictors)
+        if self.response_name and self.response_name in frame.names:
+            needed.append(self.response_name)
+        for extra in (self.weights_name, self.offset_name):
+            if extra and extra in frame.names:
+                needed.append(extra)
+        changed = False
+        names, vecs = [], []
+        for c in needed:
+            if c not in frame.names:
+                v = Vec.from_numpy(np.full(frame.nrows, np.nan))
+                changed = True
+            else:
+                v = frame.vec(c)
+                want = self.domains.get(c) or (
+                    self.response_domain if c == self.response_name else None)
+                if v.type == T_CAT and want is not None and v.levels() != want:
+                    v = _remap_domain(v, want)
+                    changed = True
+                elif v.type == T_CAT and want is None and c in self.num_cols:
+                    # train saw numeric, test has cat → NA out
+                    v = Vec.from_numpy(np.full(frame.nrows, np.nan))
+                    changed = True
+            names.append(c)
+            vecs.append(v)
+        if not changed and names == frame.names[: len(names)]:
+            return frame
+        f = Frame(names, vecs)
+        DKV.remove(f.key)  # adaptation product is transient, not registered
+        return f
+
+
+def _remap_domain(v: Vec, want: list) -> Vec:
+    lookup = {l: i for i, l in enumerate(want)}
+    src = v.to_numpy()
+    dom = v.domain
+    out = np.full(len(src), np.nan)
+    for i, code in enumerate(src):
+        if not math.isnan(code):
+            out[i] = lookup.get(str(dom[int(code)]), np.nan)
+    return Vec._from_floats(np.where(np.isnan(out), 0.0, out),
+                            np.isnan(out), T_CAT, np.asarray(want, object))
+
+
+# ===========================================================================
+@dataclass
+class ModelOutput:
+    """hex/Model.Output analog: everything the training run learned."""
+    model_id: str = ""
+    algo: str = ""
+    names: list = field(default_factory=list)
+    domains: dict = field(default_factory=dict)
+    response_domain: Optional[list] = None
+    training_metrics: Optional[object] = None
+    validation_metrics: Optional[object] = None
+    cross_validation_metrics: Optional[object] = None
+    scoring_history: list = field(default_factory=list)
+    model_summary: dict = field(default_factory=dict)
+    variable_importances: Optional[list] = None
+    run_time_ms: int = 0
+    cv_predictions_key: Optional[str] = None
+    cv_fold_assignment_key: Optional[str] = None
+
+
+class ModelBase:
+    """Shared estimator/model surface (mirrors h2o-py H2OEstimator)."""
+
+    algo = "base"
+    supervised = True
+    _defaults: dict = {}
+    _COMMON = {
+        "model_id": None, "seed": -1, "nfolds": 0, "weights_column": None,
+        "offset_column": None, "fold_assignment": "AUTO", "fold_column": None,
+        "keep_cross_validation_predictions": False,
+        "keep_cross_validation_fold_assignment": False,
+        "ignored_columns": None, "ignore_const_cols": True,
+        "max_runtime_secs": 0.0, "standardize": True,
+        "categorical_encoding": "AUTO", "distribution": "AUTO",
+    }
+
+    def __init__(self, **params):
+        self.params = dict(self._COMMON)
+        self.params.update(self._defaults)
+        unknown = set(params) - set(self.params)
+        if unknown:
+            raise ValueError(f"{self.algo}: unknown parameters {sorted(unknown)}")
+        self.params.update(params)
+        self._output: Optional[ModelOutput] = None
+        self._dinfo: Optional[DataInfo] = None
+        self.key: Optional[str] = None
+
+    # ---- public training entrypoint (H2OEstimator.train) ----------------
+    def train(self, x=None, y=None, training_frame=None, validation_frame=None,
+              **overrides) -> "ModelBase":
+        self.params.update(overrides)
+        frame = training_frame
+        assert isinstance(frame, Frame), "training_frame must be a Frame"
+        if self.supervised:
+            assert y is not None, f"{self.algo} requires a response column y"
+        x = self._resolve_predictors(frame, x, y)
+        self._dinfo = self._make_data_info(frame, x, y)
+        self.key = self.params.get("model_id") or DKV.make_key(self.algo)
+        self._output = ModelOutput(model_id=self.key, algo=self.algo,
+                                   names=list(x),
+                                   domains=self._dinfo.domains,
+                                   response_domain=self._dinfo.response_domain)
+        job = Job(description=f"{self.algo} on {frame.key}", dest=self.key)
+        t0 = time.time()
+
+        def work(job: Job):
+            if int(self.params["nfolds"] or 0) > 1 or self.params.get("fold_column"):
+                self._run_cross_validation(frame, x, y, job)
+            self._fit(frame, job)
+            self._score_train_valid(frame, validation_frame)
+            self._output.run_time_ms = int(1000 * (time.time() - t0))
+            return self
+
+        job.start(work, background=False)
+        job.join()
+        DKV.put(self.key, self)
+        return self
+
+    def _resolve_predictors(self, frame, x, y):
+        if x is None:
+            skip = {y, self.params.get("weights_column"),
+                    self.params.get("offset_column"),
+                    self.params.get("fold_column")}
+            skip |= set(self.params.get("ignored_columns") or [])
+            x = [c for c in frame.names if c not in skip]
+        else:
+            x = [frame.names[i] if isinstance(i, int) else i for i in x]
+        if self.params.get("ignore_const_cols"):
+            x = [c for c in x
+                 if frame.vec(c).type == "str"
+                 or not (frame.vec(c).codec.kind == "const"
+                         and frame.vec(c).na_cnt() == 0)]
+        return x
+
+    def _make_data_info(self, frame, x, y) -> DataInfo:
+        return DataInfo(frame, x, y,
+                        cat_mode=self._cat_mode(),
+                        standardize=bool(self.params.get("standardize")),
+                        weights=self.params.get("weights_column"),
+                        offset=self.params.get("offset_column"))
+
+    def _cat_mode(self) -> str:
+        return "onehot"
+
+    # ---- algo hooks ------------------------------------------------------
+    def _fit(self, frame: Frame, job: Job):
+        raise NotImplementedError
+
+    def _score_matrix(self, X: jax.Array):
+        """Batch score0: return regression preds (n,) or class probs (n,K)."""
+        raise NotImplementedError
+
+    # ---- scoring / metrics ----------------------------------------------
+    @property
+    def _is_classifier(self) -> bool:
+        return self.supervised and self._dinfo.response_domain is not None
+
+    @property
+    def nclasses(self) -> int:
+        d = self._dinfo.response_domain if self._dinfo else None
+        return len(d) if d else 1
+
+    def predict(self, test_data: Frame) -> Frame:
+        X = self._dinfo.matrix(test_data)
+        out = self._score_matrix(X)
+        n = test_data.nrows
+        if self._is_classifier:
+            probs = np.asarray(out)[:n]
+            pred = probs.argmax(axis=1).astype(np.float64)
+            dom = self._dinfo.response_domain
+            cols = {"predict": Vec._from_floats(pred, np.zeros(n, bool),
+                                                T_CAT, np.asarray(dom, object))}
+            for k, lvl in enumerate(dom):
+                cols[f"p{lvl}"] = Vec.from_numpy(probs[:, k].astype(np.float64))
+            return Frame(list(cols), list(cols.values()))
+        pred = np.asarray(out)[:n].astype(np.float64)
+        return Frame(["predict"], [Vec.from_numpy(pred)])
+
+    def model_performance(self, test_data: Optional[Frame] = None):
+        if test_data is None:
+            return self._output.training_metrics
+        return self._compute_metrics(test_data)
+
+    def _compute_metrics(self, frame: Frame):
+        di = self._dinfo
+        X = di.matrix(frame)
+        y = di.response(frame)
+        w = di.weights(frame)
+        w = jnp.where(jnp.isnan(y), 0.0, w)
+        out = self._score_matrix(X)
+        return self._metrics_from_preds(y, out, w)
+
+    def _metrics_from_preds(self, y, out, w):
+        if not self.supervised:
+            return None
+        if self._is_classifier and self.nclasses == 2:
+            return M.binomial_metrics(y, out[:, 1], w,
+                                      domain=self._dinfo.response_domain)
+        if self._is_classifier:
+            return M.multinomial_metrics(y, out, w,
+                                         domain=self._dinfo.response_domain)
+        return M.regression_metrics(y, out, w)
+
+    def _score_train_valid(self, frame, valid):
+        if not self.supervised:
+            return
+        self._output.training_metrics = self._compute_metrics(frame)
+        if valid is not None:
+            self._output.validation_metrics = self._compute_metrics(valid)
+
+    # ---- cross-validation (ModelBuilder.computeCrossValidation :597) -----
+    def _run_cross_validation(self, frame: Frame, x, y, job: Job):
+        nfolds = int(self.params["nfolds"] or 0)
+        fold_col = self.params.get("fold_column")
+        n = frame.nrows
+        if fold_col:
+            fa = frame.vec(fold_col).to_numpy().astype(int)
+            folds = sorted(set(fa.tolist()))
+        else:
+            seed = int(self.params.get("seed") or -1)
+            rng = np.random.default_rng(seed if seed > 0 else None)
+            if self.params.get("fold_assignment", "AUTO") in ("AUTO", "Random"):
+                fa = rng.integers(0, nfolds, size=n)
+            elif self.params["fold_assignment"] == "Modulo":
+                fa = np.arange(n) % nfolds
+            else:  # Stratified — per-class modulo on shuffled order
+                yv = frame.vec(y).to_numpy()
+                fa = np.zeros(n, int)
+                for cls in np.unique(yv[~np.isnan(yv)]):
+                    idx = np.where(yv == cls)[0]
+                    rng.shuffle(idx)
+                    fa[idx] = np.arange(len(idx)) % nfolds
+            folds = list(range(nfolds))
+        host = frame.to_numpy()
+        col_data = {c: host[:, j] for j, c in enumerate(frame.names)}
+        cat_doms = {c: frame.vec(c).domain for c in frame.names
+                    if frame.vec(c).type == T_CAT}
+        holdout_pred = None
+        cv_models = []
+        for fi, f in enumerate(folds):
+            tr_idx = fa != f
+            te_idx = ~tr_idx
+            tr = _subframe(frame, col_data, cat_doms, tr_idx)
+            te = _subframe(frame, col_data, cat_doms, te_idx)
+            mb = self.__class__(**{k: v for k, v in self.params.items()
+                                   if k not in ("nfolds", "model_id",
+                                                "fold_column")})
+            mb.params["nfolds"] = 0
+            mb.train(x=x, y=y, training_frame=tr)
+            cv_models.append(mb)
+            pf = mb.predict(te)
+            if holdout_pred is None:
+                ncols_p = pf.ncols
+                holdout_pred = np.full((n, ncols_p), np.nan)
+            holdout_pred[te_idx] = pf.to_numpy()
+            for k in (tr.key, te.key, pf.key):
+                DKV.remove(k)
+            job.update(0.5 * (fi + 1) / len(folds), f"CV fold {fi+1}")
+        # CV metrics on the combined holdout predictions
+        yv = self._dinfo.response(frame)
+        w = self._dinfo.weights(frame)
+        pad = frame.padded_len
+        if self._is_classifier:
+            probs = np.zeros((pad, self.nclasses), np.float32)
+            probs[:n] = holdout_pred[:, 1:]
+            out = jnp.asarray(probs)
+        else:
+            pr = np.zeros(pad, np.float32)
+            pr[:n] = holdout_pred[:, 0]
+            out = jnp.asarray(pr)
+        self._output.cross_validation_metrics = self._metrics_from_preds(yv, out, w)
+        self._cv_models = cv_models
+        if self.params.get("keep_cross_validation_predictions"):
+            cvp = Frame.from_numpy(holdout_pred[:, 1:] if self._is_classifier
+                                   else holdout_pred)
+            self._output.cv_predictions_key = cvp.key
+        if self.params.get("keep_cross_validation_fold_assignment"):
+            cvf = Frame.from_numpy(fa.astype(np.float64))
+            self._output.cv_fold_assignment_key = cvf.key
+
+    # ---- introspection ---------------------------------------------------
+    def auc(self, valid=False):
+        m = (self._output.validation_metrics if valid
+             else self._output.training_metrics)
+        return getattr(m, "auc", None)
+
+    def logloss(self, valid=False):
+        m = (self._output.validation_metrics if valid
+             else self._output.training_metrics)
+        return getattr(m, "logloss", None)
+
+    def mse(self, valid=False):
+        m = (self._output.validation_metrics if valid
+             else self._output.training_metrics)
+        return getattr(m, "mse", None)
+
+    def rmse(self, valid=False):
+        m = (self._output.validation_metrics if valid
+             else self._output.training_metrics)
+        return getattr(m, "rmse", None)
+
+    @property
+    def model_id(self):
+        return self.key
+
+    def summary(self):
+        return self._output.model_summary if self._output else {}
+
+    def scoring_history(self):
+        return self._output.scoring_history if self._output else []
+
+    def varimp(self, use_pandas=False):
+        vi = self._output.variable_importances if self._output else None
+        if vi and use_pandas:
+            import pandas as pd
+            return pd.DataFrame(vi)
+        return vi
+
+    def to_dict(self):
+        o = self._output
+        return {
+            "model_id": self.key, "algo": self.algo,
+            "params": {k: v for k, v in self.params.items() if v is not None},
+            "training_metrics": o.training_metrics.to_dict() if o and o.training_metrics else None,
+            "validation_metrics": o.validation_metrics.to_dict() if o and o.validation_metrics else None,
+            "model_summary": o.model_summary if o else {},
+        }
+
+
+def _subframe(frame: Frame, col_data, cat_doms, idx: np.ndarray) -> Frame:
+    """Row-subset a frame on the host (CV fold splitting)."""
+    names, vecs = [], []
+    for c in frame.names:
+        v = frame.vec(c)
+        if v.type == "str":
+            vecs.append(Vec.from_numpy(v.host_data[idx], type="str"))
+        else:
+            col = col_data[c][idx]
+            mask = np.isnan(col)
+            vecs.append(Vec._from_floats(np.where(mask, 0.0, col), mask,
+                                         v.type, cat_doms.get(c)))
+        names.append(c)
+    return Frame(names, vecs)

@@ -1,0 +1,116 @@
+"""Naive Bayes — hex/naivebayes/NaiveBayes.java: one-pass conditional tables.
+
+Reference: per-class priors + per-feature conditionals (categorical: Laplace-
+smoothed count tables; numeric: per-class Gaussian mean/sd) computed in a
+single MRTask; scoring is a log-space sum.
+
+TPU-native design: all tables come from segment-sums keyed by class in one
+jitted pass (psum across shards); scoring is a batched log-density matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.model import ModelBase, DataInfo
+
+
+class H2ONaiveBayesEstimator(ModelBase):
+    algo = "naivebayes"
+    _defaults = {
+        "laplace": 0.0, "min_sdev": 0.001, "eps_sdev": 0.0,
+        "min_prob": 0.001, "eps_prob": 0.0, "compute_metrics": True,
+    }
+
+    def _cat_mode(self):
+        return "label"
+
+    def _make_data_info(self, frame, x, y):
+        return DataInfo(frame, x, y, cat_mode="label", standardize=False,
+                        impute_missing=False,
+                        weights=self.params.get("weights_column"))
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        X = di.matrix(frame)     # label-encoded cats, NaN NAs
+        y = di.response(frame)
+        w = di.weights(frame)
+        w = jnp.where(jnp.isnan(y), 0.0, w)
+        K = self.nclasses
+        yi = jnp.where(jnp.isnan(y), 0, y).astype(jnp.int32)
+        lap = float(self.params.get("laplace") or 0.0)
+        cat_idx = [i for i, c in enumerate(di.predictors) if c in di.cat_cols]
+        num_idx = [i for i, c in enumerate(di.predictors) if c not in di.cat_cols]
+        cards = [di.cardinalities[di.predictors[i]] for i in cat_idx]
+
+        @jax.jit
+        def tables(X, yi, w):
+            prior = jax.ops.segment_sum(w, yi, num_segments=K)
+            outs = []
+            for j, card in zip(cat_idx, cards):
+                col = X[:, j]
+                ok = ~jnp.isnan(col)
+                code = jnp.where(ok, col, 0).astype(jnp.int32)
+                idx = yi * card + code
+                cnt = jax.ops.segment_sum(jnp.where(ok, w, 0.0), idx,
+                                          num_segments=K * card)
+                outs.append(cnt.reshape(K, card))
+            nsum, nssq, ncnt = [], [], []
+            for j in num_idx:
+                col = X[:, j]
+                ok = ~jnp.isnan(col)
+                wv = jnp.where(ok, w, 0.0)
+                cv = jnp.where(ok, col, 0.0)
+                nsum.append(jax.ops.segment_sum(wv * cv, yi, num_segments=K))
+                nssq.append(jax.ops.segment_sum(wv * cv * cv, yi,
+                                                num_segments=K))
+                ncnt.append(jax.ops.segment_sum(wv, yi, num_segments=K))
+            return prior, outs, nsum, nssq, ncnt
+
+        prior, cat_cnt, nsum, nssq, ncnt = tables(X, yi, w)
+        prior = np.asarray(prior, np.float64)
+        self._priors = prior / prior.sum()
+        self._cat_idx = cat_idx
+        self._num_idx = num_idx
+        self._cat_probs = []
+        for cnt, card in zip(cat_cnt, cards):
+            c = np.asarray(cnt, np.float64) + lap
+            self._cat_probs.append(c / c.sum(axis=1, keepdims=True))
+        min_sd = float(self.params.get("min_sdev") or 1e-3)
+        self._num_mean, self._num_sd = [], []
+        for s, q, c in zip(nsum, nssq, ncnt):
+            s, q, c = (np.asarray(v, np.float64) for v in (s, q, c))
+            m = s / np.maximum(c, 1e-30)
+            var = q / np.maximum(c, 1e-30) - m * m
+            sd = np.sqrt(np.maximum(var * c / np.maximum(c - 1, 1), min_sd ** 2))
+            self._num_mean.append(m)
+            self._num_sd.append(sd)
+        self._output.model_summary = {
+            "nclasses": K, "priors": self._priors.tolist(), "laplace": lap}
+
+    def _score_matrix(self, X):
+        K = self.nclasses
+        logp = jnp.log(jnp.asarray(np.maximum(self._priors, 1e-300),
+                                   jnp.float32))[None, :]
+        parts = jnp.tile(logp, (X.shape[0], 1))
+        min_prob = float(self.params.get("min_prob") or 1e-3)
+        for t, j in enumerate(self._cat_idx):
+            tbl = jnp.asarray(np.log(np.maximum(self._cat_probs[t], min_prob)),
+                              jnp.float32)          # (K, card)
+            col = X[:, j]
+            ok = ~jnp.isnan(col)
+            code = jnp.where(ok, col, 0).astype(jnp.int32)
+            contrib = tbl.T[code]                    # (n, K)
+            parts = parts + jnp.where(ok[:, None], contrib, 0.0)
+        for t, j in enumerate(self._num_idx):
+            m = jnp.asarray(self._num_mean[t], jnp.float32)[None, :]
+            sd = jnp.asarray(self._num_sd[t], jnp.float32)[None, :]
+            col = X[:, j][:, None]
+            ok = ~jnp.isnan(X[:, j])
+            ll = -0.5 * jnp.log(2 * jnp.pi * sd * sd) \
+                - (col - m) ** 2 / (2 * sd * sd)
+            parts = parts + jnp.where(ok[:, None], ll, 0.0)
+        return jax.nn.softmax(parts, axis=1)

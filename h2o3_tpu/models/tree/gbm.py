@@ -1,0 +1,6 @@
+"""GBM public module — driver lives in shared_tree.py (GBM/DRF share it,
+mirroring hex/tree/SharedTree.java ownership of the build loop)."""
+
+from h2o3_tpu.models.tree.shared_tree import H2OGradientBoostingEstimator
+
+__all__ = ["H2OGradientBoostingEstimator"]

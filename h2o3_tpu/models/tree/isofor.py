@@ -1,0 +1,140 @@
+"""Isolation Forest — hex/tree/isofor/IsolationForest.java.
+
+Reference: random-split trees on row samples; isolation depth → anomaly score.
+H2O grows trees choosing a random column and a random threshold inside the
+node's observed [min,max] and scores rows by normalized mean path length.
+
+TPU-native design: no histograms needed — per level we only need per-(leaf,
+col) min/max (one segment reduction) to draw random thresholds; routing reuses
+the shared apply_splits kernel. Path length is encoded INTO the tree's value
+array (value[node] = depth(node) + c(node_size)), so scoring the ensemble is
+the same fixed-depth gather walk as GBM — mean path length = average of tree
+"predictions"."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.models.tree import engine as E
+from h2o3_tpu.models.tree.shared_tree import SharedTreeEstimator
+
+
+def _avg_path(n: float) -> float:
+    """c(n): average unsuccessful-search path length in a BST of n nodes."""
+    if n <= 1:
+        return 0.0
+    if n == 2:
+        return 1.0
+    h = math.log(n - 1) + 0.5772156649
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+class H2OIsolationForestEstimator(SharedTreeEstimator):
+    algo = "isolationforest"
+    supervised = False
+    _defaults = dict(SharedTreeEstimator._tree_defaults)
+    _defaults.update({"ntrees": 50, "max_depth": 8, "sample_size": 256,
+                      "sample_rate": -1.0, "contamination": -1.0})
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        X = di.matrix(frame)
+        w = di.weights(frame)
+        n = frame.nrows
+        C = X.shape[1]
+        D = int(self.params["max_depth"])
+        ntrees = int(self.params["ntrees"])
+        seed = int(self.params.get("seed") or -1)
+        rng = np.random.default_rng(seed if seed > 0 else 42)
+        sample_size = int(self.params.get("sample_size") or 256)
+        sample_rate = float(self.params.get("sample_rate") or -1.0)
+        psi = (max(2, int(sample_rate * n)) if sample_rate > 0
+               else min(sample_size, n))
+        nodes = 2 ** (D + 1) - 1
+        wh = np.asarray(w)
+        live = np.nonzero(wh > 0)[0]
+        trees = []
+        for t in range(ntrees):
+            idx = rng.choice(live, size=min(psi, len(live)), replace=False)
+            wt = np.zeros(len(wh), np.float32)
+            wt[idx] = 1.0
+            wtj = jnp.asarray(wt)
+            col, thr, nal, val = self._grow_random_tree(X, wtj, C, D, nodes, rng)
+            trees.append((col, thr, nal, val))
+            job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+        self._trees = self._finish_trees(trees, D)
+        self._psi = psi
+        # score training data to calibrate min/max path length (H2O exposes
+        # normalized score via observed min/max mean lengths)
+        ml = np.asarray(self._mean_length(X))[:n]
+        self._min_len, self._max_len = float(ml.min()), float(ml.max())
+        self._output.model_summary = {
+            "number_of_trees": ntrees, "max_depth": D, "sample_size": psi,
+        }
+
+    def _grow_random_tree(self, X, w, C, D, nodes, rng):
+        col_arr = np.full(nodes, -1, np.int32)
+        thr_arr = np.zeros(nodes, np.float32)
+        nal_arr = np.zeros(nodes, bool)
+        val_arr = np.zeros(nodes, np.float32)
+        leaf = jnp.zeros(X.shape[0], jnp.int32)
+        active = w > 0
+        import jax
+        for d in range(D):
+            L = 2 ** d
+            lv = jnp.where(active, leaf, L)
+            mn, mx = E.leaf_ranges(X, lv, L)
+            cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
+            mn_np = np.asarray(mn)
+            mx_np = np.asarray(mx)
+            cnt_np = np.asarray(cnt)
+            base = 2 ** d - 1
+            did = np.zeros(L, bool)
+            cols = np.zeros(L, np.int32)
+            thrs = np.zeros(L, np.float32)
+            for l in range(L):
+                # record path-length value in case this node terminalizes
+                val_arr[base + l] = d + _avg_path(cnt_np[l])
+                span = mx_np[l] - mn_np[l]
+                cand = np.nonzero(span > 0)[0]
+                if cnt_np[l] > 1 and len(cand) > 0 and d < D:
+                    c = int(rng.choice(cand))
+                    u = rng.random()
+                    cols[l] = c
+                    thrs[l] = mn_np[l, c] + u * span[c]
+                    did[l] = True
+            col_arr[base:base + L] = np.where(did, cols, -1)
+            thr_arr[base:base + L] = thrs
+            if not did.any():
+                break
+            leaf, active = E.apply_splits(
+                X, leaf, active, jnp.asarray(did), jnp.asarray(cols),
+                jnp.asarray(thrs), jnp.asarray(np.zeros(L, bool)))
+        # deepest level values
+        L = 2 ** D
+        import jax
+        lv = jnp.where(active, leaf, L)
+        cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
+        cnt_np = np.asarray(cnt)
+        for l in range(L):
+            val_arr[2 ** D - 1 + l] = D + _avg_path(cnt_np[l])
+        return col_arr, thr_arr, nal_arr, val_arr
+
+    # ---- scoring ---------------------------------------------------------
+    def _mean_length(self, X):
+        return E.predict_ensemble(X, self._trees) / self._trees.ntrees
+
+    def _score_matrix(self, X):
+        return self._mean_length(X)
+
+    def predict(self, test_data: Frame) -> Frame:
+        X = self._dinfo.matrix(test_data)
+        ml = np.asarray(self._mean_length(X))[: test_data.nrows].astype(np.float64)
+        span = max(self._max_len - self._min_len, 1e-12)
+        score = (self._max_len - ml) / span   # H2O's observed-range normalization
+        return Frame(["predict", "mean_length"],
+                     [Vec.from_numpy(score), Vec.from_numpy(ml)])

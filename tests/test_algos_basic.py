@@ -1,0 +1,278 @@
+"""Algorithm smoke + accuracy tests (mirrors testdir_algos pyunits: sanity on
+small data with sklearn-style reference checks computed inline)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.models
+from h2o3_tpu.core.frame import Frame
+
+
+def _make_blobs(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    c = rng.normal(0, 1, (3, 4)) * 6
+    X = np.concatenate([rng.normal(c[i], 1.0, (n // 3, 4)) for i in range(3)])
+    y = np.repeat(np.arange(3), n // 3)
+    return X, y
+
+
+def _make_binary(n=400, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 5))
+    logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] + 0.5 * X[:, 2]
+    p = 1 / (1 + np.exp(-logit))
+    y = (rng.random(n) < p).astype(int)
+    return X, y
+
+
+def _frame_xy(X, y, ylabels=None):
+    cols = {f"x{j}": X[:, j] for j in range(X.shape[1])}
+    if ylabels is not None:
+        cols["y"] = np.array([ylabels[i] for i in y], dtype=object)
+    else:
+        cols["y"] = y.astype(np.float64)
+    return Frame.from_dict(cols)
+
+
+# ---------------------------------------------------------------------------
+def test_kmeans_blobs():
+    X, _ = _make_blobs()
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+    km = h2o3_tpu.models.H2OKMeansEstimator(k=3, max_iterations=20, seed=42)
+    km.train(training_frame=f)
+    m = km._output.training_metrics
+    assert m.betweenss / m.totss > 0.8     # well-separated blobs
+    assert sorted(int(s) for s in m.size if s > 0) == [100, 100, 100]
+    p = km.predict(f)
+    assert p.nrows == 300
+
+
+def test_glm_gaussian_matches_ols():
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 1, (500, 3))
+    beta = np.array([2.0, -1.0, 0.5])
+    y = X @ beta + 1.5 + rng.normal(0, 0.01, 500)
+    f = _frame_xy(X, y)
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_=0.0, standardize=True)
+    glm.train(y="y", training_frame=f)
+    coefs = glm.coef()
+    np.testing.assert_allclose(
+        [coefs["x0"], coefs["x1"], coefs["x2"]], beta, atol=0.01)
+    np.testing.assert_allclose(coefs["Intercept"], 1.5, atol=0.01)
+    assert glm._output.training_metrics.r2 > 0.999
+
+
+def test_glm_binomial():
+    X, y = _make_binary()
+    f = _frame_xy(X, y, ylabels=["no", "yes"])
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="binomial", lambda_=0.0)
+    glm.train(y="y", training_frame=f)
+    m = glm._output.training_metrics
+    assert m.auc > 0.85
+    assert 0 < m.logloss < 0.5
+    pred = glm.predict(f)
+    assert set(pred.names) == {"predict", "pno", "pyes"}
+    probs = pred.vec("pyes").to_numpy()
+    assert probs.min() >= 0 and probs.max() <= 1
+
+
+def test_glm_l1_shrinks():
+    rng = np.random.default_rng(5)
+    X = rng.normal(0, 1, (300, 6))
+    y = 3 * X[:, 0] + rng.normal(0, 0.1, 300)   # only x0 matters
+    f = _frame_xy(X, y)
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="gaussian", alpha=1.0, lambda_=0.1)
+    glm.train(y="y", training_frame=f)
+    c = glm.coef()
+    assert abs(c["x0"]) > 1.0
+    zeroed = sum(1 for j in range(1, 6) if abs(c[f"x{j}"]) < 1e-6)
+    assert zeroed >= 4
+
+
+def test_glm_multinomial():
+    X, y = _make_blobs()
+    f = _frame_xy(X, y, ylabels=["a", "b", "c"])
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="multinomial", lambda_=0.0, max_iterations=20)
+    glm.train(y="y", training_frame=f)
+    m = glm._output.training_metrics
+    assert m.error < 0.05
+
+
+# ---------------------------------------------------------------------------
+def test_gbm_regression():
+    rng = np.random.default_rng(7)
+    X = rng.normal(0, 1, (500, 4))
+    y = np.sin(X[:, 0] * 2) * 3 + X[:, 1] ** 2
+    f = _frame_xy(X, y)
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=30, max_depth=4, learn_rate=0.3, min_rows=5, seed=1)
+    gbm.train(y="y", training_frame=f)
+    m = gbm._output.training_metrics
+    var = float(np.var(y))
+    assert m.mse < 0.25 * var
+    vi = gbm.varimp()
+    assert vi[0]["variable"] in ("x0", "x1")
+
+
+def test_gbm_bernoulli():
+    X, y = _make_binary()
+    f = _frame_xy(X, y, ylabels=["n", "p"])
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=30, max_depth=3, learn_rate=0.2, min_rows=5, seed=1)
+    gbm.train(y="y", training_frame=f)
+    m = gbm._output.training_metrics
+    assert gbm._dist == "bernoulli"
+    assert m.auc > 0.9
+    assert m.logloss < 0.45
+
+
+def test_gbm_multinomial():
+    X, y = _make_blobs()
+    f = _frame_xy(X, y, ylabels=["a", "b", "c"])
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=3, learn_rate=0.3, min_rows=5, seed=1)
+    gbm.train(y="y", training_frame=f)
+    assert gbm._output.training_metrics.error < 0.05
+
+
+def test_gbm_na_handling():
+    rng = np.random.default_rng(11)
+    X = rng.normal(0, 1, (400, 3))
+    y = (X[:, 0] > 0).astype(float) * 5 + rng.normal(0, 0.1, 400)
+    X[rng.random(400) < 0.2, 0] = np.nan     # NAs in the important column
+    f = _frame_xy(X, y)
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=20, max_depth=3, learn_rate=0.3, min_rows=5)
+    gbm.train(y="y", training_frame=f)
+    assert gbm._output.training_metrics.mse < 2.0
+
+
+def test_drf_binomial():
+    X, y = _make_binary()
+    f = _frame_xy(X, y, ylabels=["n", "p"])
+    drf = h2o3_tpu.models.H2ORandomForestEstimator(
+        ntrees=20, max_depth=10, min_rows=2, seed=3)
+    drf.train(y="y", training_frame=f)
+    assert drf._output.training_metrics.auc > 0.9
+
+
+def test_isolation_forest():
+    rng = np.random.default_rng(13)
+    X = rng.normal(0, 1, (500, 4))
+    X[:10] += 8.0                            # obvious outliers
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+    iso = h2o3_tpu.models.H2OIsolationForestEstimator(
+        ntrees=50, max_depth=8, seed=5)
+    iso.train(training_frame=f)
+    p = iso.predict(f)
+    scores = p.vec("predict").to_numpy()
+    # outliers should rank in the top tail
+    assert scores[:10].mean() > np.quantile(scores, 0.9)
+
+
+# ---------------------------------------------------------------------------
+def test_deeplearning_classification():
+    X, y = _make_blobs(n=300)
+    f = _frame_xy(X, y, ylabels=["a", "b", "c"])
+    dl = h2o3_tpu.models.H2ODeepLearningEstimator(
+        hidden=[32, 32], epochs=40, seed=1, mini_batch_size=64)
+    dl.train(y="y", training_frame=f)
+    assert dl._output.training_metrics.error < 0.1
+
+
+def test_deeplearning_autoencoder():
+    X, _ = _make_blobs(n=300)
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+    ae = h2o3_tpu.models.H2ODeepLearningEstimator(
+        hidden=[2], epochs=50, autoencoder=True, seed=1, mini_batch_size=64)
+    ae.train(training_frame=f)
+    an = ae.anomaly(f)
+    assert an.names == ["Reconstruction.MSE"]
+    assert an.vec("Reconstruction.MSE").mean() < 1.5
+
+
+def test_pca_variance():
+    rng = np.random.default_rng(17)
+    z = rng.normal(0, 1, (400, 2))
+    A = np.array([[3, 0.5, 1, 0.2], [0.5, 2, 0.1, 1]])
+    X = z @ A + rng.normal(0, 0.05, (400, 4))
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+    pca = h2o3_tpu.models.H2OPrincipalComponentAnalysisEstimator(
+        k=3, transform="DEMEAN")
+    pca.train(training_frame=f)
+    pv = pca._output.model_summary["proportion_of_variance"]
+    assert pv[0] + pv[1] > 0.99              # 2 latent dims explain ~all
+    s = pca.predict(f)
+    assert s.names == ["PC1", "PC2", "PC3"]
+
+
+def test_glrm_reconstruction():
+    rng = np.random.default_rng(19)
+    A = rng.normal(0, 1, (200, 2))
+    B = rng.normal(0, 1, (2, 6))
+    X = A @ B
+    X[rng.random(X.shape) < 0.1] = np.nan    # missing entries
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(6)})
+    glrm = h2o3_tpu.models.H2OGeneralizedLowRankEstimator(
+        k=2, max_iterations=100, seed=1)
+    glrm.train(training_frame=f)
+    rec = glrm.reconstruct(f).to_numpy()
+    obs = ~np.isnan(X)
+    err = np.nanmean((rec[obs] - X[obs]) ** 2)
+    assert err < 0.05
+
+
+def test_naive_bayes():
+    X, y = _make_blobs()
+    f = _frame_xy(X, y, ylabels=["a", "b", "c"])
+    nb = h2o3_tpu.models.H2ONaiveBayesEstimator()
+    nb.train(y="y", training_frame=f)
+    assert nb._output.training_metrics.error < 0.05
+
+
+# ---------------------------------------------------------------------------
+def test_cross_validation():
+    X, y = _make_binary(600)
+    f = _frame_xy(X, y, ylabels=["n", "p"])
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="binomial", lambda_=0.0, nfolds=3, seed=42,
+        keep_cross_validation_predictions=True)
+    glm.train(y="y", training_frame=f)
+    cvm = glm._output.cross_validation_metrics
+    assert cvm is not None and cvm.auc > 0.8
+    assert glm._output.cv_predictions_key is not None
+
+
+def test_validation_frame_and_weights():
+    X, y = _make_binary(500)
+    w = np.ones(500)
+    w[:50] = 0.0    # zero-weight rows must not affect metrics counts
+    cols = {f"x{j}": X[:, j] for j in range(5)}
+    cols["y"] = np.array(["p" if v else "n" for v in y], object)
+    cols["w"] = w
+    f = Frame.from_dict(cols)
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=3, weights_column="w", seed=1)
+    gbm.train(y="y", training_frame=f, validation_frame=f)
+    tm = gbm._output.training_metrics
+    vm = gbm._output.validation_metrics
+    assert tm.nobs == 450
+    assert vm.auc > 0.8
+
+
+def test_predict_domain_adaptation():
+    # test frame with extra level and different level order
+    tr = Frame.from_dict({"x": [1.0, 2.0, 3.0, 4.0] * 25,
+                          "c": np.array(["a", "b"] * 50, object),
+                          "y": np.arange(100).astype(np.float64)})
+    te = Frame.from_dict({"x": [1.0, 2.0], "c": np.array(["b", "zz"], object)})
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(family="gaussian",
+                                                        lambda_=0.0)
+    glm.train(y="y", training_frame=tr)
+    p = glm.predict(te)
+    assert p.nrows == 2 and np.isfinite(p.vec("predict").to_numpy()).all()
