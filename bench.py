@@ -1,0 +1,71 @@
+"""Headline benchmark: GBM (bernoulli) training throughput on HIGGS-like data.
+
+BASELINE.json metric: "HIGGS + airlines-1B GBM wall-clock vs H100 gpu_hist".
+The reference publishes no absolute number ("published": {}); the comparison
+point used here is XGBoost `gpu_hist` on HIGGS-class data on one H100:
+~11M rows × 28 features × 500 trees (depth 8) in ≈35 s ≈ 157M row·trees/s.
+We report sustained row·trees/s of the TPU histogram tree engine and
+vs_baseline = throughput / 157e6 (>1.0 beats the H100 reference point).
+
+Prints ONE JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import h2o3_tpu
+    from h2o3_tpu.models.tree import engine as E
+    from h2o3_tpu.models.tree.shared_tree import _grad_hess
+
+    h2o3_tpu.init()
+    N, C = 1_000_000, 28
+    DEPTH, NBINS, NTREES = 8, 64, 20
+    rng = np.random.default_rng(0)
+    Xh = rng.normal(0, 1, (N, C)).astype(np.float32)
+    wgt = 1.5 * Xh[:, 0] - Xh[:, 1] + 0.5 * Xh[:, 2] * Xh[:, 3]
+    yh = (rng.random(N) < 1 / (1 + np.exp(-wgt))).astype(np.float32)
+
+    from h2o3_tpu.parallel import mrtask as mr
+    X = mr.device_put_rows(Xh)
+    y = mr.device_put_rows(yh)
+    w = jnp.ones(N, jnp.float32)
+
+    grower = E.TreeGrower(nbins=NBINS, max_depth=DEPTH, min_rows=10,
+                          min_split_improvement=1e-5)
+    F = jnp.zeros(N, jnp.float32)
+
+    def one_tree(F):
+        res, hess = _grad_hess("bernoulli", F, y)
+        col, thr, nal, val, _ = grower.grow(X, w, res)
+        ta = E.TreeArrays(col=col[None], thr=thr[None], na_left=nal[None],
+                          value=val[None], depth=DEPTH)
+        return F + 0.1 * E.predict_ensemble(X, ta)
+
+    # warmup: compile every per-level kernel
+    F = one_tree(F)
+    jax.block_until_ready(F)
+    t0 = time.time()
+    for _ in range(NTREES):
+        F = one_tree(F)
+    jax.block_until_ready(F)
+    dt = time.time() - t0
+
+    throughput = N * NTREES / dt
+    baseline = 157e6  # H100 gpu_hist row·trees/s reference point (see header)
+    print(json.dumps({
+        "metric": "gbm_hist_row_trees_per_sec",
+        "value": round(throughput),
+        "unit": "row*trees/s",
+        "vs_baseline": round(throughput / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

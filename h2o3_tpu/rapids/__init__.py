@@ -1,0 +1,1 @@
+from h2o3_tpu.rapids.rapids import rapids_exec, Session

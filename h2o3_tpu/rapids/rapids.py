@@ -1,0 +1,1080 @@
+"""Rapids — the Lisp-ish dataframe expression language (water/rapids/).
+
+Reference: water/rapids/Rapids.java (parser), Session.java (temp-frame
+ref-counting per client session), ast/AstExec.java (apply), ast/prims/** (207
+primitive ASTs: operators, reducers, mungers incl. merge/sort/groupby, math,
+string, time ops). Python/R clients compile every dataframe expression to this
+grammar and POST it to /99/Rapids — implementing the same grammar here is what
+makes the client surface work.
+
+Grammar (Rapids.java:24-38):
+  expr  := (op args…) | number | "str" | 'str' | id | %id | [num…] | {args . body}
+Assignments: (tmp= key expr), (rm key).
+
+TPU-native evaluation: element-wise ops and reducers run as fused jits over
+the sharded column arrays; order-based mungers (sort/merge/group-by) factorize
+keys on the controller and use device segment ops where profitable, host
+numpy otherwise. Strings are host-side (see frame.py design note).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec, T_CAT, T_NUM, T_STR, T_TIME
+from h2o3_tpu.core.kvstore import DKV
+
+
+# ===========================================================================
+# Parser (Rapids.java)
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def peek(self):
+        while self.i < len(self.s) and self.s[self.i].isspace():
+            self.i += 1
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def parse(self):
+        c = self.peek()
+        if c == "(":
+            return self._list(")", "(")
+        if c == "[":
+            return self._numlist()
+        if c == "{":
+            return self._fun()
+        if c in "\"'":
+            return self._string(c)
+        return self._token()
+
+    def _list(self, close, open_):
+        self.i += 1
+        out = []
+        while self.peek() != close:
+            if not self.peek():
+                raise ValueError("unterminated expression")
+            out.append(self.parse())
+        self.i += 1
+        return out
+
+    def _numlist(self):
+        self.i += 1
+        out = []
+        while self.peek() != "]":
+            tok = self._token()
+            if isinstance(tok, str) and ":" in tok:   # a:b span
+                a, b = tok.split(":")
+                out.append(("span", float(a), float(b)))
+            else:
+                out.append(tok)
+        self.i += 1
+        return ("numlist", out)
+
+    def _fun(self):
+        self.i += 1
+        parts = []
+        while self.peek() != "}":
+            parts.append(self.parse())
+        self.i += 1
+        # {arg1 arg2 . body}
+        if "." in parts:
+            dot = parts.index(".")
+            return ("lambda", parts[:dot], parts[dot + 1])
+        return ("lambda", parts[:-1], parts[-1])
+
+    def _string(self, q):
+        self.i += 1
+        start = self.i
+        out = []
+        while self.s[self.i] != q:
+            ch = self.s[self.i]
+            if ch == "\\":
+                self.i += 1
+                ch = self.s[self.i]
+            out.append(ch)
+            self.i += 1
+        self.i += 1
+        return ("str", "".join(out))
+
+    def _token(self):
+        self.peek()
+        start = self.i
+        while self.i < len(self.s) and not self.s[self.i].isspace() \
+                and self.s[self.i] not in "()[]{}\"'":
+            self.i += 1
+        tok = self.s[start:self.i]
+        if tok in ("True", "TRUE", "true"):
+            return 1.0
+        if tok in ("False", "FALSE", "false"):
+            return 0.0
+        if tok in ("NA", "NaN", "nan"):
+            return float("nan")
+        try:
+            return float(tok)
+        except ValueError:
+            return tok
+
+
+def parse(expr: str):
+    return _Parser(expr).parse()
+
+
+# ===========================================================================
+class Session:
+    """Per-client session: tracks temp frames for GC (rapids/Session.java)."""
+
+    def __init__(self, session_id: str = "default"):
+        self.id = session_id
+        self.tmps: set = set()
+
+    def register(self, key: str):
+        self.tmps.add(key)
+
+    def end(self):
+        for k in self.tmps:
+            DKV.remove(k)
+        self.tmps.clear()
+
+
+_default_session = Session()
+
+
+# ===========================================================================
+# Evaluation
+class Env:
+    def __init__(self, session: Session):
+        self.session = session
+        self.locals: dict = {}
+
+
+def rapids_exec(expr: str, session: Optional[Session] = None):
+    """Rapids.exec: parse + evaluate; returns float | str | Frame | list."""
+    session = session or _default_session
+    ast = parse(expr)
+    return _eval(ast, Env(session))
+
+
+def _eval(ast, env: Env):
+    if isinstance(ast, float):
+        return ast
+    if isinstance(ast, tuple):
+        if ast[0] == "str":
+            return ast[1]
+        if ast[0] == "numlist":
+            return _expand_numlist(ast[1])
+        if ast[0] == "lambda":
+            return ast
+        if ast[0] == "span":
+            return list(np.arange(ast[1], ast[2] + 1))
+    if isinstance(ast, str):
+        if ast in env.locals:
+            return env.locals[ast]
+        obj = DKV.get(ast)
+        if obj is not None:
+            return obj
+        return ast  # bare symbol (e.g. column name)
+    if isinstance(ast, list):
+        op = ast[0]
+        if isinstance(op, (tuple, list)):
+            op = _eval(op, env)
+        if isinstance(op, tuple) and op[0] == "lambda":
+            return _apply_lambda(op, [_eval(a, env) for a in ast[1:]], env)
+        fn = PRIMS.get(op)
+        if fn is None:
+            raise ValueError(f"unknown Rapids op: {op!r}")
+        return fn(ast[1:], env)
+    raise ValueError(f"cannot evaluate {ast!r}")
+
+
+def _expand_numlist(items):
+    out = []
+    for it in items:
+        if isinstance(it, tuple) and it[0] == "span":
+            out.extend(np.arange(it[1], it[2] + 1).tolist())
+        else:
+            out.append(it)
+    return out
+
+
+def _apply_lambda(lam, args, env: Env):
+    _, params, body = lam
+    sub = Env(env.session)
+    sub.locals = dict(env.locals)
+    for p, a in zip(params, args):
+        sub.locals[p] = a
+    return _eval(body, sub)
+
+
+# ===========================================================================
+# helpers
+def _as_frame(v) -> Frame:
+    if isinstance(v, Frame):
+        return v
+    if isinstance(v, (int, float)):
+        return Frame(["C1"], [Vec.from_numpy(np.array([float(v)]))])
+    raise TypeError(f"expected frame, got {type(v)}")
+
+
+def _numeric_cols(f: Frame):
+    return [n for n, v in zip(f.names, f.vecs) if v.type != T_STR]
+
+
+def _col_np(f: Frame, j=0) -> np.ndarray:
+    return f.vecs[j].to_numpy()
+
+
+def _new_frame(names, arrays, types=None, domains=None) -> Frame:
+    vecs = []
+    for i, a in enumerate(arrays):
+        t = (types or {}).get(i) if isinstance(types, dict) else None
+        d = (domains or {}).get(i) if isinstance(domains, dict) else None
+        if a.dtype == object:
+            vecs.append(Vec.from_numpy(a, type=t or T_STR))
+        elif d is not None:
+            mask = np.isnan(a)
+            vecs.append(Vec._from_floats(np.where(mask, 0, a), mask, T_CAT,
+                                         np.asarray(d, object)))
+        else:
+            vecs.append(Vec.from_numpy(a))
+    return Frame(list(names), vecs)
+
+
+def _broadcast_op(args, env, fn, str_ok=False):
+    """Element-wise binary op over frame/scalar combinations — fused jit."""
+    a = _eval(args[0], env)
+    b = _eval(args[1], env)
+    fa, fb = isinstance(a, Frame), isinstance(b, Frame)
+    if not fa and not fb:
+        return float(fn(jnp.float32(a), jnp.float32(b)))
+    base = a if fa else b
+    names = base.names
+
+    def get(x):
+        if isinstance(x, Frame):
+            return x.matrix(_numeric_cols(x))
+        return jnp.float32(x)
+
+    A, B = get(a), get(b)
+    out = jax.jit(fn)(A, B)
+    out_np = np.asarray(out, np.float64)[: base.nrows]
+    return _new_frame(names, [out_np[:, j] for j in range(out_np.shape[1])])
+
+
+def _unary_op(args, env, fn):
+    a = _eval(args[0], env)
+    if not isinstance(a, Frame):
+        return float(fn(jnp.float32(a)))
+    A = a.matrix(_numeric_cols(a))
+    out = np.asarray(jax.jit(fn)(A), np.float64)[: a.nrows]
+    return _new_frame(a.names, [out[:, j] for j in range(out.shape[1])])
+
+
+def _reduce_op(args, env, fn, na_rm_idx=None):
+    """Whole-frame reducer via one fused jit (NaN-aware)."""
+    a = _eval(args[0], env)
+    na_rm = bool(_eval(args[na_rm_idx], env)) if na_rm_idx is not None and \
+        len(args) > na_rm_idx else True
+    A = a.matrix(_numeric_cols(a))
+    n = a.nrows
+
+    @jax.jit
+    def red(A):
+        idx = jnp.arange(A.shape[0])[:, None]
+        live = idx < n
+        return fn(A, live)
+
+    return float(red(A))
+
+
+# ===========================================================================
+# Primitive registry  (ast/prims/**)
+PRIMS: dict = {}
+
+
+def prim(*names):
+    def deco(fn):
+        for n in names:
+            PRIMS[n] = fn
+        return fn
+    return deco
+
+
+# ---- operators (prims/operators) ------------------------------------------
+@prim("+")
+def _add(a, e): return _broadcast_op(a, e, lambda x, y: x + y)
+
+
+@prim("-")
+def _sub(a, e): return _broadcast_op(a, e, lambda x, y: x - y)
+
+
+@prim("*")
+def _mul(a, e): return _broadcast_op(a, e, lambda x, y: x * y)
+
+
+@prim("/")
+def _div(a, e): return _broadcast_op(a, e, lambda x, y: x / y)
+
+
+@prim("^", "**")
+def _pow(a, e): return _broadcast_op(a, e, lambda x, y: jnp.power(x, y))
+
+
+@prim("%", "mod")
+def _mod(a, e): return _broadcast_op(a, e, lambda x, y: jnp.mod(x, y))
+
+
+@prim("intDiv", "%/%")
+def _intdiv(a, e): return _broadcast_op(a, e, lambda x, y: jnp.floor_divide(x, y))
+
+
+def _cmp(fn):
+    return lambda a, e: _broadcast_op(a, e,
+                                      lambda x, y: fn(x, y).astype(jnp.float32))
+
+
+PRIMS["=="] = _cmp(lambda x, y: x == y)
+PRIMS["!="] = _cmp(lambda x, y: x != y)
+PRIMS[">"] = _cmp(lambda x, y: x > y)
+PRIMS[">="] = _cmp(lambda x, y: x >= y)
+PRIMS["<"] = _cmp(lambda x, y: x < y)
+PRIMS["<="] = _cmp(lambda x, y: x <= y)
+PRIMS["&"] = _cmp(lambda x, y: (x != 0) & (y != 0))
+PRIMS["|"] = _cmp(lambda x, y: (x != 0) | (y != 0))
+PRIMS["&&"] = PRIMS["&"]
+PRIMS["||"] = PRIMS["|"]
+
+
+@prim("!", "not")
+def _not(a, e):
+    return _unary_op(a, e, lambda x: (x == 0).astype(jnp.float32))
+
+
+# ---- math (prims/math) -----------------------------------------------------
+_MATH = {
+    "abs": jnp.abs, "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sqrt": jnp.sqrt, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "floor": jnp.floor, "ceiling": jnp.ceil, "trunc": jnp.trunc,
+    "sign": jnp.sign, "gamma": jax.scipy.special.gammaln,
+}
+for name, f in _MATH.items():
+    PRIMS[name] = (lambda ff: lambda a, e: _unary_op(a, e, ff))(f)
+
+
+@prim("round")
+def _round(a, e):
+    digits = int(_eval(a[1], e)) if len(a) > 1 else 0
+    m = 10.0 ** digits
+    return _unary_op(a[:1], e, lambda x: jnp.round(x * m) / m)
+
+
+@prim("signif")
+def _signif(a, e):
+    digits = int(_eval(a[1], e)) if len(a) > 1 else 6
+
+    def f(x):
+        mag = jnp.power(10.0, digits - 1 - jnp.floor(jnp.log10(jnp.abs(x))))
+        return jnp.where(x == 0, 0.0, jnp.round(x * mag) / mag)
+    return _unary_op(a[:1], e, f)
+
+
+# ---- reducers (prims/reducers) --------------------------------------------
+@prim("sum")
+def _sum(a, e):
+    return _reduce_op(a, e, lambda A, live: jnp.where(
+        jnp.isnan(A) | ~live, 0.0, A).sum())
+
+
+@prim("mean")
+def _mean(a, e):
+    def f(A, live):
+        ok = ~jnp.isnan(A) & live
+        return jnp.where(ok, A, 0.0).sum() / jnp.maximum(ok.sum(), 1)
+    return _reduce_op(a, e, f)
+
+
+@prim("min")
+def _min(a, e):
+    return _reduce_op(a, e, lambda A, live: jnp.where(
+        jnp.isnan(A) | ~live, jnp.inf, A).min())
+
+
+@prim("max")
+def _max(a, e):
+    return _reduce_op(a, e, lambda A, live: jnp.where(
+        jnp.isnan(A) | ~live, -jnp.inf, A).max())
+
+
+@prim("sd")
+def _sd(a, e):
+    f = _eval(a[0], e)
+    return float(f.vecs[0].sigma())
+
+
+@prim("var")
+def _var(a, e):
+    f = _eval(a[0], e)
+    return float(f.vecs[0].sigma()) ** 2
+
+
+@prim("median")
+def _median(a, e):
+    f = _eval(a[0], e)
+    return float(np.nanmedian(_col_np(f)))
+
+
+@prim("prod")
+def _prod(a, e):
+    return _reduce_op(a, e, lambda A, live: jnp.where(
+        jnp.isnan(A) | ~live, 1.0, A).prod())
+
+
+@prim("all")
+def _all(a, e):
+    return _reduce_op(a, e, lambda A, live: (
+        jnp.where(live, A != 0, True)).all().astype(jnp.float32))
+
+
+@prim("any")
+def _any(a, e):
+    return _reduce_op(a, e, lambda A, live: (
+        jnp.where(live, A != 0, False)).any().astype(jnp.float32))
+
+
+@prim("cumsum", "cumprod", "cummin", "cummax")
+def _cumulative(a, e):
+    raise NotImplementedError  # replaced below per-op
+
+
+def _make_cum(npfn):
+    def f(a, e):
+        fr = _eval(a[0], e)
+        col = _col_np(fr)
+        return _new_frame(fr.names[:1], [npfn(col)])
+    return f
+
+
+PRIMS["cumsum"] = _make_cum(np.cumsum)
+PRIMS["cumprod"] = _make_cum(np.cumprod)
+PRIMS["cummin"] = _make_cum(np.minimum.accumulate)
+PRIMS["cummax"] = _make_cum(np.maximum.accumulate)
+
+
+# ---- frame structure (prims/mungers) ---------------------------------------
+@prim("nrow")
+def _nrow(a, e): return float(_eval(a[0], e).nrows)
+
+
+@prim("ncol")
+def _ncol(a, e): return float(_eval(a[0], e).ncols)
+
+
+@prim("colnames", "names")
+def _colnames(a, e): return list(_eval(a[0], e).names)
+
+
+@prim("cols", "cols_py")
+def _cols(a, e):
+    f = _eval(a[0], e)
+    sel = _eval(a[1], e)
+    if isinstance(sel, str):
+        return f[[sel]]
+    if isinstance(sel, float):
+        sel = [sel]
+    if isinstance(sel, list):
+        if sel and isinstance(sel[0], str):
+            return f[[s for s in sel]]
+        idx = [int(s) for s in sel]
+        if idx and idx[0] < 0:   # negative = drop
+            keep = [i for i in range(f.ncols) if -(i + 1) not in idx and i not in [-(j + 1) for j in idx]]
+            keep = [i for i in range(f.ncols) if i not in [-j - 1 for j in idx]]
+            return f[keep]
+        return f[idx]
+    raise ValueError(sel)
+
+
+@prim("rows")
+def _rows(a, e):
+    f = _eval(a[0], e)
+    sel = _eval(a[1], e)
+    if isinstance(sel, Frame):  # boolean mask frame
+        mask = _col_np(sel) != 0
+        idx = np.nonzero(mask[: f.nrows])[0]
+    elif isinstance(sel, list):
+        idx = np.array([int(s) for s in sel])
+        if len(idx) and idx[0] < 0:
+            drop = set((-idx - 1).tolist())
+            idx = np.array([i for i in range(f.nrows) if i not in drop])
+    else:
+        idx = np.array([int(sel)])
+    return _take_rows(f, idx)
+
+
+def _take_rows(f: Frame, idx: np.ndarray) -> Frame:
+    names, vecs = [], []
+    for c, v in zip(f.names, f.vecs):
+        if v.type == T_STR:
+            vecs.append(Vec.from_numpy(v.host_data[idx], type=T_STR))
+        else:
+            col = v.to_numpy()[idx]
+            mask = np.isnan(col)
+            vecs.append(Vec._from_floats(np.where(mask, 0, col), mask,
+                                         v.type, v.domain))
+        names.append(c)
+    return Frame(names, vecs)
+
+
+@prim("cbind")
+def _cbind(a, e):
+    frames = [_as_frame(_eval(x, e)) for x in a]
+    names, vecs = [], []
+    seen = set()
+    for f in frames:
+        for n, v in zip(f.names, f.vecs):
+            nn = n
+            k = 0
+            while nn in seen:
+                k += 1
+                nn = f"{n}{k}"
+            seen.add(nn)
+            names.append(nn)
+            vecs.append(v)
+    return Frame(names, vecs)
+
+
+@prim("rbind")
+def _rbind(a, e):
+    frames = [_as_frame(_eval(x, e)) for x in a]
+    base = frames[0]
+    names, vecs = [], []
+    for j, c in enumerate(base.names):
+        vts = [f.vecs[j] for f in frames]
+        if vts[0].type == T_STR:
+            data = np.concatenate([v.host_data for v in vts])
+            vecs.append(Vec.from_numpy(data, type=T_STR))
+        elif vts[0].type == T_CAT:
+            # merge domains (ParseDataset categorical merge)
+            dom = sorted({l for v in vts for l in (v.levels() or [])})
+            lut = {l: i for i, l in enumerate(dom)}
+            cols = []
+            for v in vts:
+                c_np = v.to_numpy()
+                vdom = v.levels()
+                cols.append(np.array([np.nan if math.isnan(x)
+                                      else lut[vdom[int(x)]] for x in c_np]))
+            col = np.concatenate(cols)
+            mask = np.isnan(col)
+            vecs.append(Vec._from_floats(np.where(mask, 0, col), mask, T_CAT,
+                                         np.asarray(dom, object)))
+        else:
+            col = np.concatenate([v.to_numpy() for v in vts])
+            mask = np.isnan(col)
+            vecs.append(Vec._from_floats(np.where(mask, 0, col), mask,
+                                         vts[0].type))
+        names.append(c)
+    return Frame(names, vecs)
+
+
+@prim("setnames", "colnames=")
+def _setnames(a, e):
+    f = _eval(a[0], e)
+    idx = _eval(a[1], e)
+    names = _eval(a[2], e)
+    if not isinstance(idx, list):
+        idx = [idx]
+    if not isinstance(names, list):
+        names = [names]
+    for i, n in zip(idx, names):
+        f.names[int(i)] = n if isinstance(n, str) else str(n)
+    f._matrix_cache.clear()
+    return f
+
+
+@prim("tmp=")
+def _assign(a, e):
+    key = a[0]
+    val = _eval(a[1], e)
+    if isinstance(val, Frame):
+        DKV.remove(val.key)
+        val.key = key
+    DKV.put(key, val)
+    e.session.register(key)
+    return val
+
+
+@prim("rm")
+def _rm(a, e):
+    DKV.remove(a[0] if isinstance(a[0], str) else _eval(a[0], e))
+    return 0.0
+
+
+@prim(":=")
+def _colassign(a, e):
+    """(:= frame rhs col_idx row_idx) — update columns in place."""
+    f = _eval(a[0], e)
+    rhs = _eval(a[1], e)
+    cols = _eval(a[2], e)
+    if isinstance(cols, float):
+        cols = [cols]
+    for k, ci in enumerate(int(c) for c in cols):
+        if ci >= f.ncols:
+            name = f"C{ci+1}"
+        else:
+            name = f.names[ci]
+        if isinstance(rhs, Frame):
+            f[name] = rhs.vecs[min(k, rhs.ncols - 1)]
+        else:
+            f[name] = np.full(f.nrows, float(rhs))
+    return f
+
+
+@prim("is.na")
+def _isna(a, e):
+    return _unary_op(a, e, lambda x: jnp.isnan(x).astype(jnp.float32))
+
+
+@prim("ifelse")
+def _ifelse(a, e):
+    def f(c, x, y):
+        return jnp.where(c != 0, x, y)
+    c = _eval(a[0], e)
+    x = _eval(a[1], e)
+    y = _eval(a[2], e)
+    if not isinstance(c, Frame):
+        return x if c else y
+    C = c.matrix(_numeric_cols(c))
+    X = x.matrix(_numeric_cols(x)) if isinstance(x, Frame) else jnp.float32(x)
+    Y = y.matrix(_numeric_cols(y)) if isinstance(y, Frame) else jnp.float32(y)
+    out = np.asarray(jax.jit(f)(C, X, Y), np.float64)[: c.nrows]
+    return _new_frame(c.names, [out[:, j] for j in range(out.shape[1])])
+
+
+@prim("h2o.which")
+def _which(a, e):
+    f = _eval(a[0], e)
+    idx = np.nonzero(_col_np(f) != 0)[0].astype(np.float64)
+    return _new_frame(["which"], [idx])
+
+
+@prim("na.omit")
+def _naomit(a, e):
+    f = _eval(a[0], e)
+    m = f.to_numpy()
+    keep = ~np.isnan(m).any(axis=1)
+    return _take_rows(f, np.nonzero(keep)[0])
+
+
+@prim("unique")
+def _unique(a, e):
+    f = _eval(a[0], e)
+    v = f.vecs[0]
+    col = _col_np(f)
+    u = np.unique(col[~np.isnan(col)])
+    if v.type == T_CAT:
+        dom = v.levels()
+        mask = np.zeros(len(u), bool)
+        return _new_frame(f.names[:1], [u], domains={0: dom})
+    return _new_frame(f.names[:1], [u])
+
+
+@prim("table")
+def _table(a, e):
+    f = _eval(a[0], e)
+    col = _col_np(f)
+    v = f.vecs[0]
+    vals, cnts = np.unique(col[~np.isnan(col)], return_counts=True)
+    if v.type == T_CAT:
+        dom = v.levels()
+        labels = np.array([dom[int(x)] for x in vals], object)
+        return _new_frame([f.names[0], "Count"],
+                          [labels, cnts.astype(np.float64)])
+    return _new_frame([f.names[0], "Count"],
+                      [vals, cnts.astype(np.float64)])
+
+
+# ---- type coercion ---------------------------------------------------------
+@prim("as.factor", "asfactor")
+def _asfactor(a, e):
+    f = _eval(a[0], e)
+    v = f.vecs[0]
+    if v.type == T_CAT:
+        return f
+    col = v.to_numpy()
+    if v.type == T_STR:
+        return _new_frame(f.names[:1], [v.host_data])  # re-ingest as enum
+    mask = np.isnan(col)
+    uniq = np.unique(col[~mask])
+    lut = {x: i for i, x in enumerate(uniq)}
+    codes = np.array([np.nan if m else lut[x] for x, m in zip(col, mask)])
+    dom = [("%g" % x) for x in uniq]
+    return _new_frame(f.names[:1], [codes], domains={0: dom})
+
+
+@prim("as.numeric", "asnumeric")
+def _asnumeric(a, e):
+    f = _eval(a[0], e)
+    v = f.vecs[0]
+    if v.type == T_CAT:
+        col = v.to_numpy()
+        dom = v.levels()
+        try:
+            vals = np.array([float(d) for d in dom])
+            out = np.array([np.nan if math.isnan(c) else vals[int(c)]
+                            for c in col])
+        except ValueError:
+            out = col
+        return _new_frame(f.names[:1], [out])
+    return _new_frame(f.names[:1], [v.to_numpy()])
+
+
+@prim("as.character", "ascharacter")
+def _aschar(a, e):
+    f = _eval(a[0], e)
+    v = f.vecs[0]
+    if v.type == T_CAT:
+        dom = v.levels()
+        col = v.to_numpy()
+        out = np.array([None if math.isnan(c) else dom[int(c)] for c in col],
+                       object)
+    else:
+        out = np.array(["%g" % x if not math.isnan(x) else None
+                        for x in v.to_numpy()], object)
+    return _new_frame(f.names[:1], [out])
+
+
+@prim("levels")
+def _levels(a, e):
+    f = _eval(a[0], e)
+    return f.vecs[0].levels() or []
+
+
+# ---- sort / merge / group-by (prims/mungers radix family) ------------------
+@prim("sort")
+def _sort(a, e):
+    f = _eval(a[0], e)
+    by = _eval(a[1], e)
+    asc = _eval(a[2], e) if len(a) > 2 else [1.0] * 99
+    if not isinstance(by, list):
+        by = [by]
+    cols = [int(b) if isinstance(b, float) else f.col_idx(b) for b in by]
+    keys = []
+    for k, ci in enumerate(reversed(cols)):
+        colv = f.vecs[ci].to_numpy()
+        ascending = bool(asc[len(cols) - 1 - k]) if isinstance(asc, list) else True
+        keys.append(colv if ascending else -colv)
+    order = np.lexsort(keys)
+    return _take_rows(f, order)
+
+
+@prim("merge")
+def _merge(a, e):
+    """(merge left right all_left all_right by_left by_right method)"""
+    lf = _eval(a[0], e)
+    rf = _eval(a[1], e)
+    all_l = bool(_eval(a[2], e)) if len(a) > 2 else False
+    all_r = bool(_eval(a[3], e)) if len(a) > 3 else False
+    by_l = _eval(a[4], e) if len(a) > 4 else []
+    by_r = _eval(a[5], e) if len(a) > 5 else []
+    if not by_l:
+        common = [c for c in lf.names if c in rf.names]
+        by_l = [lf.col_idx(c) for c in common]
+        by_r = [rf.col_idx(c) for c in common]
+    by_l = [int(x) for x in (by_l if isinstance(by_l, list) else [by_l])]
+    by_r = [int(x) for x in (by_r if isinstance(by_r, list) else [by_r])]
+    ldf = lf.as_data_frame()
+    rdf = rf.as_data_frame()
+    lkeys = [lf.names[i] for i in by_l]
+    rkeys = [rf.names[i] for i in by_r]
+    how = "outer" if (all_l and all_r) else \
+        "left" if all_l else "right" if all_r else "inner"
+    out = ldf.merge(rdf, left_on=lkeys, right_on=rkeys, how=how)
+    return Frame.from_pandas(out)
+
+
+@prim("GB", "group_by")
+def _groupby(a, e):
+    """(GB frame [by…] agg_col agg_fn na_handling …) — AstGroup."""
+    f = _eval(a[0], e)
+    by = _eval(a[1], e)
+    by = [int(b) for b in (by if isinstance(by, list) else [by])]
+    aggs = []
+    i = 2
+    rest = a[2:]
+    while i + 2 < len(a) + 1 and i + 2 <= len(a):
+        fn_name = _eval(a[i], e)
+        col = int(_eval(a[i + 1], e))
+        na = _eval(a[i + 2], e) if i + 2 < len(a) else "rm"
+        aggs.append((fn_name, col, na))
+        i += 3
+    key_cols = [f.vecs[j].to_numpy() for j in by]
+    key_tup = list(zip(*key_cols)) if key_cols else []
+    uniq = sorted(set(key_tup))
+    index = {k: i for i, k in enumerate(uniq)}
+    gid = np.array([index[k] for k in key_tup])
+    out_names = [f.names[j] for j in by]
+    out_cols = []
+    for kd, j in enumerate(by):
+        vals = np.array([u[kd] for u in uniq])
+        out_cols.append(vals)
+    fns = {"sum": np.nansum, "mean": np.nanmean, "min": np.nanmin,
+           "max": np.nanmax, "sd": lambda x: np.nanstd(x, ddof=1),
+           "var": lambda x: np.nanvar(x, ddof=1), "median": np.nanmedian,
+           "nrow": len, "count": len, "mode": lambda x: float(
+               np.bincount(x[~np.isnan(x)].astype(int)).argmax())}
+    for fn_name, cj, _na in aggs:
+        colv = f.vecs[cj].to_numpy()
+        fn = fns[fn_name]
+        vals = np.array([fn(colv[gid == g]) for g in range(len(uniq))],
+                        np.float64)
+        out_names.append(f"{fn_name}_{f.names[cj]}")
+        out_cols.append(vals)
+    doms = {}
+    for kd, j in enumerate(by):
+        if f.vecs[j].type == T_CAT:
+            doms[kd] = f.vecs[j].levels()
+    return _new_frame(out_names, out_cols, domains=doms)
+
+
+@prim("quantile")
+def _quantile(a, e):
+    f = _eval(a[0], e)
+    probs = _eval(a[1], e)
+    probs = probs if isinstance(probs, list) else [probs]
+    cols = _numeric_cols(f)
+    out_cols = [np.asarray(probs, np.float64)]
+    names = ["Probs"]
+    for c in cols:
+        col = f.vec(c).to_numpy()
+        out_cols.append(np.nanquantile(col, probs))
+        names.append(c)
+    return _new_frame(names, out_cols)
+
+
+@prim("h2o.impute")
+def _impute(a, e):
+    f = _eval(a[0], e)
+    col = int(_eval(a[1], e))
+    method = _eval(a[2], e) if len(a) > 2 else "mean"
+    v = f.vecs[col]
+    x = v.to_numpy()
+    if method == "median":
+        fill = float(np.nanmedian(x))
+    elif method == "mode":
+        vals, cnt = np.unique(x[~np.isnan(x)], return_counts=True)
+        fill = float(vals[cnt.argmax()])
+    else:
+        fill = float(np.nanmean(x))
+    x = np.where(np.isnan(x), fill, x)
+    f[f.names[col]] = Vec._from_floats(x, np.zeros(len(x), bool), v.type,
+                                       v.domain)
+    return f
+
+
+# ---- string ops (prims/string) --------------------------------------------
+def _str_map(args, env, fn):
+    f = _eval(args[0], env)
+    v = f.vecs[0]
+    if v.type == T_STR:
+        data = v.host_data
+        out = np.array([None if s is None else fn(s) for s in data], object)
+        return _new_frame(f.names[:1], [out])
+    if v.type == T_CAT:
+        dom = [fn(d) for d in v.levels()]
+        col = v.to_numpy()
+        mask = np.isnan(col)
+        return _new_frame(f.names[:1], [col], domains={0: dom})
+    raise TypeError("string op on numeric column")
+
+
+@prim("toupper")
+def _toupper(a, e): return _str_map(a, e, str.upper)
+
+
+@prim("tolower")
+def _tolower(a, e): return _str_map(a, e, str.lower)
+
+
+@prim("trim")
+def _trim(a, e): return _str_map(a, e, str.strip)
+
+
+@prim("nchar", "strlen", "length")
+def _nchar(a, e):
+    f = _eval(a[0], e)
+    v = f.vecs[0]
+    if v.type == T_STR:
+        out = np.array([np.nan if s is None else float(len(s))
+                        for s in v.host_data])
+    else:
+        dom = v.levels()
+        col = v.to_numpy()
+        out = np.array([np.nan if math.isnan(c) else float(len(dom[int(c)]))
+                        for c in col])
+    return _new_frame(f.names[:1], [out])
+
+
+@prim("replaceall", "gsub")
+def _gsub(a, e):
+    pat = _eval(a[0], e)
+    rep = _eval(a[1], e)
+    rest = a[2:]
+    return _str_map(rest, e, lambda s: re.sub(pat, rep, s))
+
+
+@prim("replacefirst", "sub")
+def _sub_str(a, e):
+    pat = _eval(a[0], e)
+    rep = _eval(a[1], e)
+    return _str_map(a[2:], e, lambda s: re.sub(pat, rep, s, count=1))
+
+
+@prim("substring")
+def _substring(a, e):
+    f_args = a[:1]
+    start = int(_eval(a[1], e))
+    end = int(_eval(a[2], e)) if len(a) > 2 else None
+    return _str_map(f_args, e, lambda s: s[start:end])
+
+
+@prim("strsplit")
+def _strsplit(a, e):
+    f = _eval(a[0], e)
+    pat = _eval(a[1], e)
+    v = f.vecs[0]
+    data = v.host_data if v.type == T_STR else np.array(
+        [None if math.isnan(c) else v.levels()[int(c)] for c in v.to_numpy()],
+        object)
+    parts = [re.split(pat, s) if s is not None else [] for s in data]
+    width = max((len(p) for p in parts), default=0)
+    cols = []
+    for j in range(width):
+        cols.append(np.array([p[j] if j < len(p) else None for p in parts],
+                             object))
+    return _new_frame([f"C{j+1}" for j in range(width)], cols)
+
+
+@prim("countmatches")
+def _countmatches(a, e):
+    f = _eval(a[0], e)
+    pat = _eval(a[1], e)
+    pats = pat if isinstance(pat, list) else [pat]
+    v = f.vecs[0]
+    data = v.host_data if v.type == T_STR else np.array(
+        [None if math.isnan(c) else v.levels()[int(c)] for c in v.to_numpy()],
+        object)
+    out = np.array([np.nan if s is None else
+                    float(sum(s.count(p) for p in pats)) for s in data])
+    return _new_frame(f.names[:1], [out])
+
+
+# ---- time ops (prims/time) -------------------------------------------------
+def _time_part(args, env, part):
+    f = _eval(args[0], env)
+    ms = f.vecs[0].to_numpy()
+    dt = ms.astype("datetime64[ms]")
+    import pandas as pd
+    s = pd.Series(dt)
+    out = getattr(s.dt, part).to_numpy().astype(np.float64)
+    out[np.isnan(ms)] = np.nan
+    return _new_frame(f.names[:1], [out])
+
+
+for _p, _attr in [("year", "year"), ("month", "month"), ("day", "day"),
+                  ("hour", "hour"), ("minute", "minute"),
+                  ("second", "second"), ("dayOfWeek", "dayofweek"),
+                  ("week", "isocalendar")]:
+    if _p == "week":
+        continue
+    PRIMS[_p] = (lambda attr: lambda a, e: _time_part(a, e, attr))(_attr)
+
+
+# ---- misc ------------------------------------------------------------------
+@prim("getrow")
+def _getrow(a, e):
+    f = _eval(a[0], e)
+    return [float(x) for x in f.to_numpy()[0]]
+
+
+@prim("h2o.runif")
+def _runif(a, e):
+    f = _eval(a[0], e)
+    seed = int(_eval(a[1], e)) if len(a) > 1 else -1
+    rng = np.random.default_rng(seed if seed > 0 else None)
+    return _new_frame(["rnd"], [rng.random(f.nrows)])
+
+
+@prim("hist")
+def _hist(a, e):
+    f = _eval(a[0], e)
+    breaks = _eval(a[1], e) if len(a) > 1 else "sturges"
+    col = _col_np(f)
+    col = col[~np.isnan(col)]
+    if isinstance(breaks, list):
+        counts, edges = np.histogram(col, bins=np.asarray(breaks))
+    elif isinstance(breaks, float):
+        counts, edges = np.histogram(col, bins=int(breaks))
+    else:
+        counts, edges = np.histogram(col, bins="sturges")
+    return _new_frame(["breaks", "counts", "mids"],
+                      [edges[1:].astype(np.float64),
+                       counts.astype(np.float64),
+                       ((edges[:-1] + edges[1:]) / 2).astype(np.float64)])
+
+
+@prim("scale")
+def _scale(a, e):
+    f = _eval(a[0], e)
+    center = _eval(a[1], e) if len(a) > 1 else True
+    scale_ = _eval(a[2], e) if len(a) > 2 else True
+    A = f.matrix(_numeric_cols(f))
+    n = f.nrows
+
+    @jax.jit
+    def sc(A):
+        live = jnp.arange(A.shape[0])[:, None] < n
+        ok = ~jnp.isnan(A) & live
+        cnt = jnp.maximum(ok.sum(0), 1)
+        mu = jnp.where(ok, A, 0).sum(0) / cnt
+        x = A - (mu if center else 0.0)
+        sd = jnp.sqrt(jnp.where(ok, x * x, 0).sum(0) / jnp.maximum(cnt - 1, 1))
+        return x / jnp.where(sd > 0, sd, 1.0) if scale_ else x
+
+    out = np.asarray(sc(A), np.float64)[:n]
+    return _new_frame(f.names, [out[:, j] for j in range(out.shape[1])])
+
+
+@prim("apply")
+def _apply(a, e):
+    f = _eval(a[0], e)
+    margin = int(_eval(a[1], e))
+    lam = _eval(a[2], e)
+    if margin == 2:  # per column
+        outs = []
+        for j, c in enumerate(f.names):
+            sub = f[[c]]
+            r = _apply_lambda(lam, [sub], e)
+            outs.append(float(r) if not isinstance(r, Frame)
+                        else float(_col_np(r)[0]))
+            DKV.remove(sub.key)
+        return _new_frame(f.names, [np.array([o]) for o in outs])
+    # margin == 1: per row — vectorize via matrix when the body allows
+    m = f.to_numpy()
+    outs = []
+    for i in range(f.nrows):
+        rowf = _new_frame(f.names, [m[i:i+1, j] for j in range(f.ncols)])
+        r = _apply_lambda(lam, [rowf], e)
+        outs.append(float(r) if not isinstance(r, Frame)
+                    else float(_col_np(r)[0]))
+        DKV.remove(rowf.key)
+    return _new_frame(["apply"], [np.asarray(outs)])

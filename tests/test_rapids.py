@@ -1,0 +1,113 @@
+"""Rapids expression-language tests (mirrors testdir_munging pyunits)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.rapids import rapids_exec
+
+
+@pytest.fixture()
+def f():
+    fr = Frame.from_dict({
+        "a": [1.0, 2.0, 3.0, 4.0, 5.0],
+        "b": [10.0, 20.0, np.nan, 40.0, 50.0],
+        "c": np.array(["x", "y", "x", "z", "y"], dtype=object),
+    }, key="fr_test")
+    yield fr
+    h2o3_tpu.remove("fr_test")
+
+
+def test_arith_and_reduce(f):
+    assert rapids_exec("(sum (cols fr_test [0]))") == 15.0
+    assert rapids_exec("(mean (cols fr_test [1]))") == 30.0
+    assert rapids_exec("(max (cols fr_test [0]))") == 5.0
+    g = rapids_exec("(+ (cols fr_test [0]) 10)")
+    np.testing.assert_allclose(g.vecs[0].to_numpy(), [11, 12, 13, 14, 15])
+
+
+def test_comparison_and_filter(f):
+    mask = rapids_exec("(> (cols fr_test [0]) 2.5)")
+    np.testing.assert_array_equal(mask.vecs[0].to_numpy(), [0, 0, 1, 1, 1])
+    sub = rapids_exec("(rows fr_test (> (cols fr_test [0]) 2.5))")
+    assert sub.nrows == 3
+    np.testing.assert_allclose(sub.vec("a").to_numpy(), [3, 4, 5])
+
+
+def test_isna_ifelse(f):
+    na = rapids_exec("(is.na (cols fr_test [1]))")
+    assert na.vecs[0].to_numpy().tolist() == [0, 0, 1, 0, 0]
+    r = rapids_exec("(ifelse (is.na (cols fr_test [1])) -1 (cols fr_test [1]))")
+    np.testing.assert_allclose(r.vecs[0].to_numpy(), [10, 20, -1, 40, 50])
+
+
+def test_cbind_rbind(f):
+    g = rapids_exec("(cbind (cols fr_test [0]) (cols fr_test [1]))")
+    assert g.ncols == 2 and g.nrows == 5
+    h = rapids_exec("(rbind fr_test fr_test)")
+    assert h.nrows == 10 and h.ncols == 3
+    assert h.vec("c").levels() == ["x", "y", "z"]
+
+
+def test_sort_groupby(f):
+    s = rapids_exec("(sort fr_test [0] [0])")   # descending by col 0
+    assert s.vec("a").to_numpy()[0] == 5.0
+    g = rapids_exec('(GB fr_test [2] "sum" 0 "rm")')
+    assert g.nrows == 3
+    sums = dict(zip([g.vec(g.names[0]).domain[int(i)]
+                     for i in g.vecs[0].to_numpy()],
+                    g.vecs[1].to_numpy()))
+    assert sums == {"x": 4.0, "y": 7.0, "z": 4.0}
+
+
+def test_merge():
+    a = Frame.from_dict({"k": np.array(["a", "b", "c"], object),
+                         "v": [1.0, 2.0, 3.0]}, key="m_a")
+    b = Frame.from_dict({"k": np.array(["b", "c", "d"], object),
+                         "w": [20.0, 30.0, 40.0]}, key="m_b")
+    m = rapids_exec("(merge m_a m_b False False [0] [0] 'auto')")
+    assert m.nrows == 2
+    h2o3_tpu.remove("m_a"); h2o3_tpu.remove("m_b")
+
+
+def test_string_ops(f):
+    up = rapids_exec("(toupper (cols fr_test [2]))")
+    assert up.vecs[0].levels() == ["X", "Y", "Z"]
+    n = rapids_exec("(nchar (cols fr_test [2]))")
+    assert n.vecs[0].to_numpy().tolist() == [1, 1, 1, 1, 1]
+
+
+def test_asfactor_levels(f):
+    fac = rapids_exec("(as.factor (cols fr_test [0]))")
+    assert fac.vecs[0].type == "enum"
+    assert rapids_exec("(levels (cols fr_test [2]))") == ["x", "y", "z"]
+
+
+def test_quantile(f):
+    q = rapids_exec("(quantile (cols fr_test [0]) [0.5] 'interpolated' _)")
+    assert q.vec("a").to_numpy()[0] == 3.0
+
+
+def test_assignment_and_session(f):
+    r = rapids_exec("(tmp= rap_tmp1 (+ (cols fr_test [0]) 1))")
+    assert h2o3_tpu.get_frame("rap_tmp1") is r
+    rapids_exec("(rm rap_tmp1)")
+    assert h2o3_tpu.get_frame("rap_tmp1") is None
+
+
+def test_scale_apply(f):
+    s = rapids_exec("(scale (cols fr_test [0]) True True)")
+    col = s.vecs[0].to_numpy()
+    np.testing.assert_allclose(col.mean(), 0, atol=1e-6)
+    np.testing.assert_allclose(col.std(ddof=1), 1, atol=1e-5)
+    m = rapids_exec("(apply (cols fr_test [0 1]) 2 {x . (mean x)})")
+    np.testing.assert_allclose(m.vec("a").to_numpy()[0], 3.0)
+
+
+def test_math_and_cumsum(f):
+    g = rapids_exec("(sqrt (cols fr_test [0]))")
+    np.testing.assert_allclose(g.vecs[0].to_numpy(),
+                               np.sqrt([1, 2, 3, 4, 5]), rtol=1e-6)
+    cs = rapids_exec("(cumsum (cols fr_test [0]))")
+    np.testing.assert_allclose(cs.vecs[0].to_numpy(), [1, 3, 6, 10, 15])
