@@ -41,20 +41,25 @@ def main():
                           min_split_improvement=1e-5)
     F = jnp.zeros(N, jnp.float32)
 
-    def one_tree(F):
-        res, hess = _grad_hess("bernoulli", F, y)
-        col, thr, nal, val, _ = grower.grow(X, w, res)
-        ta = E.TreeArrays(col=col[None], thr=thr[None], na_left=nal[None],
-                          value=val[None], depth=DEPTH)
-        return F + 0.1 * E.predict_ensemble(X, ta)
+    import jax.random as jrandom
+    key = jrandom.PRNGKey(0)
 
-    # warmup: compile every per-level kernel
-    F = one_tree(F)
-    jax.block_until_ready(F)
+    def one_tree(F, k):
+        res, hess = _grad_hess("bernoulli", F, y)
+        col, thr, nal, val, heap, _ = grower.grow(X, w, res, key=k)
+        val = E.gamma_pass(heap, w, res, hess, val, nodes=grower.nodes)
+        return F + 0.1 * val[heap]
+
+    # warmup: compile every per-level kernel (sync via scalar readback —
+    # block_until_ready is unreliable through the axon relay)
+    key, k = jrandom.split(key)
+    F = one_tree(F, k)
+    float(F.sum())
     t0 = time.time()
     for _ in range(NTREES):
-        F = one_tree(F)
-    jax.block_until_ready(F)
+        key, k = jrandom.split(key)
+        F = one_tree(F, k)
+    float(F.sum())
     dt = time.time() - t0
 
     throughput = N * NTREES / dt

@@ -41,3 +41,21 @@ def remove(key):
 def ls():
     """List all registered keys (h2o.ls)."""
     return DKV.keys()
+
+
+def save_model(model, path):
+    """Binary model export (h2o.save_model)."""
+    from h2o3_tpu.genmodel.mojo import save_model as _sm
+    return _sm(model, path)
+
+
+def load_model(path):
+    """Binary model import (h2o.load_model)."""
+    from h2o3_tpu.genmodel.mojo import load_model as _lm
+    return _lm(path)
+
+
+def import_mojo(path):
+    """Load a scoring artifact (h2o.import_mojo → generic model)."""
+    from h2o3_tpu.genmodel.mojo import MojoModel
+    return MojoModel.load(path)

@@ -1,0 +1,402 @@
+"""REST API server — water/api/RequestServer.java rebuilt on stdlib http.
+
+Reference: RequestServer.java:56 (route tree, ~150 routes :75-80), versioned
+Schema system (water/api/Schema.java, schemas3/*), handlers (ParseHandler,
+ModelBuilderHandler, FramesHandler, RapidsHandler, JobsHandler…), served by
+Jetty through h2o-webserver-iface. Clients (h2o-py/h2o-r/Flow) are pure REST
+consumers — this surface is the compatibility seam.
+
+TPU-native design: one controller process serves the API (every H2O node
+serves it; here the controller IS the cluster). Threaded stdlib HTTPServer, no
+Jetty; routes mirror the /3 and /99 paths and schema field names the clients
+expect. Model builds run as background Jobs, polled via /3/Jobs like the
+reference.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.jobs import Job, jobs_list
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.io import parser as io_parser
+from h2o3_tpu.rapids import rapids_exec, Session
+
+
+def _frame_schema(f: Frame, with_summary=False) -> dict:
+    d = {
+        "frame_id": {"name": f.key},
+        "rows": f.nrows, "column_count": f.ncols,
+        "columns": [{"label": n, "type": v.type,
+                     "missing_count": (v.na_cnt() if v.type != "str" else 0),
+                     "domain": v.levels()}
+                    for n, v in zip(f.names, f.vecs)],
+    }
+    if with_summary:
+        d["summary"] = f.summary()
+    return d
+
+
+def _model_schema(m) -> dict:
+    return m.to_dict()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "h2o3-tpu/0.1"
+
+    # ---- plumbing -------------------------------------------------------
+    def _send(self, obj, code=200):
+        body = json.dumps(obj, default=_json_default).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, msg, code=400):
+        self._send({"__meta": {"schema_type": "H2OError"},
+                    "msg": str(msg), "http_status": code}, code)
+
+    def _params(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        ln = int(self.headers.get("Content-Length") or 0)
+        if ln:
+            body = self.rfile.read(ln).decode()
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                q.update(json.loads(body))
+            else:
+                q.update({k: v[0] for k, v in
+                          urllib.parse.parse_qs(body).items()})
+        return q
+
+    def log_message(self, fmt, *args):
+        pass  # quiet; Log module handles observability
+
+    # ---- routing --------------------------------------------------------
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+    def _route(self, method):
+        path = urllib.parse.urlparse(self.path).path
+        try:
+            for pat, m, fn in ROUTES:
+                if m != method:
+                    continue
+                mm = pat.fullmatch(path)
+                if mm:
+                    fn(self, *mm.groups())
+                    return
+            self._error(f"no route {method} {path}", 404)
+        except Exception as ex:  # noqa: BLE001 — handler errors → H2OError
+            self._error(repr(ex), 500)
+
+
+def _json_default(o):
+    if isinstance(o, (np.floating, np.integer)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+# ---------------------------------------------------------------------------
+# handlers
+def _h_cloud(h: _Handler):
+    info = h2o3_tpu.cluster_info()
+    h._send({"__meta": {"schema_type": "CloudV3"},
+             "cloud_name": info["cloud_name"],
+             "cloud_size": info["cloud_size"],
+             "cloud_healthy": True, "consensus": True, "locked": True,
+             "version": h2o3_tpu.__version__,
+             "nodes": [{"h2o": d, "healthy": True}
+                       for d in info["devices"]]})
+
+
+def _h_import(h: _Handler):
+    p = h._params()
+    path = p.get("path")
+    h._send({"__meta": {"schema_type": "ImportFilesV3"},
+             "files": [path], "destination_frames": [path], "fails": []})
+
+
+def _h_parse_setup(h: _Handler):
+    p = h._params()
+    src = p.get("source_frames")
+    if isinstance(src, str):
+        src = json.loads(src) if src.startswith("[") else [src]
+    path = src[0].strip('"')
+    s = io_parser.parse_setup(path)
+    h._send({"__meta": {"schema_type": "ParseSetupV3"},
+             "source_frames": src,
+             "separator": ord(s.separator), "check_header": 1 if s.header else -1,
+             "column_names": s.column_names, "column_types": s.column_types,
+             "parse_type": s.parse_type,
+             "destination_frame": path.split("/")[-1] + ".hex"})
+
+
+def _h_parse(h: _Handler):
+    p = h._params()
+    src = p.get("source_frames")
+    if isinstance(src, str):
+        src = json.loads(src) if src.startswith("[") else [src]
+    path = src[0].strip('"')
+    dest = p.get("destination_frame") or None
+    job = Job(description=f"Parse {path}", dest=dest or "parsed")
+
+    def work(job):
+        f = io_parser.import_file(path, destination_frame=dest)
+        job.dest = f.key
+        return f
+
+    job.start(work)
+    h._send({"__meta": {"schema_type": "ParseV3"},
+             "job": job.to_dict(), "destination_frame": {"name": dest}})
+
+
+def _h_frames(h: _Handler):
+    frames = [DKV.get(k) for k in DKV.keys()]
+    frames = [f for f in frames if isinstance(f, Frame)]
+    h._send({"__meta": {"schema_type": "FramesV3"},
+             "frames": [_frame_schema(f) for f in frames]})
+
+
+def _h_frame(h: _Handler, fid):
+    f = DKV.get(fid)
+    if not isinstance(f, Frame):
+        return h._error(f"frame {fid} not found", 404)
+    h._send({"__meta": {"schema_type": "FramesV3"},
+             "frames": [_frame_schema(f, with_summary=True)]})
+
+
+def _h_frame_delete(h: _Handler, fid):
+    DKV.remove(fid)
+    h._send({"__meta": {"schema_type": "FramesV3"}})
+
+
+def _h_model_builders(h: _Handler):
+    from h2o3_tpu.models import ESTIMATORS
+    h._send({"__meta": {"schema_type": "ModelBuildersV3"},
+             "model_builders": {k: {"algo": k, "visibility": "Stable"}
+                                for k in ESTIMATORS}})
+
+
+def _h_build_model(h: _Handler, algo):
+    from h2o3_tpu.models import ESTIMATORS
+    cls = ESTIMATORS.get(algo)
+    if cls is None:
+        return h._error(f"unknown algo {algo}", 404)
+    p = h._params()
+    tf = DKV.get(p.pop("training_frame", None))
+    vf = DKV.get(p.pop("validation_frame", None)) if p.get(
+        "validation_frame") else None
+    y = p.pop("response_column", None)
+    x = p.pop("x", None)
+    if isinstance(x, str):
+        x = json.loads(x)
+    p.pop("_rest_version", None)
+    params = {}
+    for k, v in p.items():
+        if k in cls._COMMON or k in cls._defaults:
+            params[k] = _coerce_param(v)
+    est = cls(**params)
+    job = Job(description=f"{algo} model build",
+              dest=params.get("model_id") or DKV.make_key(algo))
+
+    def work(job):
+        est.train(x=x, y=y, training_frame=tf, validation_frame=vf)
+        job.dest = est.key
+        return est
+
+    job.start(work)
+    h._send({"__meta": {"schema_type": "ModelBuilderJobV3"},
+             "job": job.to_dict()})
+
+
+def _coerce_param(v):
+    if isinstance(v, str):
+        if v.lower() in ("true", "false"):
+            return v.lower() == "true"
+        if v.startswith("["):
+            return json.loads(v)
+        try:
+            fv = float(v)
+            return int(fv) if fv.is_integer() and "." not in v else fv
+        except ValueError:
+            return v
+    return v
+
+
+def _h_models(h: _Handler):
+    from h2o3_tpu.models.model import ModelBase
+    ms = [DKV.get(k) for k in DKV.keys()]
+    ms = [m for m in ms if isinstance(m, ModelBase)]
+    h._send({"__meta": {"schema_type": "ModelsV3"},
+             "models": [_model_schema(m) for m in ms]})
+
+
+def _h_model(h: _Handler, mid):
+    m = DKV.get(mid)
+    if m is None:
+        return h._error(f"model {mid} not found", 404)
+    h._send({"__meta": {"schema_type": "ModelsV3"},
+             "models": [_model_schema(m)]})
+
+
+def _h_model_delete(h: _Handler, mid):
+    DKV.remove(mid)
+    h._send({"__meta": {"schema_type": "ModelsV3"}})
+
+
+def _h_predict(h: _Handler, mid, fid):
+    m = DKV.get(mid)
+    f = DKV.get(fid)
+    if m is None or f is None:
+        return h._error("model or frame not found", 404)
+    p = h._params()
+    dest = p.get("predictions_frame")
+    pred = m.predict(f)
+    if dest:
+        DKV.remove(pred.key)
+        pred.key = dest
+        DKV.put(dest, pred)
+    h._send({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
+             "predictions_frame": {"name": pred.key},
+             "model_metrics": []})
+
+
+def _h_jobs(h: _Handler):
+    h._send({"__meta": {"schema_type": "JobsV3"}, "jobs": jobs_list()})
+
+
+def _h_job(h: _Handler, jid):
+    j = DKV.get(jid)
+    if not isinstance(j, Job):
+        return h._error(f"job {jid} not found", 404)
+    h._send({"__meta": {"schema_type": "JobsV3"}, "jobs": [j.to_dict()]})
+
+
+_sessions: dict = {}
+
+
+def _h_rapids(h: _Handler):
+    p = h._params()
+    ast = p.get("ast")
+    sid = p.get("session_id", "default")
+    sess = _sessions.setdefault(sid, Session(sid))
+    val = rapids_exec(ast, sess)
+    if isinstance(val, Frame):
+        h._send({"__meta": {"schema_type": "RapidsFrameV3"},
+                 "key": {"name": val.key}, "num_rows": val.nrows,
+                 "num_cols": val.ncols})
+    elif isinstance(val, (int, float)):
+        h._send({"__meta": {"schema_type": "RapidsNumberV3"},
+                 "scalar": val})
+    elif isinstance(val, list):
+        h._send({"__meta": {"schema_type": "RapidsStringsV3"},
+                 "string": [str(s) for s in val]})
+    else:
+        h._send({"__meta": {"schema_type": "RapidsStringV3"},
+                 "string": str(val)})
+
+
+def _h_init_session(h: _Handler):
+    sid = DKV.make_key("session")
+    _sessions[sid] = Session(sid)
+    h._send({"__meta": {"schema_type": "InitIDV3"}, "session_key": sid})
+
+
+def _h_end_session(h: _Handler):
+    p = h._params()
+    sid = p.get("session_id", "default")
+    s = _sessions.pop(sid, None)
+    if s:
+        s.end()
+    h._send({"__meta": {"schema_type": "InitIDV3"}, "session_key": sid})
+
+
+def _h_shutdown(h: _Handler):
+    h._send({"__meta": {"schema_type": "ShutdownV3"}})
+    threading.Thread(target=h.server.shutdown, daemon=True).start()
+
+
+def _h_about(h: _Handler):
+    h._send({"__meta": {"schema_type": "AboutV3"},
+             "entries": [{"name": "Build version",
+                          "value": h2o3_tpu.__version__},
+                         {"name": "Backend", "value": "jax/tpu"}]})
+
+
+ROUTES = [
+    (re.compile(r"/3/Cloud"), "GET", _h_cloud),
+    (re.compile(r"/3/About"), "GET", _h_about),
+    (re.compile(r"/3/ImportFiles"), "GET", _h_import),
+    (re.compile(r"/3/ParseSetup"), "POST", _h_parse_setup),
+    (re.compile(r"/3/Parse"), "POST", _h_parse),
+    (re.compile(r"/3/Frames"), "GET", _h_frames),
+    (re.compile(r"/3/Frames/([^/]+)"), "GET", _h_frame),
+    (re.compile(r"/3/Frames/([^/]+)"), "DELETE", _h_frame_delete),
+    (re.compile(r"/3/ModelBuilders"), "GET", _h_model_builders),
+    (re.compile(r"/3/ModelBuilders/([^/]+)"), "POST", _h_build_model),
+    (re.compile(r"/99/ModelBuilders/([^/]+)"), "POST", _h_build_model),
+    (re.compile(r"/3/Models"), "GET", _h_models),
+    (re.compile(r"/3/Models/([^/]+)"), "GET", _h_model),
+    (re.compile(r"/3/Models/([^/]+)"), "DELETE", _h_model_delete),
+    (re.compile(r"/3/Predictions/models/([^/]+)/frames/([^/]+)"), "POST",
+     _h_predict),
+    (re.compile(r"/3/Jobs"), "GET", _h_jobs),
+    (re.compile(r"/3/Jobs/([^/]+)"), "GET", _h_job),
+    (re.compile(r"/99/Rapids"), "POST", _h_rapids),
+    (re.compile(r"/3/InitID"), "GET", _h_init_session),
+    (re.compile(r"/3/InitID"), "DELETE", _h_end_session),
+    (re.compile(r"/3/Shutdown"), "POST", _h_shutdown),
+]
+
+
+class H2OServer:
+    """Controller-side API server (h2o.init() + jetty in one)."""
+
+    def __init__(self, port: int = 54321):
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread: threading.Thread | None = None
+
+    def start(self, background=True):
+        h2o3_tpu.cloud()  # form the device mesh before serving
+        if background:
+            self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                           daemon=True, name="h2o3-rest")
+            self.thread.start()
+        else:
+            self.httpd.serve_forever()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_server(port: int = 54321) -> H2OServer:
+    return H2OServer(port).start()
+
+
+if __name__ == "__main__":
+    import sys
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 54321
+    print(f"h2o3-tpu REST server on :{port}")
+    H2OServer(port).start(background=False)

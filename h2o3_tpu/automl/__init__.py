@@ -1,0 +1,1 @@
+from h2o3_tpu.automl.automl import H2OAutoML, Leaderboard

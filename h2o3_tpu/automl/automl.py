@@ -1,0 +1,193 @@
+"""AutoML — ai/h2o/automl rebuilt: staged model plan + leaderboard + stacking.
+
+Reference: AutoML.java:40 (lifecycle; planWork :347, learn :612),
+ModelingStep/ModelingStepsExecutor (step state machine), modeling/
+*StepsProvider (per-algo step definitions: XGBoost×3, GLM, DRF, GBM×5,
+DeepLearning×3, XRT, 2 grids, 2 stacked ensembles), leaderboard/
+Leaderboard.java (ranked by CV metric), events/EventLog.
+
+TPU-native: the plan is a controller-side list of (name, builder-factory)
+steps executed under the time/model budget; every step's chips-saturating
+work is the underlying builder's jitted programs. The reference's XGBoost
+steps map onto the native GBM histogram engine (the TPU build replaces the
+xgboost4j JNI path outright — SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.jobs import Job
+from h2o3_tpu.core.kvstore import DKV
+
+
+def _steps(seed: int):
+    """The default modeling plan (modeling/*StepsProvider defaults)."""
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator as GLM
+    from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator as GBM
+    from h2o3_tpu.models.tree.drf import H2ORandomForestEstimator as DRF
+    from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator as DL
+    s = seed if seed and seed > 0 else 1
+    return [
+        # XGBoost steps → native GBM histogram engine (hist semantics)
+        ("XGBoost_1", GBM, dict(ntrees=50, max_depth=10, min_rows=5,
+                                learn_rate=0.3, sample_rate=0.8,
+                                col_sample_rate_per_tree=0.8, seed=s)),
+        ("XGBoost_2", GBM, dict(ntrees=50, max_depth=6, min_rows=10,
+                                learn_rate=0.3, sample_rate=0.6,
+                                col_sample_rate_per_tree=0.8, seed=s)),
+        ("XGBoost_3", GBM, dict(ntrees=50, max_depth=15, min_rows=3,
+                                learn_rate=0.3, sample_rate=0.8, seed=s)),
+        ("GLM_1", GLM, dict(alpha=0.5, lambda_search=True, nlambdas=10,
+                            max_iterations=20)),
+        ("DRF_1", DRF, dict(ntrees=50, seed=s)),
+        ("GBM_1", GBM, dict(ntrees=60, max_depth=6, min_rows=1,
+                            learn_rate=0.1, sample_rate=0.8,
+                            col_sample_rate_per_tree=0.8, seed=s)),
+        ("GBM_2", GBM, dict(ntrees=60, max_depth=7, min_rows=10,
+                            learn_rate=0.1, sample_rate=0.9, seed=s)),
+        ("GBM_3", GBM, dict(ntrees=60, max_depth=8, min_rows=10,
+                            learn_rate=0.1, seed=s)),
+        ("GBM_4", GBM, dict(ntrees=60, max_depth=10, min_rows=10,
+                            learn_rate=0.05, seed=s)),
+        ("GBM_5", GBM, dict(ntrees=100, max_depth=15, min_rows=100,
+                            learn_rate=0.05, sample_rate=0.6, seed=s)),
+        ("DeepLearning_1", DL, dict(hidden=[64, 64], epochs=10, seed=s,
+                                    mini_batch_size=128)),
+        ("DeepLearning_2", DL, dict(hidden=[128], epochs=10, seed=s,
+                                    mini_batch_size=128)),
+        ("DeepLearning_3", DL, dict(hidden=[32, 32, 32], epochs=10, seed=s,
+                                    mini_batch_size=128)),
+        ("XRT_1", DRF, dict(ntrees=50, histogram_type="Random", seed=s)),
+    ]
+
+
+class Leaderboard:
+    """leaderboard/Leaderboard.java: models ranked by CV metric."""
+
+    def __init__(self, sort_metric: str, decreasing: bool):
+        self.sort_metric = sort_metric
+        self.decreasing = decreasing
+        self.rows: list = []
+
+    def add(self, name, model):
+        src = (model._output.cross_validation_metrics
+               or model._output.validation_metrics
+               or model._output.training_metrics)
+        row = {"model_id": model.key, "step": name}
+        for k in ("auc", "logloss", "mean_per_class_error", "rmse", "mse",
+                  "pr_auc", "error", "mae"):
+            v = getattr(src, k, None)
+            if v is not None:
+                row[k] = v
+        self.rows.append((row, model))
+        key = self.sort_metric
+        self.rows.sort(key=lambda rm: rm[0].get(key, float("inf")),
+                       reverse=self.decreasing)
+
+    def as_list(self):
+        return [r for r, _ in self.rows]
+
+    @property
+    def leader(self):
+        return self.rows[0][1] if self.rows else None
+
+
+class H2OAutoML:
+    def __init__(self, max_models: int = 10, max_runtime_secs: float = 0.0,
+                 seed: int = -1, nfolds: int = 5, sort_metric: str = "AUTO",
+                 exclude_algos=None, include_algos=None, project_name=None,
+                 balance_classes: bool = False,
+                 keep_cross_validation_predictions: bool = True):
+        self.max_models = max_models
+        self.max_runtime_secs = max_runtime_secs
+        self.seed = seed
+        self.nfolds = nfolds
+        self.sort_metric = sort_metric
+        self.exclude_algos = {a.lower() for a in (exclude_algos or [])}
+        self.include_algos = ({a.lower() for a in include_algos}
+                              if include_algos else None)
+        self.project_name = project_name or DKV.make_key("automl")
+        self.leaderboard_obj = None
+        self.event_log: list = []
+        self.leader = None
+
+    def _log(self, msg):
+        self.event_log.append({"t": time.time(), "message": msg})
+
+    def train(self, x=None, y=None, training_frame=None,
+              validation_frame=None, leaderboard_frame=None):
+        assert y is not None and training_frame is not None
+        is_cls = training_frame.vec(y).type == "enum"
+        ncls = training_frame.vec(y).cardinality if is_cls else 1
+        metric = self.sort_metric
+        if metric in ("AUTO", None):
+            metric = ("auc" if ncls == 2 else
+                      "mean_per_class_error" if is_cls else "rmse")
+        decreasing = metric in ("auc", "pr_auc", "accuracy", "f1")
+        lb = Leaderboard(metric.lower(), decreasing)
+        self.leaderboard_obj = lb
+        t0 = time.time()
+        built = 0
+        se_candidates = []
+        for name, cls, params in _steps(self.seed):
+            algo = cls.algo
+            if self.include_algos is not None and algo not in self.include_algos \
+                    and not (algo == "gbm" and "xgboost" in self.include_algos):
+                continue
+            if algo in self.exclude_algos:
+                continue
+            if self.max_models and built >= self.max_models:
+                break
+            if self.max_runtime_secs and time.time() - t0 > self.max_runtime_secs:
+                self._log("time budget exhausted")
+                break
+            p = dict(params)
+            p["nfolds"] = self.nfolds
+            p["keep_cross_validation_predictions"] = True
+            p["model_id"] = f"{self.project_name}_{name}"
+            try:
+                self._log(f"building {name}")
+                m = cls(**p)
+                m.train(x=x, y=y, training_frame=training_frame,
+                        validation_frame=validation_frame)
+                lb.add(name, m)
+                se_candidates.append(m)
+                built += 1
+            except Exception as ex:  # noqa: BLE001 — a failed step is logged
+                self._log(f"step {name} failed: {ex!r}")
+        # Stacked ensembles (best-of-family + all) when ≥2 base models
+        if len(se_candidates) >= 2 and "stackedensemble" not in self.exclude_algos:
+            try:
+                from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+                best_of_family = {}
+                for (row, m) in lb.rows:
+                    best_of_family.setdefault(m.algo, m)
+                for se_name, base in (
+                        ("StackedEnsemble_BestOfFamily",
+                         list(best_of_family.values())),
+                        ("StackedEnsemble_AllModels", se_candidates)):
+                    if len(base) < 2:
+                        continue
+                    self._log(f"building {se_name}")
+                    se = H2OStackedEnsembleEstimator(
+                        base_models=base,
+                        model_id=f"{self.project_name}_{se_name}")
+                    se.train(y=y, training_frame=training_frame)
+                    lb.add(se_name, se)
+            except Exception as ex:  # noqa: BLE001
+                self._log(f"stacking failed: {ex!r}")
+        self.leader = lb.leader
+        self._log(f"done: {built} base models; leader={lb.leader.key if lb.leader else None}")
+        return self
+
+    @property
+    def leaderboard(self):
+        import pandas as pd
+        return pd.DataFrame(self.leaderboard_obj.as_list())
+
+    def predict(self, test_data: Frame) -> Frame:
+        return self.leader.predict(test_data)

@@ -1,0 +1,324 @@
+"""MOJO-style scoring artifacts — h2o-genmodel rebuilt.
+
+Reference: h2o-genmodel/ (MojoModel.java, GenModel.java, per-algo readers
+hex/genmodel/algos/*, EasyPredictModelWrapper row API): a MOJO is a zip of
+model metadata + binary payload that scores with zero cluster dependencies;
+writers live beside each algo (*MojoWriter.java).
+
+This build's artifact keeps the same contract — a self-contained zip
+(model.ini-style JSON metadata + npz payloads) scoreable with numpy alone —
+with the same algo coverage (trees/GLM/KMeans/DL/NB/PCA/GLRM). Byte-level
+compatibility with the reference's zip layout is not attempted: the scoring
+JAR ecosystem is JVM-side; the parity surface here is save → load → identical
+predictions without a running cloud.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import zipfile
+
+import numpy as np
+
+from h2o3_tpu.core.kvstore import DKV
+
+MAGIC = "h2o3_tpu_mojo/1"
+
+
+# ===========================================================================
+def export_mojo(model, path: str) -> str:
+    """Model.getMojo(): serialize the learned state + scoring metadata."""
+    algo = model.algo
+    di = model._dinfo
+    meta = {
+        "magic": MAGIC, "algo": algo, "model_id": model.key,
+        "params": {k: v for k, v in model.params.items()
+                   if isinstance(v, (int, float, str, bool, list, type(None)))},
+        "predictors": di.predictors if di else [],
+        "feature_names": di.feature_names if di else [],
+        "cat_cols": di.cat_cols if di else [],
+        "num_cols": di.num_cols if di else [],
+        "domains": {k: list(v) for k, v in (di.domains or {}).items()} if di else {},
+        "response_domain": di.response_domain if di else None,
+        "means": di.means if di else {},
+        "sigmas": di.sigmas if di else {},
+        "standardize": di.standardize if di else False,
+        "cat_mode": di.cat_mode if di else "onehot",
+    }
+    arrays = {}
+    if algo in ("gbm", "drf", "isolationforest"):
+        if getattr(model, "_trees_k", None) is not None:
+            meta["nclass_trees"] = len(model._trees_k)
+            meta["depth"] = model._trees_k[0].depth
+            for c, ta in enumerate(model._trees_k):
+                arrays[f"col_{c}"] = np.asarray(ta.col)
+                arrays[f"thr_{c}"] = np.asarray(ta.thr)
+                arrays[f"nal_{c}"] = np.asarray(ta.na_left)
+                arrays[f"val_{c}"] = np.asarray(ta.value)
+            meta["f0"] = np.asarray(model._f0).tolist()
+        else:
+            ta = model._trees
+            meta["depth"] = ta.depth
+            arrays["col_0"] = np.asarray(ta.col)
+            arrays["thr_0"] = np.asarray(ta.thr)
+            arrays["nal_0"] = np.asarray(ta.na_left)
+            arrays["val_0"] = np.asarray(ta.value)
+            if algo == "gbm":
+                meta["f0"] = float(model._f0)
+                meta["dist"] = model._dist
+            if algo == "isolationforest":
+                meta["min_len"] = model._min_len
+                meta["max_len"] = model._max_len
+        if algo == "gbm":
+            meta["dist"] = model._dist
+            meta["learn_rate"] = float(model.params["learn_rate"])
+        if algo == "drf":
+            meta["nclasses"] = model.nclasses
+    elif algo == "glm":
+        arrays["beta"] = np.asarray(model._state.beta)
+        meta["family"] = model._state.family
+        meta["link"] = model._state.link
+    elif algo == "kmeans":
+        arrays["centers"] = np.asarray(model._centroids)
+    elif algo == "deeplearning":
+        for i, (W, b) in enumerate(model._params_net):
+            arrays[f"W_{i}"] = np.asarray(W)
+            arrays[f"b_{i}"] = np.asarray(b)
+        meta["n_layers"] = len(model._params_net)
+        meta["activation"] = model.params.get("activation")
+        meta["loss_kind"] = model._loss_kind
+        meta["autoencoder"] = bool(model.params.get("autoencoder"))
+    elif algo == "naivebayes":
+        arrays["priors"] = model._priors
+        for i, t in enumerate(model._cat_probs):
+            arrays[f"cat_{i}"] = t
+        for i, m in enumerate(model._num_mean):
+            arrays[f"nmean_{i}"] = m
+            arrays[f"nsd_{i}"] = model._num_sd[i]
+        meta["cat_idx"] = list(model._cat_idx)
+        meta["num_idx"] = list(model._num_idx)
+    elif algo == "pca":
+        arrays["rotation"] = model._rotation
+        arrays["mean"] = model._mean
+        arrays["sd"] = model._sd
+        meta["transform"] = model._transform
+    elif algo == "glrm":
+        arrays["archetypes"] = model._B
+    else:
+        raise NotImplementedError(f"MOJO export for {algo}")
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("model.json", json.dumps(meta, default=float))
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        zf.writestr("payload.npz", buf.getvalue())
+    return path
+
+
+# ===========================================================================
+class MojoModel:
+    """Standalone scorer (hex/genmodel/MojoModel + EasyPredictModelWrapper):
+    numpy-only, no cloud, no jax required at score time."""
+
+    def __init__(self, meta: dict, arrays: dict):
+        self.meta = meta
+        self.arrays = arrays
+        self.algo = meta["algo"]
+
+    @staticmethod
+    def load(path: str) -> "MojoModel":
+        with zipfile.ZipFile(path) as zf:
+            meta = json.loads(zf.read("model.json"))
+            assert meta.get("magic") == MAGIC, "not an h2o3_tpu MOJO"
+            npz = np.load(io.BytesIO(zf.read("payload.npz")))
+            arrays = {k: npz[k] for k in npz.files}
+        return MojoModel(meta, arrays)
+
+    # ---- row → model-space matrix (GenModel data prep) -------------------
+    def _row_to_matrix(self, rows) -> np.ndarray:
+        m = self.meta
+        if isinstance(rows, dict):
+            rows = [rows]
+        n = len(rows)
+        if m["cat_mode"] == "label":
+            X = np.full((n, len(m["predictors"])), np.nan)
+            for i, r in enumerate(rows):
+                for j, c in enumerate(m["predictors"]):
+                    v = r.get(c)
+                    if v is None:
+                        continue
+                    if c in m["domains"]:
+                        dom = m["domains"][c]
+                        X[i, j] = dom.index(str(v)) if str(v) in dom else np.nan
+                    else:
+                        X[i, j] = float(v)
+            return X
+        cols = []
+        for i, r in enumerate(rows):
+            row = []
+            for c in m["cat_cols"]:
+                dom = m["domains"][c]
+                oh = [0.0] * len(dom)
+                v = r.get(c)
+                if v is not None and str(v) in dom:
+                    oh[dom.index(str(v))] = 1.0
+                row.extend(oh)
+            for c in m["num_cols"]:
+                v = r.get(c)
+                x = np.nan if v is None else float(v)
+                if m["standardize"]:
+                    mu = m["means"].get(c, 0.0)
+                    sd = max(m["sigmas"].get(c, 1.0) or 1.0, 1e-10)
+                    x = 0.0 if np.isnan(x) else (x - mu) / sd
+                elif np.isnan(x):
+                    x = m["means"].get(c, 0.0)
+                row.append(x)
+            cols.append(row)
+        return np.asarray(cols, np.float64)
+
+    # ---- scoring ---------------------------------------------------------
+    def _walk_trees(self, X, c_idx=0):
+        col = self.arrays[f"col_{c_idx}"]
+        thr = self.arrays[f"thr_{c_idx}"]
+        nal = self.arrays[f"nal_{c_idx}"]
+        val = self.arrays[f"val_{c_idx}"]
+        T = col.shape[0]
+        n = X.shape[0]
+        out = np.zeros(n)
+        depth = self.meta["depth"]
+        for t in range(T):
+            node = np.zeros(n, np.int64)
+            for _ in range(depth):
+                c = col[t][node]
+                leafish = c < 0
+                cc = np.maximum(c, 0)
+                x = X[np.arange(n), cc]
+                isna = np.isnan(x)
+                right = np.where(isna, ~nal[t][node], x > thr[t][node])
+                child = 2 * node + 1 + right.astype(np.int64)
+                node = np.where(leafish, node, child)
+            out += val[t][node]
+        return out
+
+    def predict(self, data):
+        """EasyPredictModelWrapper.predict: dict row(s) → prediction dict."""
+        X = self._row_to_matrix(data)
+        m = self.meta
+        algo = self.algo
+        if algo == "gbm":
+            if "nclass_trees" in m:
+                K = m["nclass_trees"]
+                F = np.stack([m["f0"][c] + m["learn_rate"] *
+                              self._walk_trees(X, c) for c in range(K)], 1)
+                eF = np.exp(F - F.max(1, keepdims=True))
+                P = eF / eF.sum(1, keepdims=True)
+                return self._cls_out(P)
+            F = m["f0"] + m["learn_rate"] * self._walk_trees(X)
+            if m["dist"] in ("bernoulli", "quasibinomial"):
+                p = 1 / (1 + np.exp(-F))
+                return self._cls_out(np.stack([1 - p, p], 1))
+            if m["dist"] in ("poisson", "gamma", "tweedie"):
+                return {"predict": np.exp(F)}
+            return {"predict": F}
+        if algo == "drf":
+            if "nclass_trees" in m:
+                K = m["nclass_trees"]
+                P = np.stack([self._walk_trees(X, c) /
+                              self.arrays["col_0"].shape[0]
+                              for c in range(K)], 1)
+                P = np.clip(P, 0, 1)
+                P /= np.maximum(P.sum(1, keepdims=True), 1e-10)
+                return self._cls_out(P)
+            mean = self._walk_trees(X) / self.arrays["col_0"].shape[0]
+            if m["response_domain"]:
+                p = np.clip(mean, 0, 1)
+                return self._cls_out(np.stack([1 - p, p], 1))
+            return {"predict": mean}
+        if algo == "isolationforest":
+            ml = self._walk_trees(X) / self.arrays["col_0"].shape[0]
+            span = max(m["max_len"] - m["min_len"], 1e-12)
+            return {"predict": (m["max_len"] - ml) / span, "mean_length": ml}
+        if algo == "glm":
+            beta = self.arrays["beta"]
+            Xi = np.column_stack([np.nan_to_num(X), np.ones(len(X))])
+            if m["family"] == "multinomial":
+                F = Xi @ beta.T
+                eF = np.exp(F - F.max(1, keepdims=True))
+                return self._cls_out(eF / eF.sum(1, keepdims=True))
+            eta = Xi @ beta
+            link = m["link"]
+            mu = (eta if link == "identity" else
+                  1 / (1 + np.exp(-eta)) if link == "logit" else
+                  np.exp(eta) if link == "log" else 1.0 / eta)
+            if m["family"] in ("binomial", "quasibinomial"):
+                return self._cls_out(np.stack([1 - mu, mu], 1))
+            return {"predict": mu}
+        if algo == "kmeans":
+            C = self.arrays["centers"]
+            d = ((np.nan_to_num(X)[:, None, :] - C[None]) ** 2).sum(-1)
+            return {"cluster": d.argmin(1)}
+        if algo == "deeplearning":
+            h = np.nan_to_num(X)
+            nl = m["n_layers"]
+            act = (m.get("activation") or "Rectifier").lower()
+            for i in range(nl):
+                z = h @ self.arrays[f"W_{i}"] + self.arrays[f"b_{i}"]
+                if i < nl - 1:
+                    if "maxout" in act:
+                        z = z.reshape(z.shape[0], -1, 2).max(2)
+                    elif "tanh" in act:
+                        z = np.tanh(z)
+                    else:
+                        z = np.maximum(z, 0)
+                h = z
+            if m.get("autoencoder"):
+                return {"reconstruction": h}
+            if m["loss_kind"] == "ce":
+                eF = np.exp(h - h.max(1, keepdims=True))
+                return self._cls_out(eF / eF.sum(1, keepdims=True))
+            return {"predict": h[:, 0]}
+        if algo == "pca":
+            x = np.nan_to_num(X)
+            t = m["transform"]
+            if t in ("DEMEAN", "STANDARDIZE"):
+                x = x - self.arrays["mean"]
+            if t in ("DESCALE", "STANDARDIZE", "NORMALIZE"):
+                x = x / self.arrays["sd"]
+            return {"scores": x @ self.arrays["rotation"]}
+        raise NotImplementedError(self.algo)
+
+    def _cls_out(self, P):
+        dom = self.meta["response_domain"]
+        idx = P.argmax(1)
+        return {"predict": np.array([dom[i] for i in idx], object),
+                "probs": P, "domain": dom}
+
+
+# ===========================================================================
+# Binary model save/load (water/api/ModelsHandler exportBinaryModel)
+class _ModelPickler(pickle.Pickler):
+    """Device arrays are converted to host numpy on serialization — a saved
+    model must load without a TPU attached (Model.exportBinaryModel)."""
+
+    def reducer_override(self, obj):
+        try:
+            import jax
+            if isinstance(obj, jax.Array):
+                return (np.asarray, (np.asarray(obj),))
+        except ImportError:
+            pass
+        return NotImplemented
+
+
+def save_model(model, path: str) -> str:
+    with open(path, "wb") as f:
+        _ModelPickler(f, protocol=5).dump(model)
+    return path
+
+
+def load_model(path: str):
+    with open(path, "rb") as f:
+        m = pickle.load(f)
+    DKV.put(m.key, m)
+    return m

@@ -1,0 +1,107 @@
+"""Stacked Ensemble — hex/ensemble/StackedEnsemble.java + Metalearners.java.
+
+Reference: base models trained with keep_cross_validation_predictions; the
+"level-one" frame is the column-bound CV holdout predictions; a metalearner
+(default GLM with non-negative weights for regression/binomial) is trained on
+it; scoring = metalearner over base-model predictions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.models.model import ModelBase
+
+
+class H2OStackedEnsembleEstimator(ModelBase):
+    algo = "stackedensemble"
+    _defaults = {
+        "base_models": None, "metalearner_algorithm": "AUTO",
+        "metalearner_nfolds": 0, "metalearner_params": None,
+    }
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        base = self.params.get("base_models") or []
+        base = [DKV.get(b) if isinstance(b, str) else b for b in base]
+        if not base:
+            raise ValueError("stackedensemble requires base_models")
+        self._base = base
+        # level-one frame from CV holdout predictions
+        cols = {}
+        for m in base:
+            cvk = m._output.cv_predictions_key
+            if cvk is None:
+                raise ValueError(
+                    f"base model {m.key} lacks keep_cross_validation_predictions")
+            cvp = DKV.get(cvk)
+            arr = cvp.to_numpy()
+            if self._is_classifier and self.nclasses == 2:
+                cols[f"{m.key}"] = arr[:, -1]      # P(class1)
+            elif self._is_classifier:
+                for k in range(arr.shape[1]):
+                    cols[f"{m.key}_p{k}"] = arr[:, k]
+            else:
+                cols[f"{m.key}"] = arr[:, 0]
+        cols[di.response_name] = frame.vec(di.response_name).to_numpy() \
+            if frame.vec(di.response_name).type != "enum" else None
+        yv = frame.vec(di.response_name)
+        l1 = Frame.from_dict({k: v for k, v in cols.items() if v is not None})
+        l1[di.response_name] = yv
+        # metalearner (Metalearners.java default: GLM)
+        algo = (self.params.get("metalearner_algorithm") or "AUTO").lower()
+        mp = dict(self.params.get("metalearner_params") or {})
+        if algo in ("auto", "glm"):
+            from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+            mp.setdefault("lambda_", 0.0)
+            if not self._is_classifier or self.nclasses == 2:
+                mp.setdefault("non_negative", True)
+            meta = H2OGeneralizedLinearEstimator(**mp)
+        elif algo == "gbm":
+            from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator
+            meta = H2OGradientBoostingEstimator(**mp)
+        elif algo == "drf":
+            from h2o3_tpu.models.tree.drf import H2ORandomForestEstimator
+            meta = H2ORandomForestEstimator(**mp)
+        elif algo == "deeplearning":
+            from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+            meta = H2ODeepLearningEstimator(**mp)
+        else:
+            raise ValueError(f"metalearner {algo}")
+        meta.train(y=di.response_name, training_frame=l1)
+        self._meta = meta
+        DKV.remove(l1.key)
+        self._output.model_summary = {
+            "base_models": [m.key for m in base],
+            "metalearner": meta.algo,
+        }
+
+    def _level_one(self, test: Frame) -> Frame:
+        cols = {}
+        for m in self._base:
+            p = m.predict(test)
+            arr = p.to_numpy()
+            if self._is_classifier and self.nclasses == 2:
+                cols[f"{m.key}"] = arr[:, -1]
+            elif self._is_classifier:
+                for k in range(arr.shape[1] - 1):
+                    cols[f"{m.key}_p{k}"] = arr[:, 1 + k]
+            else:
+                cols[f"{m.key}"] = arr[:, 0]
+            DKV.remove(p.key)
+        return Frame.from_dict(cols)
+
+    def predict(self, test_data: Frame) -> Frame:
+        l1 = self._level_one(test_data)
+        out = self._meta.predict(l1)
+        DKV.remove(l1.key)
+        return out
+
+    def _compute_metrics(self, frame: Frame):
+        l1 = self._level_one(frame)
+        l1[self._dinfo.response_name] = frame.vec(self._dinfo.response_name)
+        m = self._meta._compute_metrics(l1)
+        DKV.remove(l1.key)
+        return m

@@ -471,6 +471,19 @@ class ModelBase:
             return pd.DataFrame(vi)
         return vi
 
+    # ---- export (h2o-genmodel surface) -----------------------------------
+    def download_mojo(self, path: str) -> str:
+        from h2o3_tpu.genmodel.mojo import export_mojo
+        return export_mojo(self, path)
+
+    save_mojo = download_mojo
+
+    def save_model_details(self, path: str) -> str:
+        import json
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, default=str)
+        return path
+
     def to_dict(self):
         o = self._output
         return {

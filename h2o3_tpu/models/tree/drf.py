@@ -1,10 +1,13 @@
 """DRF — hex/tree/drf/DRF.java: random forest on the shared histogram engine.
 
-Reference: DRF.java (357 LoC): independent trees on bootstrap-ish samples
-(sample_rate 0.632 without replacement), mtries column sampling (−1 → √C for
+Reference: DRF.java (357 LoC): independent trees on sampled rows (sample_rate
+0.632 without replacement), mtries column sampling per node (−1 → √C for
 classification, C/3 for regression), leaves predict in-leaf response means
 (class frequency for classification); ensemble prediction is the average.
 OOB scoring (reference default) is replaced by on-sample metrics this round.
+
+TPU-native: per-node mtries is drawn per (level, leaf) inside the fused level
+program (engine._level_step) from the tree's PRNG key — no host RNG.
 """
 
 from __future__ import annotations
@@ -32,43 +35,44 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
         K = self.nclasses
         ntrees = int(self.params["ntrees"])
         seed = int(self.params.get("seed") or -1)
-        rng = np.random.default_rng(seed if seed > 0 else 42)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
         grower = self._grower()
         mtries = int(self.params.get("mtries") or -1)
         if mtries == -1:
             mtries = max(1, int(math.sqrt(C))) if K > 1 else max(1, C // 3)
         elif mtries <= 0:
             mtries = C
-        gains = np.zeros(C, np.float64)
+        sample_rate = float(self.params["sample_rate"])
+        gains_tot = jnp.zeros(C, jnp.float32)
         if K > 2:
             onehot = jax.nn.one_hot(y.astype(jnp.int32), K)
             trees_k = [[] for _ in range(K)]
             for t in range(ntrees):
-                wt = self._sample_weights(w, rng,
-                                          float(self.params["sample_rate"]))
+                key, k1 = jax.random.split(key)
+                wt = self._sample_weights(w, k1, sample_rate)
                 for c in range(K):
-                    col, thr, nal, val, g = grower.grow(
-                        X, wt, onehot[:, c], rng=rng, mtries=mtries)
-                    gains += g
+                    key, kc = jax.random.split(key)
+                    col, thr, nal, val, heap, g = grower.grow(
+                        X, wt, onehot[:, c], key=kc, mtries=mtries)
+                    gains_tot = gains_tot + g
                     trees_k[c].append((col, thr, nal, val))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
-            self._trees_k = [self._finish_trees(tl, grower.D)
-                             for tl in trees_k]
+            self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
         else:
             trees = []
             for t in range(ntrees):
-                wt = self._sample_weights(w, rng,
-                                          float(self.params["sample_rate"]))
-                col, thr, nal, val, g = grower.grow(X, wt, y, rng=rng,
-                                                    mtries=mtries)
-                gains += g
+                key, k1, k2 = jax.random.split(key, 3)
+                wt = self._sample_weights(w, k1, sample_rate)
+                col, thr, nal, val, heap, g = grower.grow(X, wt, y, key=k2,
+                                                          mtries=mtries)
+                gains_tot = gains_tot + g
                 trees.append((col, thr, nal, val))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
-            self._trees = self._finish_trees(trees, grower.D)
-        self._varimp_from_gains(gains)
+            self._trees = E.stack_trees(trees, grower.D)
+        self._varimp_from_gains(np.asarray(gains_tot, np.float64))
         self._output.model_summary = {
             "number_of_trees": ntrees, "max_depth": grower.D,
-            "mtries": mtries, "sample_rate": self.params["sample_rate"],
+            "mtries": mtries, "sample_rate": sample_rate,
         }
 
     def _score_matrix(self, X):

@@ -7,23 +7,27 @@ double[] :59-70, merged in reduce :338, uniform-adaptive binning :41),
 hex/tree/DTree.java:514 (DecidedNode.bestCol — split scoring over bins),
 hex/tree/SharedTree.java:507 (buildLayer).
 
-TPU-native design — no CAS, no private copies, no reduce tree:
-  * Leaf assignment is a per-row int vector updated level-by-level
-    (phase-1 "score" fused into the previous level's split application).
+TPU-native design — no CAS, no private copies, no reduce tree, and (critical
+on real hardware) NO host↔device synchronization inside tree growth:
+  * One tree level == ONE fused jitted program (`_level_step`): adaptive
+    ranges → binning → histograms → split search → node-array writes → row
+    routing. The controller dispatches D async programs per tree and never
+    reads back until scoring time.
   * Uniform-adaptive bin ranges: per-(leaf,column) min/max are segment
-    reductions; each row re-bins against ITS leaf's range each level —
-    exactly DHistogram's adaptive-range semantics, fully vectorized.
+    reductions over IN-SAMPLE rows; each row re-bins against ITS leaf's range
+    each level — DHistogram's adaptive-range semantics, fully vectorized.
   * Histograms: hist[l,c,b,s] = Σ_r onehot_leaf[r,l]·stat_s[r]·onehot_bin[r,c,b].
-    For shallow levels this is evaluated as a dense matmul
-    (leaf·stat panel)ᵀ @ (bin one-hot) per column block — it rides the MXU,
-    and the row-contraction over the sharded dimension becomes one ICI
-    all-reduce (the entire MRTask reduce tree collapses into a psum).
-    For deep levels (many leaves) it switches to segment-sum (scatter-add)
-    on a combined (leaf,bin) index.
-  * Split search is one vectorized pass over (leaf, col, bin, na-dir) on
-    device — DecidedNode.bestCol without the per-node loop.
-  * Trees are dense heap-order arrays (CompressedTree analog), so ensemble
-    prediction is a fixed-depth gather loop — static shapes, jit-friendly.
+    Shallow levels evaluate this as a dense matmul (leaf·stat panel)ᵀ @
+    (bin one-hot) per column block — it rides the MXU, and the row
+    contraction over the sharded dimension becomes one ICI all-reduce (the
+    entire MRTask reduce tree collapses into a psum). Deep levels switch to
+    segment-sum on a combined (leaf,bin) index.
+  * Rows carry (leaf, heap-node) vectors; ALL rows are routed (so out-of-bag
+    rows get tree predictions for the F update) while histogram contributions
+    are weighted by the in-sample weights — H2O's sampling semantics.
+  * Trees are dense heap-order DEVICE arrays (CompressedTree analog);
+    training predictions are a gather val[heap] — no tree walk; ensemble
+    scoring is a fixed-depth gather loop — static shapes, jit-friendly.
 """
 
 from __future__ import annotations
@@ -41,37 +45,33 @@ _COL_BLOCK = 8
 
 
 # ===========================================================================
-# Per-level kernels (static over L=leaves-at-level, B=nbins, C=ncols)
-@functools.partial(jax.jit, static_argnames=("L",))
-def leaf_ranges(X, leaf, L):
-    """Per-(leaf,col) min/max over active rows → uniform-adaptive bin ranges.
-
-    X: (n, C) f32 with NaN for NA; leaf: (n,) int32 in [0,L), L = inactive.
-    """
+# Building blocks (called inside the fused level step; individually jitted
+# only for unit tests — nested jit inlines).
+def leaf_ranges(X, lv, L):
+    """Per-(leaf,col) min/max over in-sample rows (lv==L → excluded)."""
     big = jnp.float32(3.0e38)
     xmin = jnp.where(jnp.isnan(X), big, X)
     xmax = jnp.where(jnp.isnan(X), -big, X)
-    mn = jax.ops.segment_min(xmin, leaf, num_segments=L + 1)[:L]
-    mx = jax.ops.segment_max(xmax, leaf, num_segments=L + 1)[:L]
+    mn = jax.ops.segment_min(xmin, lv, num_segments=L + 1)[:L]
+    mx = jax.ops.segment_max(xmax, lv, num_segments=L + 1)[:L]
     return mn, mx
 
 
-@functools.partial(jax.jit, static_argnames=("B",))
-def bin_rows(X, leaf, mn, mx, B):
+def bin_rows(X, lv, mn, mx, B):
     """Adaptive binning: row r, col c → bin in [0,B); NA → bin B."""
-    lm = mn[leaf]                      # (n, C) gather of own-leaf ranges
-    lM = mx[leaf]
+    safe = jnp.minimum(lv, mn.shape[0] - 1)
+    lm = mn[safe]
+    lM = mx[safe]
     span = jnp.maximum(lM - lm, 1e-30)
     b = jnp.floor((X - lm) / span * B).astype(jnp.int32)
     b = jnp.clip(b, 0, B - 1)
     return jnp.where(jnp.isnan(X), B, b)
 
 
-@functools.partial(jax.jit, static_argnames=("L", "B"))
-def histogram_matmul(bins, leaf, stats, L, B):
+def histogram_matmul(bins, lv, stats, L, B):
     """hist (L, C, B+1, 3) via MXU: (n,L·3)ᵀ @ (n,CB·(B+1)) per column block."""
     n, C = bins.shape
-    oh_leaf = jax.nn.one_hot(leaf, L, dtype=jnp.float32)          # (n, L)
+    oh_leaf = jax.nn.one_hot(lv, L, dtype=jnp.float32)            # (n, L)
     W3 = (oh_leaf[:, :, None] * stats[:, None, :]).reshape(n, L * 3)
     nb = B + 1
     pad_c = (-C) % _COL_BLOCK
@@ -91,35 +91,34 @@ def histogram_matmul(bins, leaf, stats, L, B):
     return h.reshape(L, 3, C, nb).transpose(0, 2, 3, 1)
 
 
-@functools.partial(jax.jit, static_argnames=("L", "B"))
-def histogram_scatter(bins, leaf, stats, L, B):
+def histogram_scatter(bins, lv, stats, L, B):
     """Deep-tree path: segment-sum on combined (leaf·(B+1)+bin) per column."""
     n, C = bins.shape
     nb = B + 1
-    base = leaf * nb
+    base = lv * nb
 
     def one_col(c):
         idx = base + bins[:, c]
-        return jax.ops.segment_sum(stats, idx, num_segments=(L + 1) * nb)[: L * nb]
+        return jax.ops.segment_sum(stats, idx,
+                                   num_segments=(L + 1) * nb)[: L * nb]
 
     hs = jax.lax.map(one_col, jnp.arange(C))                      # (C, L·nb, 3)
     return hs.reshape(C, L, nb, 3).transpose(1, 0, 2, 3)
 
 
-def build_histograms(bins, leaf, stats, L, B):
-    if L * 3 <= _MATMUL_MAX_LEAVES * 3:
-        return histogram_matmul(bins, leaf, stats, L, B)
-    return histogram_scatter(bins, leaf, stats, L, B)
+def build_histograms(bins, lv, stats, L, B):
+    if L <= _MATMUL_MAX_LEAVES:
+        return histogram_matmul(bins, lv, stats, L, B)
+    return histogram_scatter(bins, lv, stats, L, B)
 
 
-# ===========================================================================
-@functools.partial(jax.jit, static_argnames=("B",))
 def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
                      col_mask, B):
-    """Vectorized DecidedNode.bestCol over every (leaf, col, threshold, NA-dir).
+    """Vectorized DecidedNode.bestCol over every (leaf, col, threshold,
+    NA-dir). col_mask: (L, C) bool — per-leaf column availability (mtries).
 
     hist: (L, C, B+1, 3); slot B is the NA bucket. Returns per-leaf arrays:
-      gain (L,), col (L,), thr_bin (L,), na_left (L,), plus child stat sums.
+      did, gain, col, thr, na_left, leaf_w, leaf_wy.
     Split at t ∈ [0,B-1): left = bins ≤ t (+NA if na_left), right = rest.
     """
     w = hist[..., 0]
@@ -156,7 +155,7 @@ def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
     g_left = gains(True)
     g = jnp.maximum(g_right, g_left)
     na_left = g_left > g_right
-    g = jnp.where(col_mask[None, :, None], g, -jnp.inf)
+    g = jnp.where(col_mask[:, :, None], g, -jnp.inf)
 
     L, C = tot_w.shape
     flat = g.reshape(L, C * (B - 1))
@@ -170,24 +169,77 @@ def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
     lmn = jnp.take_along_axis(mn, best_col[:, None], 1)[:, 0]
     lmx = jnp.take_along_axis(mx, best_col[:, None], 1)[:, 0]
     thr = lmn + (lmx - lmn) * (best_bin + 1).astype(jnp.float32) / B
-    did = best_gain > jnp.maximum(min_split_improvement, 0.0)
-    # leaf prediction stats (for terminal value): parent mean = Σwy/Σw
+    did = jnp.isfinite(best_gain) & \
+        (best_gain > jnp.maximum(min_split_improvement, 0.0))
     leaf_w = tot_w[:, 0]
     leaf_wy = tot_wy[:, 0]
     return did, best_gain, best_col, thr, best_nal, leaf_w, leaf_wy
 
 
-@jax.jit
-def apply_splits(X, leaf, active, did, col, thr, na_left):
-    """Phase-1 "score": route rows to child leaves; freeze terminal rows."""
-    c = col[leaf]
+# ===========================================================================
+# The fused per-level program — zero host syncs.
+@functools.partial(jax.jit, static_argnames=("d", "B", "mtries"))
+def _level_step(X, stats, w_in, leaf, heap, active, colA, thrA, nalA, valA,
+                gains, col_mask, key, *, d, B, mtries,
+                min_rows, min_split_improvement):
+    L = 2 ** d
+    C = X.shape[1]
+    in_sample = active & (w_in > 0)
+    lv = jnp.where(in_sample, leaf, L)
+    mn, mx = leaf_ranges(X, lv, L)
+    bins = bin_rows(X, lv, mn, mx, B)
+    hist = build_histograms(bins, lv, stats, L, B)
+    if mtries > 0 and mtries < C:
+        # per-leaf mtries column sampling (DRF per-node semantics)
+        r = jax.random.uniform(jax.random.fold_in(key, d), (L, C))
+        kth = jnp.sort(r, axis=1)[:, mtries - 1:mtries]
+        cmask = (r <= kth) & col_mask[None, :]
+    else:
+        cmask = jnp.broadcast_to(col_mask[None, :], (L, C))
+    did, gain, bcol, thr, nal, lw, lwy = find_best_splits(
+        hist, mn, mx, min_rows, min_split_improvement, cmask, B)
+    base = 2 ** d - 1
+    lvl_val = jnp.where(lw > 0, lwy / jnp.maximum(lw, 1e-30), 0.0)
+    colA = jax.lax.dynamic_update_slice(
+        colA, jnp.where(did, bcol, -1).astype(jnp.int32), (base,))
+    thrA = jax.lax.dynamic_update_slice(thrA, thr, (base,))
+    nalA = jax.lax.dynamic_update_slice(nalA, nal, (base,))
+    valA = jax.lax.dynamic_update_slice(valA, lvl_val.astype(jnp.float32),
+                                        (base,))
+    gains = gains.at[bcol].add(jnp.where(did, jnp.maximum(gain, 0.0), 0.0))
+    # route ALL rows in split nodes (OOB rows included — they need the tree's
+    # prediction), freeze rows in terminal nodes
+    c = bcol[leaf]
     t = thr[leaf]
     x = jnp.take_along_axis(X, c[:, None], axis=1)[:, 0]
     isna = jnp.isnan(x)
-    go_right = jnp.where(isna, ~na_left[leaf], x > t)
-    new_leaf = 2 * leaf + go_right.astype(jnp.int32)
+    go_right = jnp.where(isna, ~nal[leaf], x > t)
     splits = did[leaf] & active
-    return jnp.where(splits, new_leaf, 0), active & did[leaf]
+    leaf = jnp.where(splits, 2 * leaf + go_right.astype(jnp.int32), 0)
+    heap = jnp.where(splits, 2 * heap + 1 + go_right.astype(jnp.int32), heap)
+    active = splits
+    return leaf, heap, active, colA, thrA, nalA, valA, gains
+
+
+@functools.partial(jax.jit, static_argnames=("D",))
+def _final_leaves(stats, leaf, active, w_in, valA, *, D):
+    L = 2 ** D
+    lv = jnp.where(active & (w_in > 0), leaf, L)
+    sums = jax.ops.segment_sum(stats[:, :2], lv, num_segments=L + 1)[:L]
+    vals = jnp.where(sums[:, 0] > 0,
+                     sums[:, 1] / jnp.maximum(sums[:, 0], 1e-30),
+                     0.0).astype(jnp.float32)
+    return jax.lax.dynamic_update_slice(valA, vals, (2 ** D - 1,))
+
+
+@functools.partial(jax.jit, static_argnames=("nodes", "scale"))
+def gamma_pass(heap, w, res, hess, val, *, nodes, scale=1.0):
+    """GammaPass (GBM.java:1235) on device: Newton leaf Σw·res / Σw·hess."""
+    num = jax.ops.segment_sum(w * res, heap, num_segments=nodes)
+    den = jax.ops.segment_sum(w * hess, heap, num_segments=nodes)
+    return jnp.where(den > 1e-10,
+                     jnp.clip(scale * num / jnp.maximum(den, 1e-10), -19, 19),
+                     val).astype(jnp.float32)
 
 
 # ===========================================================================
@@ -195,11 +247,12 @@ def apply_splits(X, leaf, active, did, col, thr, na_left):
 @dataclass
 class TreeArrays:
     """One ensemble's trees as stacked dense arrays, heap node order:
-    node 0 = root; children of i are 2i+1 / 2i+2. Leaves carry values."""
-    col: np.ndarray       # (T, nodes) int32, -1 = leaf
-    thr: np.ndarray       # (T, nodes) f32
-    na_left: np.ndarray   # (T, nodes) bool
-    value: np.ndarray     # (T, nodes) f32 — prediction if stopped here
+    node 0 = root; children of i are 2i+1 / 2i+2. Leaves carry values.
+    Arrays may live on device (jnp) or host (np)."""
+    col: object       # (T, nodes) int32, -1 = leaf
+    thr: object       # (T, nodes) f32
+    na_left: object   # (T, nodes) bool
+    value: object     # (T, nodes) f32 — prediction if stopped here
     depth: int
 
     @property
@@ -207,11 +260,18 @@ class TreeArrays:
         return self.col.shape[0]
 
 
-def predict_ensemble(X, trees: TreeArrays, weights=None):
-    """Σ_t value[t, leaf_t(row)] — fixed-depth gather walk per tree.
+def stack_trees(tree_list, depth) -> TreeArrays:
+    """Stack per-tree device arrays into one ensemble — stays on device."""
+    return TreeArrays(
+        col=jnp.stack([t[0] for t in tree_list]),
+        thr=jnp.stack([t[1] for t in tree_list]),
+        na_left=jnp.stack([t[2] for t in tree_list]),
+        value=jnp.stack([t[3] for t in tree_list]),
+        depth=depth)
 
-    X: (n, C) f32 (NaN = NA). Returns (n,) f32. `weights`: per-tree scale.
-    """
+
+def predict_ensemble(X, trees: TreeArrays, weights=None):
+    """Σ_t value[t, leaf_t(row)] — fixed-depth gather walk per tree."""
     col = jnp.asarray(trees.col)
     thr = jnp.asarray(trees.thr)
     nal = jnp.asarray(trees.na_left)
@@ -221,7 +281,7 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
     depth = trees.depth
 
     @jax.jit
-    def run(X):
+    def run(X, col, thr, nal, val, tw):
         n = X.shape[0]
 
         def per_tree(acc, t):
@@ -241,22 +301,21 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
             return acc + tw[t] * val[t][node], None
 
         out, _ = jax.lax.scan(per_tree, jnp.zeros(n, jnp.float32),
-                              jnp.arange(trees.ntrees))
+                              jnp.arange(col.shape[0]))
         return out
 
-    return run(X)
+    return run(X, col, thr, nal, val, tw)
 
 
 def predict_leaf_ids(X, trees: TreeArrays):
-    """Per-(row, tree) terminal node ids and depths (isolation forest path
-    length; also SHAP later)."""
+    """Per-(row, tree) terminal node ids and depths (IF path length, SHAP)."""
     col = jnp.asarray(trees.col)
     thr = jnp.asarray(trees.thr)
     nal = jnp.asarray(trees.na_left)
     depth = trees.depth
 
     @jax.jit
-    def run(X):
+    def run(X, col, thr, nal):
         n = X.shape[0]
 
         def per_tree(_, t):
@@ -279,20 +338,16 @@ def predict_leaf_ids(X, trees: TreeArrays):
             return None, (node, dep)
 
         _, (nodes, deps) = jax.lax.scan(per_tree, None,
-                                        jnp.arange(trees.ntrees))
+                                        jnp.arange(col.shape[0]))
         return nodes, deps
 
-    return run(X)
+    return run(X, col, thr, nal)
 
 
 # ===========================================================================
 class TreeGrower:
-    """Grows ONE tree level-by-level; used by GBM/DRF/IF drivers.
-
-    The driver supplies per-row gradient stats each tree; the grower returns
-    heap-order arrays plus per-row final leaf ids (for leaf-value fitting à la
-    GBM's GammaPass).
-    """
+    """Grows ONE tree level-by-level with D async device programs and no host
+    round-trips. Returns device arrays; used by the GBM/DRF/IF drivers."""
 
     def __init__(self, nbins: int, max_depth: int, min_rows: float,
                  min_split_improvement: float):
@@ -302,62 +357,31 @@ class TreeGrower:
         self.msi = float(min_split_improvement)
         self.nodes = 2 ** (self.D + 1) - 1
 
-    def grow(self, X, w, grad, col_mask=None, rng=None, mtries: int = 0):
-        """X: (n,C) f32 NaN-NA; w: (n,) sample weights (0 = not in tree);
-        grad: (n,) target the tree regresses on (residual/gradient).
-        Returns (col, thr, na_left, value, leaf_final, gain_by_col)."""
+    def grow(self, X, w, grad, col_mask=None, key=None, mtries: int = 0):
+        """X: (n,C) f32 NaN-NA; w: (n,) in-sample weights (0 = out-of-bag);
+        grad: (n,) regression target (residual/gradient).
+
+        Returns device arrays (col, thr, na_left, value, heap, gains):
+        heap = per-row terminal node id (val[heap] = this tree's prediction).
+        """
         n, C = X.shape
-        B, D = self.B, self.D
         stats = jnp.stack([w, w * grad, w * grad * grad], axis=1)
         leaf = jnp.zeros(n, jnp.int32)
-        active = w > 0
-        col_arr = np.full(self.nodes, -1, np.int32)
-        thr_arr = np.zeros(self.nodes, np.float32)
-        nal_arr = np.zeros(self.nodes, bool)
-        val_arr = np.zeros(self.nodes, np.float32)
-        gain_by_col = np.zeros(C, np.float64)
+        heap = jnp.zeros(n, jnp.int32)
+        active = jnp.ones(n, bool)
+        colA = jnp.full(self.nodes, -1, jnp.int32)
+        thrA = jnp.zeros(self.nodes, jnp.float32)
+        nalA = jnp.zeros(self.nodes, bool)
+        valA = jnp.zeros(self.nodes, jnp.float32)
+        gains = jnp.zeros(C, jnp.float32)
         if col_mask is None:
             col_mask = jnp.ones(C, bool)
-        for d in range(D):
-            L = 2 ** d
-            lv = jnp.where(active, leaf, L)
-            mn, mx = leaf_ranges(X, lv, L)
-            bins = bin_rows(X, lv, mn, mx, B)
-            hist = build_histograms(bins, lv, stats, L, B)
-            cmask = col_mask
-            if mtries and mtries < C and rng is not None:
-                # per-leaf mtries is emulated per-level (DRF col sampling)
-                r = rng.random(C)
-                k = np.partition(r, mtries - 1)[mtries - 1]
-                cmask = jnp.asarray(r <= k) & col_mask
-            did, gain, bcol, thr, nal, lw, lwy = find_best_splits(
-                hist, mn, mx, self.min_rows, self.msi, cmask, B)
-            did_np = np.asarray(did)
-            gain_np = np.asarray(gain)
-            col_np = np.asarray(bcol)
-            base = 2 ** d - 1
-            lw_np = np.asarray(lw)
-            lwy_np = np.asarray(lwy)
-            ids = base + np.arange(L)
-            # record this level's decisions + fallback leaf means
-            val_arr[ids] = np.where(lw_np > 0, lwy_np / np.maximum(lw_np, 1e-30), 0.0)
-            col_arr[ids] = np.where(did_np, col_np, -1)
-            thr_arr[ids] = np.asarray(thr)
-            nal_arr[ids] = np.asarray(nal)
-            for l in np.nonzero(did_np)[0]:
-                gain_by_col[col_np[l]] += max(gain_np[l], 0.0)
-            if not did_np.any():
-                break
-            leaf, active = apply_splits(X, leaf, active, did, bcol,
-                                        jnp.asarray(thr), nal)
-        else:
-            # reached depth D: fit leaf means for the deepest layer
-            L = 2 ** D
-            lv = jnp.where(active, leaf, L)
-            sums = jax.ops.segment_sum(stats[:, :2], lv, num_segments=L + 1)[:L]
-            sums_np = np.asarray(sums)
-            ids = 2 ** D - 1 + np.arange(L)
-            val_arr[ids] = np.where(sums_np[:, 0] > 0,
-                                    sums_np[:, 1] / np.maximum(sums_np[:, 0], 1e-30),
-                                    0.0)
-        return col_arr, thr_arr, nal_arr, val_arr, gain_by_col
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        for d in range(self.D):
+            leaf, heap, active, colA, thrA, nalA, valA, gains = _level_step(
+                X, stats, w, leaf, heap, active, colA, thrA, nalA, valA,
+                gains, col_mask, key, d=d, B=self.B, mtries=int(mtries),
+                min_rows=self.min_rows, min_split_improvement=self.msi)
+        valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
+        return colA, thrA, nalA, valA, heap, gains

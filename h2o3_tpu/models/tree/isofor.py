@@ -4,17 +4,20 @@ Reference: random-split trees on row samples; isolation depth → anomaly score.
 H2O grows trees choosing a random column and a random threshold inside the
 node's observed [min,max] and scores rows by normalized mean path length.
 
-TPU-native design: no histograms needed — per level we only need per-(leaf,
-col) min/max (one segment reduction) to draw random thresholds; routing reuses
-the shared apply_splits kernel. Path length is encoded INTO the tree's value
-array (value[node] = depth(node) + c(node_size)), so scoring the ensemble is
-the same fixed-depth gather walk as GBM — mean path length = average of tree
+TPU-native design: no histograms — per level we need only per-(leaf,col)
+min/max (a segment reduction) to draw random (column, threshold) pairs from
+the tree's PRNG key, all inside ONE fused jitted level program (no host RNG,
+no round-trips). Path length is encoded INTO the tree's value array
+(value[node] = depth(node) + c(node_size)), so scoring the ensemble is the
+same fixed-depth gather walk as GBM — mean path length = average of tree
 "predictions"."""
 
 from __future__ import annotations
 
+import functools
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -22,15 +25,57 @@ from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.tree import engine as E
 from h2o3_tpu.models.tree.shared_tree import SharedTreeEstimator
 
+_EULER = 0.5772156649
 
-def _avg_path(n: float) -> float:
-    """c(n): average unsuccessful-search path length in a BST of n nodes."""
-    if n <= 1:
-        return 0.0
-    if n == 2:
-        return 1.0
-    h = math.log(n - 1) + 0.5772156649
-    return 2.0 * h - 2.0 * (n - 1) / n
+
+def _avg_path_jnp(n):
+    """c(n): average unsuccessful-search path length in a BST of n points."""
+    h = jnp.log(jnp.maximum(n - 1, 1.0)) + _EULER
+    c = 2.0 * h - 2.0 * (n - 1) / jnp.maximum(n, 1.0)
+    return jnp.where(n <= 1, 0.0, jnp.where(n < 2.5, 1.0, c))
+
+
+@functools.partial(jax.jit, static_argnames=("d",))
+def _iso_level(X, w, leaf, heap, active, colA, thrA, valA, key, *, d):
+    L = 2 ** d
+    C = X.shape[1]
+    lv = jnp.where(active & (w > 0), leaf, L)
+    mn, mx = E.leaf_ranges(X, lv, L)
+    cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
+    span = mx - mn
+    valid = span > 0
+    r = jax.random.uniform(jax.random.fold_in(key, 2 * d), (L, C))
+    c_sel = jnp.argmax(jnp.where(valid, r, -1.0), axis=1).astype(jnp.int32)
+    has = valid.any(axis=1)
+    u = jax.random.uniform(jax.random.fold_in(key, 2 * d + 1), (L,))
+    mn_s = jnp.take_along_axis(mn, c_sel[:, None], 1)[:, 0]
+    mx_s = jnp.take_along_axis(mx, c_sel[:, None], 1)[:, 0]
+    thr = mn_s + u * (mx_s - mn_s)
+    did = has & (cnt > 1.5)
+    base = 2 ** d - 1
+    val_lvl = (d + _avg_path_jnp(cnt)).astype(jnp.float32)
+    valA = jax.lax.dynamic_update_slice(valA, val_lvl, (base,))
+    colA = jax.lax.dynamic_update_slice(
+        colA, jnp.where(did, c_sel, -1).astype(jnp.int32), (base,))
+    thrA = jax.lax.dynamic_update_slice(thrA, thr.astype(jnp.float32), (base,))
+    # route
+    c = c_sel[leaf]
+    t = thr[leaf]
+    x = jnp.take_along_axis(X, c[:, None], axis=1)[:, 0]
+    go_right = jnp.where(jnp.isnan(x), False, x > t)
+    splits = did[leaf] & active
+    leaf = jnp.where(splits, 2 * leaf + go_right.astype(jnp.int32), 0)
+    heap = jnp.where(splits, 2 * heap + 1 + go_right.astype(jnp.int32), heap)
+    return leaf, heap, splits, colA, thrA, valA
+
+
+@functools.partial(jax.jit, static_argnames=("D",))
+def _iso_final(w, leaf, active, valA, *, D):
+    L = 2 ** D
+    lv = jnp.where(active & (w > 0), leaf, L)
+    cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
+    vals = (D + _avg_path_jnp(cnt)).astype(jnp.float32)
+    return jax.lax.dynamic_update_slice(valA, vals, (2 ** D - 1,))
 
 
 class H2OIsolationForestEstimator(SharedTreeEstimator):
@@ -45,84 +90,42 @@ class H2OIsolationForestEstimator(SharedTreeEstimator):
         X = di.matrix(frame)
         w = di.weights(frame)
         n = frame.nrows
-        C = X.shape[1]
         D = int(self.params["max_depth"])
         ntrees = int(self.params["ntrees"])
         seed = int(self.params.get("seed") or -1)
-        rng = np.random.default_rng(seed if seed > 0 else 42)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
         sample_size = int(self.params.get("sample_size") or 256)
         sample_rate = float(self.params.get("sample_rate") or -1.0)
         psi = (max(2, int(sample_rate * n)) if sample_rate > 0
                else min(sample_size, n))
         nodes = 2 ** (D + 1) - 1
-        wh = np.asarray(w)
-        live = np.nonzero(wh > 0)[0]
+        rate = psi / max(n, 1)
         trees = []
         for t in range(ntrees):
-            idx = rng.choice(live, size=min(psi, len(live)), replace=False)
-            wt = np.zeros(len(wh), np.float32)
-            wt[idx] = 1.0
-            wtj = jnp.asarray(wt)
-            col, thr, nal, val = self._grow_random_tree(X, wtj, C, D, nodes, rng)
-            trees.append((col, thr, nal, val))
+            key, k1, k2 = jax.random.split(key, 3)
+            # ψ-row subsample via bernoulli rate (device-side; avoids a host
+            # choice() round-trip; E[rows] = ψ like the reference's sampler)
+            wt = w * (jax.random.uniform(k1, w.shape) < rate)
+            leaf = jnp.zeros(X.shape[0], jnp.int32)
+            heap = jnp.zeros(X.shape[0], jnp.int32)
+            active = jnp.ones(X.shape[0], bool)
+            colA = jnp.full(nodes, -1, jnp.int32)
+            thrA = jnp.zeros(nodes, jnp.float32)
+            valA = jnp.zeros(nodes, jnp.float32)
+            for d in range(D):
+                leaf, heap, active, colA, thrA, valA = _iso_level(
+                    X, wt, leaf, heap, active, colA, thrA, valA, k2, d=d)
+            valA = _iso_final(wt, leaf, active, valA, D=D)
+            trees.append((colA, thrA, jnp.zeros(nodes, bool), valA))
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
-        self._trees = self._finish_trees(trees, D)
+        self._trees = E.stack_trees(trees, D)
         self._psi = psi
-        # score training data to calibrate min/max path length (H2O exposes
-        # normalized score via observed min/max mean lengths)
+        # calibrate observed min/max mean path length (one sync, end of fit)
         ml = np.asarray(self._mean_length(X))[:n]
         self._min_len, self._max_len = float(ml.min()), float(ml.max())
         self._output.model_summary = {
             "number_of_trees": ntrees, "max_depth": D, "sample_size": psi,
         }
-
-    def _grow_random_tree(self, X, w, C, D, nodes, rng):
-        col_arr = np.full(nodes, -1, np.int32)
-        thr_arr = np.zeros(nodes, np.float32)
-        nal_arr = np.zeros(nodes, bool)
-        val_arr = np.zeros(nodes, np.float32)
-        leaf = jnp.zeros(X.shape[0], jnp.int32)
-        active = w > 0
-        import jax
-        for d in range(D):
-            L = 2 ** d
-            lv = jnp.where(active, leaf, L)
-            mn, mx = E.leaf_ranges(X, lv, L)
-            cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
-            mn_np = np.asarray(mn)
-            mx_np = np.asarray(mx)
-            cnt_np = np.asarray(cnt)
-            base = 2 ** d - 1
-            did = np.zeros(L, bool)
-            cols = np.zeros(L, np.int32)
-            thrs = np.zeros(L, np.float32)
-            for l in range(L):
-                # record path-length value in case this node terminalizes
-                val_arr[base + l] = d + _avg_path(cnt_np[l])
-                span = mx_np[l] - mn_np[l]
-                cand = np.nonzero(span > 0)[0]
-                if cnt_np[l] > 1 and len(cand) > 0 and d < D:
-                    c = int(rng.choice(cand))
-                    u = rng.random()
-                    cols[l] = c
-                    thrs[l] = mn_np[l, c] + u * span[c]
-                    did[l] = True
-            col_arr[base:base + L] = np.where(did, cols, -1)
-            thr_arr[base:base + L] = thrs
-            if not did.any():
-                break
-            leaf, active = E.apply_splits(
-                X, leaf, active, jnp.asarray(did), jnp.asarray(cols),
-                jnp.asarray(thrs), jnp.asarray(np.zeros(L, bool)))
-        # deepest level values
-        L = 2 ** D
-        import jax
-        lv = jnp.where(active, leaf, L)
-        cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
-        cnt_np = np.asarray(cnt)
-        for l in range(L):
-            val_arr[2 ** D - 1 + l] = D + _avg_path(cnt_np[l])
-        return col_arr, thr_arr, nal_arr, val_arr
 
     # ---- scoring ---------------------------------------------------------
     def _mean_length(self, X):

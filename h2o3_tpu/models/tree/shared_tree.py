@@ -5,15 +5,15 @@ Reference: SharedTree.java:208 (Driver), :440 (scoreAndBuildTrees), :507
 (buildNextKTrees), :981 (ComputePredAndRes), :1235 (GammaPass leaf refit),
 :776 (fitBestConstants), DRF.java (mtries column sampling, 0.632 sampling).
 
-TPU-native design: the driver is a controller loop; each level of each tree is
-a handful of jitted device programs (bin → histogram-matmul → split-search →
-route), with all cross-shard reduction via XLA collectives. The K trees of a
-multinomial iteration are built sequentially (the chips are already saturated
-by one tree's histograms — concurrency across trees bought H2O idle-CPU
-utilization, not algorithmic speedup). Residuals (ComputePredAndRes) and leaf
-refits (GammaPass) are single fused passes; training-frame predictions are
-maintained incrementally in F, so periodic scoring costs one metrics pass, not
-a rescore.
+TPU-native design: the driver is a controller loop dispatching async device
+programs; each tree is max_depth fused level-programs + one residual pass +
+one GammaPass — nothing synchronizes to the host except periodic scoring
+(score_tree_interval), so the chips never idle on controller round-trips.
+The K trees of a multinomial iteration run sequentially (one tree's
+histograms already saturate the chips; H2O's tree-level concurrency bought
+idle-CPU utilization, not algorithmic speedup). Training-frame predictions
+are maintained incrementally: each grown tree's per-row terminal node comes
+back from the router (val[heap]), so F-updates are gathers, not tree walks.
 """
 
 from __future__ import annotations
@@ -63,28 +63,21 @@ class SharedTreeEstimator(ModelBase):
                             min_rows=float(p["min_rows"]),
                             min_split_improvement=float(p["min_split_improvement"]))
 
-    def _sample_weights(self, w, rng, rate):
+    def _sample_weights(self, w, key, rate):
+        """Per-tree row sampling — on device (no host RNG round-trip)."""
         if rate >= 1.0:
             return w
-        u = rng.random(w.shape[0]).astype(np.float32)
-        return w * jnp.asarray(u < rate)
+        u = jax.random.uniform(key, w.shape)
+        return w * (u < rate)
 
-    def _col_mask(self, C, rng):
+    def _col_mask(self, C, key):
         rate = float(self.params.get("col_sample_rate_per_tree") or 1.0)
         if rate >= 1.0:
             return None
         k = max(1, int(round(rate * C)))
-        r = rng.random(C)
-        thr = np.partition(r, k - 1)[k - 1]
-        return jnp.asarray(r <= thr)
-
-    def _finish_trees(self, tree_list, depth) -> E.TreeArrays:
-        return E.TreeArrays(
-            col=np.stack([t[0] for t in tree_list]),
-            thr=np.stack([t[1] for t in tree_list]),
-            na_left=np.stack([t[2] for t in tree_list]),
-            value=np.stack([t[3] for t in tree_list]),
-            depth=depth)
+        r = jax.random.uniform(key, (C,))
+        kth = jnp.sort(r)[k - 1]
+        return r <= kth
 
     def _varimp_from_gains(self, gains: np.ndarray):
         names = self._dinfo.feature_names
@@ -121,7 +114,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         ntrees = int(self.params["ntrees"])
         lr = float(self.params["learn_rate"])
         seed = int(self.params.get("seed") or -1)
-        rng = np.random.default_rng(seed if seed > 0 else 42)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
         grower = self._grower()
         wsum = float(np.asarray(jnp.sum(w)))
         ysum = float(np.asarray(jnp.sum(w * y)))
@@ -136,33 +129,30 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             f0 = ybar
         self._f0 = f0
         F = jnp.full(X.shape[0], f0, jnp.float32)
-        trees, gains = [], np.zeros(X.shape[1], np.float64)
+        sample_rate = float(self.params["sample_rate"])
+        trees = []
+        gains_tot = jnp.zeros(X.shape[1], jnp.float32)
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
         for t in range(ntrees):
+            key, k1, k2, k3 = jax.random.split(key, 4)
             res, hess = _grad_hess(dist, F, y)
-            wt = self._sample_weights(w, rng, float(self.params["sample_rate"]))
-            cmask = self._col_mask(X.shape[1], rng)
-            mtries = 0
-            col, thr, nal, val, g = grower.grow(X, wt, res, col_mask=cmask,
-                                                rng=rng, mtries=mtries)
-            gains += g
-            ta = E.TreeArrays(col=col[None], thr=thr[None],
-                              na_left=nal[None], value=val[None],
-                              depth=grower.D)
-            # GammaPass: refit terminal values with the distribution's Newton
-            nodes, _ = E.predict_leaf_ids(X, ta)
-            node = nodes[0]
-            val = _gamma_pass(dist, node, wt, res, hess, val, grower.nodes)
-            ta.value = val[None]
+            wt = self._sample_weights(w, k1, sample_rate)
+            cmask = self._col_mask(X.shape[1], k2)
+            col, thr, nal, val, heap, g = grower.grow(X, wt, res,
+                                                      col_mask=cmask, key=k3)
+            gains_tot = gains_tot + g
+            if dist != "gaussian":   # GammaPass Newton refit (device)
+                val = E.gamma_pass(heap, wt, res, hess, val,
+                                   nodes=grower.nodes)
             trees.append((col, thr, nal, val))
-            F = F + lr * E.predict_ensemble(X, ta)
+            F = F + lr * val[heap]
             if (t + 1) % interval == 0 or t == ntrees - 1:
                 self._record_history(t + 1, F, y, w, dist)
                 if self._should_stop():
                     break
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
-        self._trees = self._finish_trees(trees, grower.D)
-        self._varimp_from_gains(gains)
+        self._trees = E.stack_trees(trees, grower.D)
+        self._varimp_from_gains(np.asarray(gains_tot, np.float64))
         self._output.model_summary = {
             "number_of_trees": self._trees.ntrees, "max_depth": grower.D,
             "distribution": dist, "learn_rate": lr, "init_f": f0,
@@ -173,52 +163,48 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         ntrees = int(self.params["ntrees"])
         lr = float(self.params["learn_rate"])
         seed = int(self.params.get("seed") or -1)
-        rng = np.random.default_rng(seed if seed > 0 else 42)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
         grower = self._grower()
         yi = y.astype(jnp.int32)
         wn = np.asarray(w, np.float64)
-        # init: log class priors
-        f0 = np.zeros(K, np.float32)
         yin = np.asarray(yi)
+        f0 = np.zeros(K, np.float32)
         for c in range(K):
             pc = (wn * (yin == c)).sum() / max(wn.sum(), 1e-30)
             f0[c] = math.log(max(pc, 1e-10))
         self._f0 = f0
         F = jnp.tile(jnp.asarray(f0)[None, :], (X.shape[0], 1))
         trees_k = [[] for _ in range(K)]
-        gains = np.zeros(X.shape[1], np.float64)
+        gains_tot = jnp.zeros(X.shape[1], jnp.float32)
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
         onehot = jax.nn.one_hot(yi, K)
+        sample_rate = float(self.params["sample_rate"])
         for t in range(ntrees):
+            key, k1, k2 = jax.random.split(key, 3)
             P = jax.nn.softmax(F, axis=1)
             R = onehot - P                       # (n, K) residuals
-            wt = self._sample_weights(w, rng, float(self.params["sample_rate"]))
-            cmask = self._col_mask(X.shape[1], rng)
+            wt = self._sample_weights(w, k1, sample_rate)
+            cmask = self._col_mask(X.shape[1], k2)
             newF = []
             for c in range(K):
+                key, kc = jax.random.split(key)
                 res = R[:, c]
-                col, thr, nal, val, g = grower.grow(X, wt, res,
-                                                    col_mask=cmask, rng=rng)
-                gains += g
-                ta = E.TreeArrays(col=col[None], thr=thr[None],
-                                  na_left=nal[None], value=val[None],
-                                  depth=grower.D)
-                nodes, _ = E.predict_leaf_ids(X, ta)
-                # multinomial GammaPass: (K-1)/K · Σr / Σ|r|(1−|r|)
+                col, thr, nal, val, heap, g = grower.grow(
+                    X, wt, res, col_mask=cmask, key=kc)
+                gains_tot = gains_tot + g
                 absr = jnp.abs(res)
-                val = _gamma_generic(nodes[0], wt, res, absr * (1 - absr),
-                                     val, grower.nodes, scale=(K - 1) / K)
-                ta.value = val[None]
+                val = E.gamma_pass(heap, wt, res, absr * (1 - absr), val,
+                                   nodes=grower.nodes, scale=(K - 1) / K)
                 trees_k[c].append((col, thr, nal, val))
-                newF.append(F[:, c] + lr * E.predict_ensemble(X, ta))
+                newF.append(F[:, c] + lr * val[heap])
             F = jnp.stack(newF, axis=1)
             if (t + 1) % interval == 0 or t == ntrees - 1:
                 self._record_history_multi(t + 1, F, y, w)
                 if self._should_stop():
                     break
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"iter {t+1}")
-        self._trees_k = [self._finish_trees(tl, grower.D) for tl in trees_k]
-        self._varimp_from_gains(gains)
+        self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
+        self._varimp_from_gains(np.asarray(gains_tot, np.float64))
         self._output.model_summary = {
             "number_of_trees": sum(t.ntrees for t in self._trees_k),
             "max_depth": grower.D, "distribution": "multinomial",
@@ -238,13 +224,12 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
     # ---- scoring history / early stopping -------------------------------
     def _record_history(self, ntrees, F, y, w, dist):
         mu = _link_inv_dist(dist, F)
+        from h2o3_tpu.models import metrics as M
         if self._is_classifier:
-            from h2o3_tpu.models import metrics as M
             m = M.binomial_metrics(y, mu[:, 1], w)
             h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
                  "training_auc": m.auc, "training_rmse": m.rmse}
         else:
-            from h2o3_tpu.models import metrics as M
             m = M.regression_metrics(y, mu, w)
             h = {"number_of_trees": ntrees, "training_rmse": m.rmse,
                  "training_mae": m.mae}
@@ -297,7 +282,6 @@ def _grad_hess(dist, F, y):
         mu = jnp.exp(F)
         return y / mu - 1.0, y / mu
     if dist == "tweedie":
-        # variance power p fixed 1.5 default
         mu = jnp.exp(F)
         return y * jnp.power(mu, -0.5) - jnp.power(mu, 0.5), \
             0.5 * (y * jnp.power(mu, -0.5) + jnp.power(mu, 0.5))
@@ -313,21 +297,3 @@ def _link_inv_dist(dist, F):
     if dist in ("poisson", "gamma", "tweedie"):
         return jnp.exp(F)
     return F
-
-
-def _gamma_pass(dist, node, w, res, hess, val, nodes):
-    """GammaPass (GBM.java:1235): Newton leaf value Σw·res / Σw·hess."""
-    if dist == "gaussian":
-        return val  # leaf mean of residuals already optimal
-    return _gamma_generic(node, w, res, hess, val, nodes)
-
-
-def _gamma_generic(node, w, res, hess, val, nodes, scale=1.0):
-    num = jax.ops.segment_sum(w * res, node, num_segments=nodes)
-    den = jax.ops.segment_sum(w * hess, node, num_segments=nodes)
-    num = np.asarray(num)
-    den = np.asarray(den)
-    out = val.copy()
-    nz = den > 1e-10
-    out[nz] = np.clip(scale * num[nz] / den[nz], -19, 19)
-    return out.astype(np.float32)

@@ -1,0 +1,92 @@
+"""Scoring-artifact parity tests — the testdir_javapredict pattern: in-cluster
+predictions vs exported-artifact predictions must match (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.models
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.genmodel.mojo import MojoModel
+
+
+def _binary_frame(n=300, seed=2):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 4))
+    y = (1.2 * X[:, 0] - X[:, 1] + rng.normal(0, 0.3, n) > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    return Frame.from_dict(cols), X
+
+
+def _rows(X, names):
+    return [{c: float(X[i, j]) for j, c in enumerate(names)}
+            for i in range(len(X))]
+
+
+def test_gbm_mojo_parity(tmp_path):
+    f, X = _binary_frame()
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=f)
+    p_cluster = gbm.predict(f).vec("pp").to_numpy()
+    mj = tmp_path / "gbm.mojo"
+    gbm.download_mojo(str(mj))
+    scorer = MojoModel.load(str(mj))
+    out = scorer.predict(_rows(X, [f"x{j}" for j in range(4)]))
+    np.testing.assert_allclose(out["probs"][:, 1], p_cluster, atol=1e-5)
+
+
+def test_glm_mojo_parity(tmp_path):
+    f, X = _binary_frame()
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="binomial", lambda_=0.0)
+    glm.train(y="y", training_frame=f)
+    p_cluster = glm.predict(f).vec("pp").to_numpy()
+    mj = tmp_path / "glm.mojo"
+    glm.download_mojo(str(mj))
+    out = MojoModel.load(str(mj)).predict(_rows(X, [f"x{j}" for j in range(4)]))
+    np.testing.assert_allclose(out["probs"][:, 1], p_cluster, atol=2e-4)
+
+
+def test_kmeans_mojo(tmp_path):
+    f, X = _binary_frame()
+    km = h2o3_tpu.models.H2OKMeansEstimator(k=2, seed=1, standardize=False)
+    km.train(x=[f"x{j}" for j in range(4)], training_frame=f)
+    pred = km.predict(f).vec("predict").to_numpy()
+    mj = tmp_path / "km.mojo"
+    km.download_mojo(str(mj))
+    out = MojoModel.load(str(mj)).predict(_rows(X, [f"x{j}" for j in range(4)]))
+    np.testing.assert_array_equal(out["cluster"], pred.astype(int))
+
+
+def test_binary_save_load(tmp_path):
+    f, X = _binary_frame()
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=5, max_depth=3, seed=1)
+    gbm.train(y="y", training_frame=f)
+    p1 = gbm.predict(f).vec("pp").to_numpy()
+    path = str(tmp_path / "model.bin")
+    h2o3_tpu.save_model(gbm, path)
+    h2o3_tpu.remove(gbm.key)
+    m2 = h2o3_tpu.load_model(path)
+    p2 = m2.predict(f).vec("pp").to_numpy()
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_categorical_mojo(tmp_path):
+    rng = np.random.default_rng(3)
+    cat = np.array(["a", "b", "c"], object)[rng.integers(0, 3, 200)]
+    x = rng.normal(0, 1, 200)
+    y = (x + (cat == "b") * 2 > 0.5).astype(int)
+    f = Frame.from_dict({"cat": cat, "x": x,
+                         "y": np.array(["n", "p"], object)[y]})
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="binomial", lambda_=0.0)
+    glm.train(y="y", training_frame=f)
+    p_cluster = glm.predict(f).vec("pp").to_numpy()
+    mj = tmp_path / "cat.mojo"
+    glm.download_mojo(str(mj))
+    rows = [{"cat": c, "x": float(v)} for c, v in zip(cat, x)]
+    out = MojoModel.load(str(mj)).predict(rows)
+    np.testing.assert_allclose(out["probs"][:, 1], p_cluster, atol=2e-4)

@@ -1,0 +1,96 @@
+"""Grid search / StackedEnsemble / TargetEncoder / AutoML tests
+(mirrors h2o-automl and hex/grid test intent)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.models
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.grid import H2OGridSearch
+from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+from h2o3_tpu.models.target_encoder import H2OTargetEncoderEstimator
+from h2o3_tpu.automl.automl import H2OAutoML
+
+
+def _binary_frame(n=400, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 5))
+    logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] + 0.5 * X[:, 2]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(5)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    return Frame.from_dict(cols)
+
+
+def test_grid_search_cartesian():
+    f = _binary_frame()
+    g = H2OGridSearch(h2o3_tpu.models.H2OGradientBoostingEstimator,
+                      {"max_depth": [2, 4], "learn_rate": [0.1, 0.3]})
+    g.train(y="y", training_frame=f, ntrees=5, seed=1)
+    assert len(g) == 4
+    best = g.get_grid(sort_by="auc")[0]
+    assert best.auc() > 0.8
+    assert not g.failures
+
+
+def test_grid_random_discrete_budget():
+    f = _binary_frame()
+    g = H2OGridSearch(h2o3_tpu.models.H2OGradientBoostingEstimator,
+                      {"max_depth": [2, 3, 4, 5], "learn_rate": [0.05, 0.1, 0.2]},
+                      search_criteria={"strategy": "RandomDiscrete",
+                                       "max_models": 3, "seed": 42})
+    g.train(y="y", training_frame=f, ntrees=3, seed=1)
+    assert len(g) == 3
+
+
+def test_stacked_ensemble():
+    f = _binary_frame(500)
+    common = dict(nfolds=3, keep_cross_validation_predictions=True, seed=7)
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=10, max_depth=3, **common)
+    gbm.train(y="y", training_frame=f)
+    glm = h2o3_tpu.models.H2OGeneralizedLinearEstimator(
+        family="binomial", lambda_=0.0, **common)
+    glm.train(y="y", training_frame=f)
+    se = H2OStackedEnsembleEstimator(base_models=[gbm, glm])
+    se.train(y="y", training_frame=f)
+    m = se.model_performance(f)
+    base_auc = max(gbm._output.cross_validation_metrics.auc,
+                   glm._output.cross_validation_metrics.auc)
+    assert m.auc > base_auc - 0.05   # ensemble shouldn't be much worse
+    p = se.predict(f)
+    assert p.nrows == 500
+
+
+def test_target_encoder():
+    rng = np.random.default_rng(5)
+    lvls = np.array(["a", "b", "c"], object)
+    codes = rng.integers(0, 3, 300)
+    means = np.array([0.2, 0.5, 0.8])
+    y = (rng.random(300) < means[codes]).astype(float)
+    f = Frame.from_dict({"cat": lvls[codes], "y": y})
+    te = H2OTargetEncoderEstimator(blending=True, inflection_point=5,
+                                   smoothing=10)
+    te.train(x=["cat"], y="y", training_frame=f)
+    out = te.transform(f)
+    assert "cat_te" in out.names
+    enc = out.vec("cat_te").to_numpy()
+    # encoded value should correlate with the level's true rate
+    for lvl, mu in enumerate(means):
+        sel = codes == lvl
+        assert abs(enc[sel].mean() - y[sel].mean()) < 0.15
+
+
+def test_automl_smoke():
+    f = _binary_frame(300)
+    aml = H2OAutoML(max_models=3, seed=1, nfolds=3)
+    aml.train(y="y", training_frame=f)
+    assert aml.leader is not None
+    lb = aml.leaderboard
+    assert len(lb) >= 3
+    # leader sorted by auc descending
+    aucs = lb["auc"].to_numpy()
+    assert aucs[0] == max(aucs)
+    p = aml.predict(f)
+    assert p.nrows == 300
