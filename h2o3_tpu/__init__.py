@@ -59,3 +59,26 @@ def import_mojo(path):
     """Load a scoring artifact (h2o.import_mojo → generic model)."""
     from h2o3_tpu.genmodel.mojo import MojoModel
     return MojoModel.load(path)
+
+
+def create_frame(**kw):
+    """Random frame generator (h2o.create_frame)."""
+    from h2o3_tpu.utils.create_frame import create_frame as _cf
+    return _cf(**kw)
+
+
+def rapids(expr, session=None):
+    """Evaluate a Rapids expression (h2o.rapids)."""
+    from h2o3_tpu.rapids import rapids_exec
+    return rapids_exec(expr, session)
+
+
+def export_file(frame, path):
+    """Frame snapshot export (h2o.export_file — .hex format here)."""
+    from h2o3_tpu.io.persist import export_frame
+    return export_frame(frame, path)
+
+
+def automl(**kw):
+    from h2o3_tpu.automl import H2OAutoML
+    return H2OAutoML(**kw)

@@ -1,0 +1,80 @@
+"""ctypes bridge to the native CSV parser (native/fastcsv.cpp).
+
+The reference's ingest hot loop is a JVM per-byte tokenizer
+(water/parser/CsvParser.java); here it's a C++ pass exporting column-major
+doubles + a string side table over a C ABI (no pybind11 in the image).
+Build: `make -C native` (or scripts/build_native.sh); the Python parser falls
+back to the csv module when the library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        here = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(here, "native", "libfastcsv.so")
+        lib = ctypes.CDLL(path)
+        lib.fastcsv_parse.restype = ctypes.c_void_p
+        lib.fastcsv_parse.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                      ctypes.c_int]
+        lib.fastcsv_nrows.restype = ctypes.c_int64
+        lib.fastcsv_nrows.argtypes = [ctypes.c_void_p]
+        lib.fastcsv_ncols.restype = ctypes.c_int64
+        lib.fastcsv_ncols.argtypes = [ctypes.c_void_p]
+        lib.fastcsv_col_data.restype = ctypes.POINTER(ctypes.c_double)
+        lib.fastcsv_col_data.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastcsv_col_nstr.restype = ctypes.c_int64
+        lib.fastcsv_col_nstr.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastcsv_col_na.restype = ctypes.c_int64
+        lib.fastcsv_col_na.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastcsv_str_row.restype = ctypes.c_int64
+        lib.fastcsv_str_row.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_int64]
+        lib.fastcsv_str_val.restype = ctypes.c_char_p
+        lib.fastcsv_str_val.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_int64]
+        lib.fastcsv_free.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    try:
+        _lib()
+        return True
+    except OSError:
+        return False
+
+
+def parse_columns(path: str, sep: str, header: bool):
+    """Returns list of (numeric ndarray, {row: str}) per column."""
+    lib = _lib()
+    h = lib.fastcsv_parse(path.encode(), sep.encode(), 1 if header else 0)
+    if not h:
+        raise IOError(f"fastcsv failed on {path}")
+    try:
+        nrows = lib.fastcsv_nrows(h)
+        ncols = lib.fastcsv_ncols(h)
+        out = []
+        for j in range(ncols):
+            ptr = lib.fastcsv_col_data(h, j)
+            arr = np.ctypeslib.as_array(ptr, shape=(nrows,)).copy()
+            nstr = lib.fastcsv_col_nstr(h, j)
+            smap = {}
+            for i in range(nstr):
+                smap[lib.fastcsv_str_row(h, j, i)] = \
+                    lib.fastcsv_str_val(h, j, i).decode("utf-8", "replace")
+            out.append((arr, smap))
+        return out
+    finally:
+        lib.fastcsv_free(h)

@@ -145,6 +145,9 @@ def parse(path: str, setup: Optional[ParseSetup] = None,
         return _parse_arff(path, setup, destination_frame)
     if setup.parse_type == "SVMLight":
         return _parse_svmlight(path, destination_frame)
+    native = _native_parse(path, setup, destination_frame, col_types)
+    if native is not None:
+        return native
     cols = _tokenize_csv(path, setup)
     names = list(setup.column_names)
     types = list(setup.column_types)
@@ -162,9 +165,6 @@ def parse(path: str, setup: Optional[ParseSetup] = None,
 
 def _tokenize_csv(path: str, setup: ParseSetup) -> list:
     """Return list of per-column python lists of token strings."""
-    native = _try_native_tokenizer(path, setup)
-    if native is not None:
-        return native
     import csv
     cols: list[list] = []
     with _open_text(path) as f:
@@ -184,13 +184,49 @@ def _tokenize_csv(path: str, setup: ParseSetup) -> list:
     return cols
 
 
-def _try_native_tokenizer(path: str, setup: ParseSetup):
-    """Use the C++ fast tokenizer if built (native/fastcsv.cpp)."""
+def _native_parse(path: str, setup: ParseSetup, dest, col_types):
+    """C++ fast path (native/fastcsv.cpp): numeric columns arrive as doubles,
+    categorical/string columns are rebuilt from the native string table."""
+    if path.endswith((".gz", ".zip")):
+        return None  # native path reads raw files; compressed → python path
     try:
         from h2o3_tpu.io import fastcsv
-        return fastcsv.tokenize(path, setup.separator, setup.header)
+        if not fastcsv.available():
+            return None
+        cols = fastcsv.parse_columns(path, setup.separator, setup.header)
     except Exception:
         return None
+    names = list(setup.column_names)
+    types = list(setup.column_types)
+    while len(names) < len(cols):
+        names.append(f"C{len(names)+1}")
+        types.append(T_CAT)
+    if col_types:
+        for k, v in col_types.items():
+            if k in names:
+                types[names.index(k)] = v
+    vecs = []
+    for j, (num, smap) in enumerate(cols):
+        t = types[j] if j < len(types) else T_CAT
+        if t == T_NUM:
+            vecs.append(Vec.from_numpy(num, type=T_NUM))
+        elif t == T_TIME:
+            out = num.copy()
+            for i, s in smap.items():
+                try:
+                    out[i] = _parse_time_ms(s)
+                except ValueError:
+                    out[i] = np.nan
+            vecs.append(Vec.from_numpy(out, type=T_TIME))
+        else:  # enum / str: reconstruct token strings
+            toks = np.empty(len(num), object)
+            isnan = np.isnan(num)
+            for i in range(len(num)):
+                toks[i] = None if isnan[i] else ("%g" % num[i])
+            for i, s in smap.items():
+                toks[i] = s
+            vecs.append(Vec.from_numpy(toks, type=T_STR if t == T_STR else None))
+    return Frame(names[: len(vecs)], vecs, dest)
 
 
 def _column_to_vec(tokens: list, vtype: str) -> Vec:

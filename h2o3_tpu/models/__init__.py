@@ -9,6 +9,18 @@ from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
 from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
 from h2o3_tpu.models.glrm import H2OGeneralizedLowRankEstimator
 from h2o3_tpu.models.naive_bayes import H2ONaiveBayesEstimator
+from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
+from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
+from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+from h2o3_tpu.models.grid import H2OGridSearch
+from h2o3_tpu.models.target_encoder import H2OTargetEncoderEstimator
+from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
+from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.extended_isofor import H2OExtendedIsolationForestEstimator
+from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
+from h2o3_tpu.models.generic import H2OGenericEstimator
+from h2o3_tpu.models.segments import train_segments, SegmentModels
 
 ESTIMATORS = {
     "kmeans": H2OKMeansEstimator,
@@ -20,4 +32,14 @@ ESTIMATORS = {
     "pca": H2OPrincipalComponentAnalysisEstimator,
     "glrm": H2OGeneralizedLowRankEstimator,
     "naivebayes": H2ONaiveBayesEstimator,
+    "svd": H2OSingularValueDecompositionEstimator,
+    "aggregator": H2OAggregatorEstimator,
+    "stackedensemble": H2OStackedEnsembleEstimator,
+    "targetencoder": H2OTargetEncoderEstimator,
+    "word2vec": H2OWord2vecEstimator,
+    "coxph": H2OCoxProportionalHazardsEstimator,
+    "extendedisolationforest": H2OExtendedIsolationForestEstimator,
+    "gam": H2OGeneralizedAdditiveEstimator,
+    "rulefit": H2ORuleFitEstimator,
+    "generic": H2OGenericEstimator,
 }

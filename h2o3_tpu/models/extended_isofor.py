@@ -1,0 +1,163 @@
+"""Extended Isolation Forest — hex/tree/isoforextended/ExtendedIsolationForest.
+
+Reference: like IsolationForest, but splits are random HYPERPLANES
+(random normal vector n, random intercept point p inside the node's bounding
+box; row goes left iff (x−p)·n ≤ 0) — removes axis-parallel artifacts.
+`extension_level` = number of non-zero dimensions − 1 (0 ⇒ classic IF).
+
+TPU-native: per level, node bounding boxes are segment reductions and the
+hyperplane draw/test for all rows is fused into one jitted program; trees are
+stored as dense heap-order (normal, point, value) arrays, and scoring is a
+fixed-depth walk where each step is a gathered row·normal dot product.
+Anomaly score uses the canonical 2^(−E[h]/c(ψ)) normalization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.models.tree import engine as E
+from h2o3_tpu.models.tree.isofor import _avg_path_jnp
+from h2o3_tpu.models.tree.shared_tree import SharedTreeEstimator
+
+
+@functools.partial(jax.jit, static_argnames=("d", "ext"))
+def _eif_level(X, w, leaf, active, normA, pointA, didA, valA, key, *, d, ext):
+    L = 2 ** d
+    C = X.shape[1]
+    lv = jnp.where(active & (w > 0), leaf, L)
+    mn, mx = E.leaf_ranges(X, lv, L)
+    cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
+    kn = jax.random.fold_in(key, 3 * d)
+    kp = jax.random.fold_in(key, 3 * d + 1)
+    km = jax.random.fold_in(key, 3 * d + 2)
+    normal = jax.random.normal(kn, (L, C))
+    if ext + 1 < C:   # keep only (ext+1) random dims
+        r = jax.random.uniform(km, (L, C))
+        kth = jnp.sort(r, axis=1)[:, ext:ext + 1]
+        normal = jnp.where(r <= kth, normal, 0.0)
+    span = jnp.maximum(mx - mn, 0.0)
+    point = mn + jax.random.uniform(kp, (L, C)) * span
+    did = (cnt > 1.5) & (span.sum(axis=1) > 0)
+    base = 2 ** d - 1
+    normA = jax.lax.dynamic_update_slice(normA, normal.astype(jnp.float32),
+                                         (base, 0))
+    pointA = jax.lax.dynamic_update_slice(pointA, point.astype(jnp.float32),
+                                          (base, 0))
+    didA = jax.lax.dynamic_update_slice(didA, did, (base,))
+    valA = jax.lax.dynamic_update_slice(
+        valA, (d + _avg_path_jnp(cnt)).astype(jnp.float32), (base,))
+    proj = ((X - point[leaf]) * normal[leaf]).sum(axis=1)
+    go_right = jnp.where(jnp.isnan(proj), False, proj > 0)
+    splits = did[leaf] & active
+    leaf = jnp.where(splits, 2 * leaf + go_right.astype(jnp.int32), 0)
+    return leaf, splits, normA, pointA, didA, valA
+
+
+@functools.partial(jax.jit, static_argnames=("D",))
+def _eif_final(w, leaf, active, valA, *, D):
+    L = 2 ** D
+    lv = jnp.where(active & (w > 0), leaf, L)
+    cnt = jax.ops.segment_sum(w, lv, num_segments=L + 1)[:L]
+    vals = (D + _avg_path_jnp(cnt)).astype(jnp.float32)
+    return jax.lax.dynamic_update_slice(valA, vals, (2 ** D - 1,))
+
+
+def _eif_walk(X, norms, points, dids, vals, D):
+    """Mean path length over hyperplane trees: fixed-depth gather walk."""
+
+    @jax.jit
+    def run(X, norms, points, dids, vals):
+        n = X.shape[0]
+        T = norms.shape[0]
+
+        def per_tree(acc, t):
+            node = jnp.zeros(n, jnp.int32)
+
+            def step(d, node):
+                nr = norms[t][node]              # (n, C)
+                pt = points[t][node]
+                proj = ((X - pt) * nr).sum(axis=1)
+                right = jnp.where(jnp.isnan(proj), False, proj > 0)
+                child = 2 * node + 1 + right.astype(jnp.int32)
+                return jnp.where(dids[t][node], child, node)
+
+            node = jax.lax.fori_loop(0, D, step, node)
+            return acc + vals[t][node], None
+
+        out, _ = jax.lax.scan(per_tree, jnp.zeros(n, jnp.float32),
+                              jnp.arange(T))
+        return out / T
+
+    return run(X, norms, points, dids, vals)
+
+
+class H2OExtendedIsolationForestEstimator(SharedTreeEstimator):
+    algo = "extendedisolationforest"
+    supervised = False
+    _defaults = dict(SharedTreeEstimator._tree_defaults)
+    _defaults.update({"ntrees": 100, "sample_size": 256, "extension_level": 0})
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        X = di.matrix(frame)
+        w = di.weights(frame)
+        n = frame.nrows
+        C = X.shape[1]
+        ntrees = int(self.params["ntrees"])
+        psi = min(int(self.params.get("sample_size") or 256), n)
+        ext = min(int(self.params.get("extension_level") or 0), C - 1)
+        D = max(1, int(np.ceil(np.log2(max(psi, 2)))))
+        seed = int(self.params.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
+        rate = psi / max(n, 1)
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        nodes = 2 ** (D + 1) - 1
+        norms, points, dids, vals = [], [], [], []
+        for t in range(ntrees):
+            key, k1, k2 = jax.random.split(key, 3)
+            wt = w * (jax.random.uniform(k1, w.shape) < rate)
+            leaf = jnp.zeros(Xz.shape[0], jnp.int32)
+            active = jnp.ones(Xz.shape[0], bool)
+            normA = jnp.zeros((nodes, C), jnp.float32)
+            pointA = jnp.zeros((nodes, C), jnp.float32)
+            didA = jnp.zeros(nodes, bool)
+            valA = jnp.zeros(nodes, jnp.float32)
+            for d in range(D):
+                leaf, active, normA, pointA, didA, valA = _eif_level(
+                    Xz, wt, leaf, active, normA, pointA, didA, valA, k2,
+                    d=d, ext=ext)
+            valA = _eif_final(wt, leaf, active, valA, D=D)
+            norms.append(normA)
+            points.append(pointA)
+            dids.append(didA)
+            vals.append(valA)
+            job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+        self._norms = jnp.stack(norms)
+        self._points = jnp.stack(points)
+        self._dids = jnp.stack(dids)
+        self._vals = jnp.stack(vals)
+        self._D = D
+        self._cn = float(np.asarray(_avg_path_jnp(jnp.float32(psi))))
+        self._output.model_summary = {
+            "number_of_trees": ntrees, "sample_size": psi,
+            "extension_level": ext,
+        }
+
+    def _score_matrix(self, X):
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        return _eif_walk(Xz, self._norms, self._points, self._dids,
+                         self._vals, self._D)
+
+    def predict(self, test_data: Frame) -> Frame:
+        X = self._dinfo.matrix(test_data)
+        ml = np.asarray(self._score_matrix(X))[: test_data.nrows]
+        score = 2.0 ** (-ml / self._cn)
+        return Frame(["anomaly_score", "mean_length"],
+                     [Vec.from_numpy(score.astype(np.float64)),
+                      Vec.from_numpy(ml.astype(np.float64))])

@@ -1,0 +1,67 @@
+"""Generic model — hex/generic/: import a MOJO as a first-class in-cluster
+model (scoreable via the normal predict path / REST)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.genmodel.mojo import MojoModel
+
+
+class H2OGenericEstimator:
+    algo = "generic"
+
+    def __init__(self, path: str = None, model_key: str = None):
+        self.params = {"path": path}
+        self.key = model_key or DKV.make_key("generic")
+        self._scorer: MojoModel | None = None
+        if path:
+            self._scorer = MojoModel.load(path)
+            DKV.put(self.key, self)
+
+    def train(self, training_frame=None, **kw):
+        path = kw.get("path") or self.params.get("path")
+        self._scorer = MojoModel.load(path)
+        DKV.put(self.key, self)
+        return self
+
+    @property
+    def original_algo(self):
+        return self._scorer.algo if self._scorer else None
+
+    def predict(self, test_data: Frame) -> Frame:
+        sc = self._scorer
+        m = sc.meta
+        rows = []
+        host = {c: test_data.vec(c) for c in test_data.names}
+        for i in range(test_data.nrows):
+            row = {}
+            for c in m["predictors"]:
+                if c not in host:
+                    row[c] = None
+                    continue
+                v = host[c]
+                if v.type == "enum":
+                    code = v.to_numpy()[i]
+                    row[c] = None if np.isnan(code) else v.domain[int(code)]
+                elif v.type == "str":
+                    row[c] = v.host_data[i]
+                else:
+                    x = v.to_numpy()[i]
+                    row[c] = None if np.isnan(x) else float(x)
+            rows.append(row)
+        out = sc.predict(rows)
+        cols = {}
+        if "probs" in out:
+            dom = out["domain"]
+            cols["predict"] = out["predict"]
+            for k, lvl in enumerate(dom):
+                cols[f"p{lvl}"] = out["probs"][:, k]
+        elif "cluster" in out:
+            cols["predict"] = out["cluster"].astype(np.float64)
+        else:
+            for k, v in out.items():
+                cols[k if k != "predict" else "predict"] = v
+        return Frame.from_dict(cols)

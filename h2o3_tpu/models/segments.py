@@ -1,0 +1,56 @@
+"""Segment models — hex/segments/SegmentModelsBuilder.java: one model per
+data segment (distinct combination of segment-column values)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+
+class SegmentModels:
+    def __init__(self, results: list):
+        self._results = results
+
+    def as_list(self):
+        return self._results
+
+    def __len__(self):
+        return len(self._results)
+
+
+def train_segments(estimator_cls, params: dict, segment_columns, x=None,
+                   y=None, training_frame: Frame = None) -> SegmentModels:
+    """ModelBuilder.trainSegments: split the frame by segment columns, train
+    one model per segment; failures recorded per segment (not fatal)."""
+    f = training_frame
+    seg_cols = [segment_columns] if isinstance(segment_columns, str) \
+        else list(segment_columns)
+    seg_data = [f.vec(c).to_numpy() for c in seg_cols]
+    seg_doms = [f.vec(c).levels() for c in seg_cols]
+    keys = list(zip(*seg_data))
+    uniq = sorted(set(keys), key=lambda t: tuple(-1 if v != v else v
+                                                 for v in t))
+    host = f.to_numpy()
+    results = []
+    from h2o3_tpu.models.model import _subframe
+    col_data = {c: host[:, j] for j, c in enumerate(f.names)}
+    cat_doms = {c: f.vec(c).domain for c in f.names
+                if f.vec(c).type == "enum"}
+    for seg in uniq:
+        idx = np.array([k == seg for k in keys])
+        label = {c: (seg_doms[i][int(seg[i])] if seg_doms[i] is not None
+                     and seg[i] == seg[i] else seg[i])
+                 for i, c in enumerate(seg_cols)}
+        try:
+            sub = _subframe(f, col_data, cat_doms, idx)
+            m = estimator_cls(**params)
+            m.train(x=x, y=y, training_frame=sub)
+            results.append({"segment": label, "model": m.key,
+                            "status": "SUCCEEDED", "nrows": int(idx.sum())})
+            DKV.remove(sub.key)
+        except Exception as ex:  # noqa: BLE001 — per-segment failure recorded
+            results.append({"segment": label, "model": None,
+                            "status": "FAILED", "error": repr(ex)})
+    return SegmentModels(results)

@@ -1,0 +1,96 @@
+"""SVD — hex/svd/SVD.java: distributed singular value decomposition.
+
+Reference: power iteration with a distributed Gram MRTask (svd/SVD.java),
+methods GramSVD / Power / Randomized.
+
+TPU-native: the Gram XᵀX is one sharded matmul; eigh of the small (p×p) Gram
+gives V and σ directly (GramSVD); U = XVσ⁻¹ is one more sharded matmul.
+Power/Randomized collapse into the same exact path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.models.model import ModelBase
+
+
+class H2OSingularValueDecompositionEstimator(ModelBase):
+    algo = "svd"
+    supervised = False
+    _defaults = {
+        "nv": 1, "transform": "NONE", "svd_method": "GramSVD",
+        "max_iterations": 1000, "keep_u": True,
+    }
+
+    def _make_data_info(self, frame, x, y):
+        from h2o3_tpu.models.model import DataInfo
+        return DataInfo(frame, x, y, cat_mode="onehot", standardize=False,
+                        impute_missing=True)
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        X = di.matrix(frame)
+        w = di.weights(frame)
+        k = int(self.params["nv"])
+        transform = (self.params.get("transform") or "NONE").upper()
+        Xz = jnp.where(jnp.isnan(X), 0.0, X) * (w[:, None] > 0)
+        wsum = float(np.asarray(w.sum()))
+        mean = np.asarray((w[:, None] * Xz).sum(axis=0)) / max(wsum, 1e-30)
+        var = np.asarray((w[:, None] * (Xz - mean) ** 2).sum(axis=0)) / \
+            max(wsum - 1, 1)
+        sd = np.sqrt(np.maximum(var, 1e-30))
+        if transform in ("DEMEAN", "STANDARDIZE"):
+            Xz = Xz - jnp.asarray(mean, jnp.float32) * (w[:, None] > 0)
+        if transform in ("DESCALE", "STANDARDIZE", "NORMALIZE"):
+            Xz = Xz / jnp.asarray(sd, jnp.float32)
+        G = jax.jit(lambda X: X.T @ X)(Xz)
+        Gn = np.asarray(G, np.float64)
+        evals, evecs = np.linalg.eigh(Gn)
+        order = np.argsort(-evals)
+        evals = np.clip(evals[order][:k], 0, None)
+        V = evecs[:, order][:, :k]
+        d = np.sqrt(evals)
+        self._v = V
+        self._d = d
+        self._transform = transform
+        self._mean, self._sd = mean, sd
+        if self.params.get("keep_u"):
+            dinv = np.where(d > 1e-12, 1.0 / np.maximum(d, 1e-12), 0.0)
+            U = np.asarray(jax.jit(lambda X: X @ jnp.asarray(
+                V * dinv[None, :], jnp.float32))(Xz))[: frame.nrows]
+            uf = Frame([f"u{j+1}" for j in range(k)],
+                       [Vec.from_numpy(U[:, j].astype(np.float64))
+                        for j in range(k)])
+            self._u_key = uf.key
+        self._output.model_summary = {
+            "nv": k, "d": d.tolist(), "method": "GramSVD",
+        }
+
+    def _score_matrix(self, X):
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        if self._transform in ("DEMEAN", "STANDARDIZE"):
+            Xz = Xz - jnp.asarray(self._mean, jnp.float32)
+        if self._transform in ("DESCALE", "STANDARDIZE", "NORMALIZE"):
+            Xz = Xz / jnp.asarray(self._sd, jnp.float32)
+        return Xz @ jnp.asarray(self._v, jnp.float32)
+
+    def predict(self, test_data: Frame) -> Frame:
+        S = np.asarray(self._score_matrix(self._dinfo.matrix(test_data)))
+        S = S[: test_data.nrows]
+        return Frame([f"svd{j+1}" for j in range(S.shape[1])],
+                     [Vec.from_numpy(S[:, j].astype(np.float64))
+                      for j in range(S.shape[1])])
+
+    def d(self):
+        return self._d
+
+    def v(self):
+        return self._v
+
+    def u(self) -> Frame:
+        from h2o3_tpu.core.kvstore import DKV
+        return DKV.get(self._u_key)

@@ -1,0 +1,177 @@
+"""Word2Vec — hex/word2vec rebuilt as batched negative-sampling SGD.
+
+Reference: hex/word2vec/WordVectorTrainer.java:17 (hierarchical-softmax
+skip-gram over shared _syn0/_syn1 with per-node Hogwild updates and
+cross-node weight averaging in reduce :152,174), WordCountTask.java (vocab),
+HBWTree.java (Huffman tree).
+
+TPU-native design: skip-gram with NEGATIVE SAMPLING (the standard
+mini-batch-able formulation) instead of hierarchical softmax — HS exists in
+the reference because per-row tree walks were cheap on CPU Hogwild; on TPU
+the batched dot-product formulation is the hardware-shaped equivalent, and
+synchronous allreduce SGD replaces Hogwild+averaging (same swap the
+DeepLearning port makes, BASELINE.json). Outputs the same artifact: a
+word→vector frame usable by transform()/find_synonyms().
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec, T_STR
+from h2o3_tpu.models.model import ModelBase
+
+
+class H2OWord2vecEstimator(ModelBase):
+    algo = "word2vec"
+    supervised = False
+    _defaults = {
+        "vec_size": 100, "window_size": 5, "sent_sample_rate": 1e-3,
+        "norm_model": "HSM", "epochs": 5, "min_word_freq": 5,
+        "init_learning_rate": 0.025, "negative_samples": 5,
+        "max_runtime_secs": 0.0,
+    }
+
+    def train(self, training_frame=None, **kw):
+        self.params.update(kw)
+        f = training_frame
+        self.key = self.params.get("model_id") or \
+            __import__("h2o3_tpu.core.kvstore", fromlist=["DKV"]).DKV.make_key("word2vec")
+        # corpus: one string column; sentences separated by NA rows
+        v = f.vecs[0]
+        if v.type == T_STR:
+            words = [w for w in v.host_data]
+        else:
+            dom = v.levels()
+            words = [None if np.isnan(c) else dom[int(c)]
+                     for c in v.to_numpy()]
+        self._fit_corpus(words)
+        from h2o3_tpu.core.kvstore import DKV
+        DKV.put(self.key, self)
+        return self
+
+    def _fit_corpus(self, words):
+        min_freq = int(self.params["min_word_freq"])
+        dim = int(self.params["vec_size"])
+        win = int(self.params["window_size"])
+        neg = int(self.params["negative_samples"])
+        epochs = int(self.params["epochs"])
+        lr = float(self.params["init_learning_rate"])
+        seed = int(self.params.get("seed") or -1)
+        # vocab (WordCountTask)
+        from collections import Counter
+        counts = Counter(w for w in words if w is not None)
+        vocab = [w for w, c in counts.most_common() if c >= min_freq]
+        self._vocab = {w: i for i, w in enumerate(vocab)}
+        V = len(vocab)
+        if V == 0:
+            raise ValueError("empty vocabulary (lower min_word_freq?)")
+        # training pairs from windows within sentences
+        sents, cur = [], []
+        for w in words:
+            if w is None:
+                if cur:
+                    sents.append(cur)
+                cur = []
+            elif w in self._vocab:
+                cur.append(self._vocab[w])
+        if cur:
+            sents.append(cur)
+        centers, contexts = [], []
+        for s in sents:
+            for i, c in enumerate(s):
+                for j in range(max(0, i - win), min(len(s), i + win + 1)):
+                    if j != i:
+                        centers.append(c)
+                        contexts.append(s[j])
+        if not centers:
+            raise ValueError("no training pairs")
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+        # unigram^0.75 negative table
+        freq = np.array([counts[w] for w in vocab], np.float64) ** 0.75
+        freq /= freq.sum()
+        rng = np.random.default_rng(seed if seed > 0 else 0)
+        key = jax.random.PRNGKey(seed if seed > 0 else 0)
+        syn0 = jnp.asarray(rng.uniform(-0.5 / dim, 0.5 / dim, (V, dim)),
+                           jnp.float32)
+        syn1 = jnp.zeros((V, dim), jnp.float32)
+
+        @jax.jit
+        def step(syn0, syn1, c_idx, ctx_idx, neg_idx, lr):
+            def loss(params):
+                s0, s1 = params
+                vc = s0[c_idx]                       # (B, d)
+                vpos = s1[ctx_idx]                   # (B, d)
+                vneg = s1[neg_idx]                   # (B, neg, d)
+                pos = jax.nn.log_sigmoid((vc * vpos).sum(-1))
+                negs = jax.nn.log_sigmoid(-(vc[:, None, :] * vneg).sum(-1))
+                return -(pos.sum() + negs.sum()) / c_idx.shape[0]
+
+            l, g = jax.value_and_grad(loss)(( syn0, syn1))
+            return syn0 - lr * g[0], syn1 - lr * g[1], l
+
+        B = min(8192, len(centers))
+        nsteps = max(1, epochs * len(centers) // B)
+        for s in range(nsteps):
+            idx = rng.integers(0, len(centers), B)
+            negs = rng.choice(V, size=(B, neg), p=freq)
+            cur_lr = lr * max(0.1, 1 - s / nsteps)
+            syn0, syn1, l = step(syn0, syn1,
+                                 jnp.asarray(centers[idx]),
+                                 jnp.asarray(contexts[idx]),
+                                 jnp.asarray(negs), cur_lr)
+        self._vectors = np.asarray(syn0)
+        self._vocab_list = vocab
+
+    # ---- public surface (h2o-py H2OWord2vecEstimator) --------------------
+    def find_synonyms(self, word: str, count: int = 20):
+        if word not in self._vocab:
+            return {}
+        v = self._vectors[self._vocab[word]]
+        sims = self._vectors @ v / (
+            np.linalg.norm(self._vectors, axis=1) * np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        out = {}
+        for i in order:
+            w = self._vocab_list[i]
+            if w != word:
+                out[w] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
+
+    def transform(self, frame: Frame, aggregate_method: str = "NONE") -> Frame:
+        """words → vectors; AVERAGE pools per sentence (NA-separated)."""
+        v = frame.vecs[0]
+        if v.type == T_STR:
+            words = list(v.host_data)
+        else:
+            dom = v.levels()
+            words = [None if np.isnan(c) else dom[int(c)]
+                     for c in v.to_numpy()]
+        dim = self._vectors.shape[1]
+        if aggregate_method.upper() == "AVERAGE":
+            rows, acc, cnt = [], np.zeros(dim), 0
+            for w in words + [None]:
+                if w is None:
+                    rows.append(acc / cnt if cnt else np.full(dim, np.nan))
+                    acc, cnt = np.zeros(dim), 0
+                elif w in self._vocab:
+                    acc = acc + self._vectors[self._vocab[w]]
+                    cnt += 1
+            mat = np.vstack(rows[:-1]) if len(rows) > 1 else np.vstack(rows)
+        else:
+            mat = np.vstack([
+                self._vectors[self._vocab[w]] if w in self._vocab
+                else np.full(dim, np.nan) for w in words])
+        return Frame([f"V{i+1}" for i in range(dim)],
+                     [Vec.from_numpy(mat[:, i]) for i in range(dim)])
+
+    def to_frame(self) -> Frame:
+        cols = {"Word": np.asarray(self._vocab_list, object)}
+        for i in range(self._vectors.shape[1]):
+            cols[f"V{i+1}"] = self._vectors[:, i].astype(np.float64)
+        return Frame.from_dict(cols)

@@ -1,0 +1,46 @@
+"""Logging — water/util/Log.java (log4j-backed per-node rolling files,
+buffered pre-init, -log_level) on stdlib logging; one controller process."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        lg = logging.getLogger("h2o3_tpu")
+        lg.setLevel(os.environ.get("H2O3_LOG_LEVEL", "INFO").upper())
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+        lg.addHandler(h)
+        log_dir = os.environ.get("H2O3_LOG_DIR")
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            fh = logging.handlers.RotatingFileHandler(
+                os.path.join(log_dir, "h2o3_tpu.log"),
+                maxBytes=50 << 20, backupCount=3)
+            lg.addHandler(fh)
+        _LOGGER = lg
+    return _LOGGER
+
+
+def info(msg, *a):
+    get_logger().info(msg, *a)
+
+
+def warn(msg, *a):
+    get_logger().warning(msg, *a)
+
+
+def err(msg, *a):
+    get_logger().error(msg, *a)
+
+
+def debug(msg, *a):
+    get_logger().debug(msg, *a)

@@ -1,0 +1,108 @@
+"""Tracing/profiling — water/TimeLine + MRTask.profile rebuilt for a
+single-controller device runtime.
+
+Reference: water.TimeLine (TimeLine.java:22) is a lock-free ring buffer of
+every UDP/TCP packet on every node, snapshotted cluster-wide via
+/3/Timeline; MRTask.profile() (MRTask.java:190-378) times each phase of a
+distributed task.
+
+TPU-native: the packet flight recorder becomes a DISPATCH recorder — a ring
+buffer of device-program launches (name, args-bytes, enqueue time, completion
+time when measured) — and deep kernel-level tracing delegates to jax.profiler
+(XLA's own tracer; the TPU equivalent of reading the wire). `profile(fn)`
+wraps any jitted step the way MRTask.profile wrapped a task.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DispatchEvent:
+    name: str
+    t_enqueue: float
+    t_done: float | None = None
+    arg_bytes: int = 0
+    note: str = ""
+
+
+class Timeline:
+    """Ring buffer of device dispatches (TimeLine's 2048-event ring)."""
+
+    CAPACITY = 2048
+
+    def __init__(self):
+        self._ring: deque = deque(maxlen=self.CAPACITY)
+        self._lock = threading.Lock()
+
+    def record(self, name: str, arg_bytes: int = 0, note: str = "") -> DispatchEvent:
+        ev = DispatchEvent(name=name, t_enqueue=time.time(),
+                           arg_bytes=arg_bytes, note=note)
+        with self._lock:
+            self._ring.append(ev)
+        return ev
+
+    def snapshot(self) -> list:
+        """/3/Timeline: most-recent dispatches, oldest first."""
+        with self._lock:
+            return [
+                {"name": e.name, "enqueue": e.t_enqueue, "done": e.t_done,
+                 "duration_ms": None if e.t_done is None
+                 else 1000 * (e.t_done - e.t_enqueue),
+                 "arg_bytes": e.arg_bytes, "note": e.note}
+                for e in self._ring
+            ]
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+TIMELINE = Timeline()
+
+
+@contextlib.contextmanager
+def span(name: str, note: str = ""):
+    """Record one controller-side span into the timeline."""
+    ev = TIMELINE.record(name, note=note)
+    try:
+        yield ev
+    finally:
+        ev.t_done = time.time()
+
+
+def profile(fn, *args, sync=True, name=None, **kwargs):
+    """MRTask.profile analog: run a (jitted) step, return (result, timing).
+
+    Timing splits enqueue (controller→device dispatch) from completion
+    (device execution + transfer), the moral split of MRProfile's
+    {RPC fan-out, map, reduce} phases.
+    """
+    import jax
+    nm = name or getattr(fn, "__name__", "step")
+    ev = TIMELINE.record(nm)
+    t0 = time.time()
+    out = fn(*args, **kwargs)
+    t_enq = time.time()
+    if sync:
+        out = jax.block_until_ready(out)
+    ev.t_done = time.time()
+    return out, {"name": nm, "enqueue_ms": 1000 * (t_enq - t0),
+                 "total_ms": 1000 * (ev.t_done - t0)}
+
+
+@contextlib.contextmanager
+def xla_trace(logdir: str):
+    """Deep tracing via the XLA profiler (xprof) — the /3/Timeline of the
+    device itself. View with tensorboard or xprof."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

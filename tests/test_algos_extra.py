@@ -1,0 +1,135 @@
+"""Tests for SVD, Aggregator, Word2Vec, CoxPH, ExtendedIsolationForest,
+persist/Recovery (mirrors corresponding testdir_algos suites)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.models
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
+from h2o3_tpu.models.aggregator import H2OAggregatorEstimator
+from h2o3_tpu.models.word2vec import H2OWord2vecEstimator
+from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.extended_isofor import H2OExtendedIsolationForestEstimator
+
+
+def test_svd_matches_numpy():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (200, 5))
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(5)})
+    svd = H2OSingularValueDecompositionEstimator(nv=3)
+    svd.train(training_frame=f)
+    _, s_ref, _ = np.linalg.svd(X, full_matrices=False)
+    np.testing.assert_allclose(svd.d(), s_ref[:3], rtol=1e-3)
+    # U D V' ≈ X restricted to rank 3
+    U = svd.u().to_numpy()
+    rec = U * svd.d() @ svd.v().T
+    ref = (np.linalg.svd(X, full_matrices=False)[0][:, :3] * s_ref[:3]) @ \
+        np.linalg.svd(X, full_matrices=False)[2][:3]
+    np.testing.assert_allclose(np.abs(rec), np.abs(ref), atol=0.2)
+
+
+def test_aggregator():
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (2000, 3))
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(3)})
+    agg = H2OAggregatorEstimator(target_num_exemplars=100,
+                                 rel_tol_num_exemplars=0.7)
+    agg.train(training_frame=f)
+    of = agg.aggregated_frame()
+    k = of.nrows
+    assert 20 <= k <= 2000
+    counts = of.vec("counts").to_numpy()
+    assert counts.sum() == 2000
+
+
+def test_word2vec():
+    # tiny synthetic corpus: two topic clusters
+    sents = []
+    for _ in range(120):
+        sents += ["cat", "dog", "pet", None]
+        sents += ["car", "truck", "road", None]
+    f = Frame.from_dict({"words": np.array(sents, object)},
+                        column_types={"words": "str"})
+    w2v = H2OWord2vecEstimator(vec_size=16, epochs=40, min_word_freq=5,
+                               window_size=2, seed=1)
+    w2v.train(training_frame=f)
+    syn = w2v.find_synonyms("cat", 2)
+    assert set(syn) <= {"dog", "pet", "car", "truck", "road"}
+    assert list(syn)[0] in ("dog", "pet")
+    vf = w2v.to_frame()
+    assert vf.ncols == 17
+    h2o3_tpu.remove(f.key)
+
+
+def test_coxph():
+    rng = np.random.default_rng(3)
+    n = 400
+    x = rng.normal(0, 1, n)
+    # exponential survival with hazard ratio exp(0.8 x)
+    t = rng.exponential(1.0 / np.exp(0.8 * x))
+    cens = rng.exponential(2.0, n)
+    event = (t <= cens).astype(float)
+    obs = np.minimum(t, cens)
+    f = Frame.from_dict({"x": x, "time": obs, "event": event})
+    cph = H2OCoxProportionalHazardsEstimator(stop_column="time")
+    cph.train(x=["x"], y="event", training_frame=f)
+    beta = cph.coef()["x"]
+    assert abs(beta - 0.8) < 0.2
+
+
+def test_extended_isolation_forest():
+    rng = np.random.default_rng(5)
+    X = rng.normal(0, 1, (500, 4))
+    X[:10] += 7.0
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(4)})
+    eif = H2OExtendedIsolationForestEstimator(ntrees=40, sample_size=128,
+                                              extension_level=1, seed=3)
+    eif.train(training_frame=f)
+    p = eif.predict(f)
+    scores = p.vec("anomaly_score").to_numpy()
+    assert scores[:10].mean() > np.quantile(scores, 0.85)
+
+
+def test_frame_persist_roundtrip(tmp_path):
+    from h2o3_tpu.io.persist import export_frame, import_frame
+    f = Frame.from_dict({
+        "a": [1.0, 2.0, np.nan], "b": np.array(["x", None, "y"], object),
+        "s": np.array(["free", "text", None], object)},
+        column_types={"s": "str"})
+    p = str(tmp_path / "f.hex")
+    export_frame(f, p)
+    g = import_frame(p, key="reimported")
+    assert g.nrows == 3
+    np.testing.assert_allclose(g.vec("a").to_numpy()[:2], [1, 2])
+    assert np.isnan(g.vec("a").to_numpy()[2])
+    assert g.vec("b").levels() == ["x", "y"]
+    assert g.vec("s").host_data[1] == "text"
+    h2o3_tpu.remove(f.key)
+    h2o3_tpu.remove("reimported")
+
+
+def test_recovery(tmp_path):
+    from h2o3_tpu.io.persist import Recovery
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (100, 3))
+    y = (X[:, 0] > 0).astype(int)
+    f = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+                         "y": np.array(["n", "p"], object)[y]},
+                        key="recov_frame")
+    rec = Recovery(str(tmp_path / "recov"))
+    rec.checkpoint_frame(f)
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=3, max_depth=2, seed=1, model_id="recov_model")
+    gbm.train(y="y", training_frame=f)
+    rec.checkpoint_model(gbm)
+    # simulate restart
+    h2o3_tpu.remove("recov_frame")
+    h2o3_tpu.remove("recov_model")
+    out = rec.resume()
+    assert [fr.key for fr in out["frames"]] == ["recov_frame"]
+    assert [m.key for m in out["models"]] == ["recov_model"]
+    m = out["models"][0]
+    p = m.predict(out["frames"][0])
+    assert p.nrows == 100

@@ -1,0 +1,110 @@
+"""GAM / RuleFit / segment models / generic model / create_frame / timeline
+tests."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+import h2o3_tpu.models
+from h2o3_tpu.core.frame import Frame
+
+
+def test_gam_fits_nonlinearity():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-3, 3, 500)
+    z = rng.normal(0, 1, 500)
+    y = np.sin(x) * 2 + 0.5 * z + rng.normal(0, 0.1, 500)
+    f = Frame.from_dict({"x": x, "z": z, "y": y})
+    from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+    gam = H2OGeneralizedAdditiveEstimator(
+        family="gaussian", gam_columns=["x"], num_knots=[8], lambda_=0.0)
+    gam.train(x=["z"], y="y", training_frame=f)
+    m = gam.model_performance()
+    # a linear model can't get sin(x); the spline should
+    assert m.mse < 0.15
+    p = gam.predict(f)
+    assert p.nrows == 500
+
+
+def test_rulefit_extracts_rules():
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (400, 4))
+    y = ((X[:, 0] > 0.5) & (X[:, 1] < 0)).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
+    rf = H2ORuleFitEstimator(max_rule_length=3, min_rule_length=2,
+                             rule_generation_ntrees=10)
+    rf.train(y="y", training_frame=f)
+    imp = rf.rule_importance()
+    assert len(imp) >= 1
+    assert rf._output.training_metrics.auc > 0.85
+
+
+def test_segment_models():
+    rng = np.random.default_rng(2)
+    seg = np.array(["a", "b"], object)[rng.integers(0, 2, 300)]
+    x = rng.normal(0, 1, 300)
+    y = np.where(seg == "a", 2 * x, -3 * x) + rng.normal(0, 0.05, 300)
+    f = Frame.from_dict({"seg": seg, "x": x, "y": y})
+    from h2o3_tpu.models.segments import train_segments
+    sm = train_segments(
+        h2o3_tpu.models.H2OGeneralizedLinearEstimator,
+        {"family": "gaussian", "lambda_": 0.0},
+        segment_columns="seg", x=["x"], y="y", training_frame=f)
+    res = sm.as_list()
+    assert len(res) == 2
+    assert all(r["status"] == "SUCCEEDED" for r in res)
+    coefs = {r["segment"]["seg"]: h2o3_tpu.get_model(r["model"]).coef()["x"]
+             for r in res}
+    assert abs(coefs["a"] - 2) < 0.1 and abs(coefs["b"] + 3) < 0.1
+
+
+def test_generic_model_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 1, (200, 3))
+    y = (X[:, 0] > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    gbm = h2o3_tpu.models.H2OGradientBoostingEstimator(ntrees=5, max_depth=3,
+                                                       seed=1)
+    gbm.train(y="y", training_frame=f)
+    p1 = gbm.predict(f).vec("pp").to_numpy()
+    mj = str(tmp_path / "g.mojo")
+    gbm.download_mojo(mj)
+    gen = h2o3_tpu.models.H2OGenericEstimator(path=mj)
+    p2 = gen.predict(f).vec("pp").to_numpy()
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+    assert gen.original_algo == "gbm"
+
+
+def test_create_frame():
+    f = h2o3_tpu.create_frame(rows=500, cols=10, categorical_fraction=0.2,
+                              integer_fraction=0.2, missing_fraction=0.05,
+                              has_response=True, seed=5)
+    assert f.nrows == 500
+    assert f.ncols == 11
+    types = set(f.types.values())
+    assert "enum" in types and "num" in types
+    h2o3_tpu.remove(f.key)
+
+
+def test_timeline_and_profile():
+    import jax.numpy as jnp
+    from h2o3_tpu.utils.timeline import TIMELINE, profile, span
+    import jax
+    TIMELINE.clear()
+
+    @jax.jit
+    def step(x):
+        return (x * 2).sum()
+
+    out, timing = profile(step, jnp.ones(1000), name="double")
+    assert timing["total_ms"] >= 0
+    with span("controller-work"):
+        pass
+    snap = TIMELINE.snapshot()
+    assert [e["name"] for e in snap] == ["double", "controller-work"]
+    assert all(e["done"] is not None for e in snap)
