@@ -31,7 +31,7 @@ def main():
 
     h2o3_tpu.init()
     N, C = 1_000_000, 28
-    DEPTH, NBINS, NTREES = 8, 32, 20
+    DEPTH, NBINS, NTREES = 6, 32, 20
     rng = np.random.default_rng(0)
     Xh = rng.normal(0, 1, (N, C)).astype(np.float32)
     wgt = 1.5 * Xh[:, 0] - Xh[:, 1] + 0.5 * Xh[:, 2] * Xh[:, 3]

@@ -21,6 +21,7 @@ from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
 from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
 from h2o3_tpu.models.generic import H2OGenericEstimator
 from h2o3_tpu.models.segments import train_segments, SegmentModels
+from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
 
 ESTIMATORS = {
     "kmeans": H2OKMeansEstimator,
@@ -42,4 +43,5 @@ ESTIMATORS = {
     "gam": H2OGeneralizedAdditiveEstimator,
     "rulefit": H2ORuleFitEstimator,
     "generic": H2OGenericEstimator,
+    "psvm": H2OSupportVectorMachineEstimator,
 }

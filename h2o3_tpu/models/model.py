@@ -227,6 +227,7 @@ class ModelBase:
         "ignored_columns": None, "ignore_const_cols": True,
         "max_runtime_secs": 0.0, "standardize": True,
         "categorical_encoding": "AUTO", "distribution": "AUTO",
+        "checkpoint": None, "export_checkpoints_dir": None,
     }
 
     def __init__(self, **params):

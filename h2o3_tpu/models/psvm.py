@@ -1,0 +1,123 @@
+"""PSVM — hex/psvm/PSVM.java: support vector machine for binary targets.
+
+Reference: primal kernel SVM solved by block minimization over an Incomplete
+Cholesky Factorization of the Gram matrix (hex/psvm), with a bulk scorer.
+
+TPU-native design: the primal squared-hinge objective is minimized directly
+with full-batch gradient steps on device (the blocked ICF exists to make CPU
+kernel evaluations tractable; on TPU the factorized feature map is the
+hardware-shaped equivalent). `kernel_type="gaussian"` uses a random Fourier
+feature map Z(x) so the "kernel" path is still two matmuls — the same
+low-rank-approximation role ICF plays in the reference.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.model import ModelBase
+
+
+class H2OSupportVectorMachineEstimator(ModelBase):
+    algo = "psvm"
+    _defaults = {
+        "hyper_param": 1.0,            # C
+        "kernel_type": "gaussian", "gamma": -1.0, "rank_ratio": -1.0,
+        "positive_weight": 1.0, "negative_weight": 1.0,
+        "max_iterations": 200, "feature_dim": 256,
+    }
+
+    def _fit(self, frame: Frame, job):
+        di = self._dinfo
+        X = di.matrix(frame)
+        y = di.response(frame)
+        w = di.weights(frame)
+        w = jnp.where(jnp.isnan(y), 0.0, w)
+        assert self.nclasses == 2, "psvm requires a binary response"
+        ysvm = jnp.where(y > 0.5, 1.0, -1.0)      # {-1, +1}
+        pw = float(self.params["positive_weight"])
+        nw = float(self.params["negative_weight"])
+        w = w * jnp.where(ysvm > 0, pw, nw)
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        p = X.shape[1]
+        kernel = (self.params.get("kernel_type") or "gaussian").lower()
+        seed = int(self.params.get("seed") or -1)
+        rng = np.random.default_rng(seed if seed > 0 else 0)
+        if kernel == "gaussian":
+            gamma = float(self.params.get("gamma") or -1.0)
+            if gamma <= 0:
+                gamma = 1.0 / max(p, 1)
+            Drff = int(self.params.get("feature_dim") or 256)
+            W = rng.normal(0, math.sqrt(2 * gamma), (p, Drff))
+            b = rng.uniform(0, 2 * np.pi, Drff)
+            self._rff = (jnp.asarray(W, jnp.float32),
+                         jnp.asarray(b, jnp.float32))
+            feat_dim = Drff
+        else:
+            self._rff = None
+            feat_dim = p
+        C = float(self.params["hyper_param"])
+        rff = self._rff
+
+        def features(Xz):
+            if rff is None:
+                return Xz
+            Wr, br = rff
+            return jnp.sqrt(2.0 / Wr.shape[1]) * jnp.cos(Xz @ Wr + br)
+
+        @jax.jit
+        def loss(params, Xz, ysvm, w):
+            beta, b0 = params
+            Z = features(Xz)
+            m = ysvm * (Z @ beta + b0)
+            hinge = jnp.maximum(0.0, 1.0 - m)
+            return 0.5 * (beta @ beta) + \
+                C * (w * hinge * hinge).sum() / jnp.maximum(w.sum(), 1.0)
+
+        params = (jnp.zeros(feat_dim, jnp.float32), jnp.float32(0.0))
+        import optax
+        opt = optax.lbfgs()
+        opt_state = opt.init(params)
+        vg = jax.jit(jax.value_and_grad(loss))
+
+        @jax.jit
+        def step(params, opt_state, Xz, ysvm, w):
+            l, g = vg(params, Xz, ysvm, w)
+            updates, opt_state = opt.update(
+                g, opt_state, params, value=l, grad=g,
+                value_fn=lambda pr: loss(pr, Xz, ysvm, w))
+            return optax.apply_updates(params, updates), opt_state, l
+
+        prev = np.inf
+        for it in range(int(self.params["max_iterations"])):
+            params, opt_state, l = step(params, opt_state, Xz, ysvm, w)
+            lv = float(l)
+            if abs(prev - lv) < 1e-8 * max(1.0, abs(prev)):
+                break
+            prev = lv
+            if it % 20 == 0:
+                job.update(0.1 + 0.8 * it / int(self.params["max_iterations"]),
+                           f"iter {it}")
+        self._params_svm = params
+        self._features = features
+        # decision margins on training data → support vector count
+        Z = features(Xz)
+        m = np.asarray(ysvm * (Z @ params[0] + params[1]))
+        wn = np.asarray(w)
+        self._output.model_summary = {
+            "svs_count": int(((m < 1.0) & (wn > 0)).sum()),
+            "kernel": kernel, "C": C, "final_objective": prev,
+        }
+
+    def _score_matrix(self, X):
+        beta, b0 = self._params_svm
+        Xz = jnp.where(jnp.isnan(X), 0.0, X)
+        dec = self._features(Xz) @ beta + b0
+        # probability-ish output via logistic link on the margin
+        pp = jax.nn.sigmoid(2.0 * dec)
+        return jnp.stack([1 - pp, pp], axis=1)

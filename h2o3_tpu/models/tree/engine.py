@@ -386,12 +386,12 @@ class TreeGrower:
                 X, stats, w, leaf, heap, active, colA, thrA, nalA, valA,
                 gains, col_mask, key, d=d, B=self.B, mtries=int(mtries),
                 min_rows=self.min_rows, min_split_improvement=self.msi)
-            if _CPU_BACKEND:
-                # XLA CPU collectives abort flakily when programs containing
-                # all-reduces pile up in the async queue (virtual-device test
-                # mesh only); serialize per level there. TPU path stays async.
-                jax.block_until_ready(leaf)
         valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
+        if _CPU_BACKEND:
+            # XLA CPU collectives abort flakily when programs containing
+            # all-reduces pile up in the async queue (virtual-device test
+            # mesh only); drain the queue once per tree. TPU stays async.
+            jax.block_until_ready(valA)
         return colA, thrA, nalA, valA, heap, gains
 
 

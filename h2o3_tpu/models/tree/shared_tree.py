@@ -131,9 +131,26 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         F = jnp.full(X.shape[0], f0, jnp.float32)
         sample_rate = float(self.params["sample_rate"])
         trees = []
+        # checkpoint restart (ModelBuilder.java:1401, SharedTree.java:132):
+        # resume boosting from a prior model's trees
+        ckpt = self.params.get("checkpoint")
+        if ckpt:
+            from h2o3_tpu.core.kvstore import DKV
+            prev = DKV.get(ckpt) if isinstance(ckpt, str) else ckpt
+            assert prev is not None and prev.algo == self.algo, \
+                f"checkpoint {ckpt} not found or wrong algo"
+            pt = prev._trees
+            assert pt.depth == grower.D, \
+                "checkpoint restart requires identical max_depth"
+            for i in range(pt.ntrees):
+                trees.append((jnp.asarray(pt.col[i]), jnp.asarray(pt.thr[i]),
+                              jnp.asarray(pt.na_left[i]),
+                              jnp.asarray(pt.value[i])))
+            self._f0 = f0 = prev._f0
+            F = f0 + lr * E.predict_ensemble(X, pt)
         gains_tot = jnp.zeros(X.shape[1], jnp.float32)
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
-        for t in range(ntrees):
+        for t in range(len(trees), ntrees):
             key, k1, k2, k3 = jax.random.split(key, 4)
             res, hess = _grad_hess(dist, F, y)
             wt = self._sample_weights(w, k1, sample_rate)

@@ -1,0 +1,26 @@
+"""Checkpoint-restart tests (ModelBuilder.java:1401 semantics)."""
+
+import numpy as np
+
+import h2o3_tpu
+import h2o3_tpu.models
+from h2o3_tpu.core.frame import Frame
+
+
+def test_gbm_checkpoint_restart():
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (300, 4))
+    y = X[:, 0] * 2 + np.sin(X[:, 1] * 3)
+    f = Frame.from_dict({**{f"x{j}": X[:, j] for j in range(4)}, "y": y})
+    m1 = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=5, max_depth=3, seed=1, model_id="ck_m1")
+    m1.train(y="y", training_frame=f)
+    mse5 = m1._output.training_metrics.mse
+    m2 = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=15, max_depth=3, seed=1, checkpoint="ck_m1")
+    m2.train(y="y", training_frame=f)
+    assert m2._trees.ntrees == 15
+    mse15 = m2._output.training_metrics.mse
+    assert mse15 < mse5    # continued boosting must improve training fit
+    h2o3_tpu.remove("ck_m1")
+    h2o3_tpu.remove(m2.key)

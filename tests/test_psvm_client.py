@@ -1,0 +1,49 @@
+"""PSVM + h2o-py-style client shim tests."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Frame
+
+
+def test_psvm_nonlinear():
+    rng = np.random.default_rng(1)
+    X = rng.normal(0, 1, (400, 4))
+    y = ((X[:, 0] ** 2 + X[:, 1] ** 2) < 1.5).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+    svm = H2OSupportVectorMachineEstimator(kernel_type="gaussian",
+                                           max_iterations=100)
+    svm.train(y="y", training_frame=f)
+    assert svm._output.training_metrics.auc > 0.9
+
+
+def test_client_frame_ops():
+    from h2o3_tpu import client as h2o
+    fr = h2o.H2OFrame({"a": [1.0, 2.0, 3.0, 4.0],
+                       "b": [10.0, 20.0, 30.0, 40.0]})
+    assert fr.shape == (4, 2)
+    c = fr["a"] + fr["b"] * 2
+    np.testing.assert_allclose(c.frame.vecs[0].to_numpy(), [21, 42, 63, 84])
+    sub = fr[fr["a"] > 2]
+    assert sub.nrows == 2
+    assert fr["a"].mean() == 2.5
+    fr["d"] = fr["a"].sqrt()
+    assert "d" in fr.names
+    np.testing.assert_allclose(fr["d"].frame.vecs[0].to_numpy(),
+                               np.sqrt([1, 2, 3, 4]), rtol=1e-6)
+
+
+def test_client_groupby_and_split():
+    from h2o3_tpu import client as h2o
+    fr = h2o.H2OFrame({"g": np.array(["a", "b", "a", "b"], object),
+                       "v": [1.0, 2.0, 3.0, 4.0]})
+    gb = fr.group_by("g").sum("v").get_frame()
+    assert gb.nrows == 2
+    sums = sorted(gb.frame.vecs[1].to_numpy().tolist())
+    assert sums == [4.0, 6.0]
+    tr, te = fr.split_frame(ratios=[0.5], seed=42)
+    assert tr.nrows + te.nrows == 4
