@@ -30,16 +30,17 @@ def _steps(seed: int):
     from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator as GBM
     from h2o3_tpu.models.tree.drf import H2ORandomForestEstimator as DRF
     from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator as DL
+    from h2o3_tpu.models.tree.xgboost import H2OXGBoostEstimator as XGB
     s = seed if seed and seed > 0 else 1
     return [
-        # XGBoost steps → native GBM histogram engine (hist semantics)
-        ("XGBoost_1", GBM, dict(ntrees=50, max_depth=10, min_rows=5,
+        # XGBoost steps (XGBoostStepsProvider defaults) on the native engine
+        ("XGBoost_1", XGB, dict(ntrees=50, max_depth=10, min_rows=5, nbins=20,
                                 learn_rate=0.3, sample_rate=0.8,
                                 col_sample_rate_per_tree=0.8, seed=s)),
-        ("XGBoost_2", GBM, dict(ntrees=50, max_depth=6, min_rows=10,
+        ("XGBoost_2", XGB, dict(ntrees=50, max_depth=6, min_rows=10, nbins=20,
                                 learn_rate=0.3, sample_rate=0.6,
                                 col_sample_rate_per_tree=0.8, seed=s)),
-        ("XGBoost_3", GBM, dict(ntrees=50, max_depth=15, min_rows=3,
+        ("XGBoost_3", XGB, dict(ntrees=50, max_depth=15, min_rows=3, nbins=20,
                                 learn_rate=0.3, sample_rate=0.8, seed=s)),
         ("GLM_1", GLM, dict(alpha=0.5, lambda_search=True, nlambdas=10,
                             max_iterations=20)),
@@ -135,8 +136,7 @@ class H2OAutoML:
         se_candidates = []
         for name, cls, params in _steps(self.seed):
             algo = cls.algo
-            if self.include_algos is not None and algo not in self.include_algos \
-                    and not (algo == "gbm" and "xgboost" in self.include_algos):
+            if self.include_algos is not None and algo not in self.include_algos:
                 continue
             if algo in self.exclude_algos:
                 continue

@@ -22,6 +22,7 @@ from h2o3_tpu.models.rulefit import H2ORuleFitEstimator
 from h2o3_tpu.models.generic import H2OGenericEstimator
 from h2o3_tpu.models.segments import train_segments, SegmentModels
 from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+from h2o3_tpu.models.tree.xgboost import H2OXGBoostEstimator
 
 ESTIMATORS = {
     "kmeans": H2OKMeansEstimator,
@@ -44,4 +45,5 @@ ESTIMATORS = {
     "rulefit": H2ORuleFitEstimator,
     "generic": H2OGenericEstimator,
     "psvm": H2OSupportVectorMachineEstimator,
+    "xgboost": H2OXGBoostEstimator,
 }

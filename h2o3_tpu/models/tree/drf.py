@@ -55,7 +55,10 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                     col, thr, nal, val, heap, g = grower.grow(
                         X, wt, onehot[:, c], key=kc, mtries=mtries)
                     gains_tot = gains_tot + g
-                    trees_k[c].append((col, thr, nal, val))
+                    trees_k[c].append((col, thr, nal, val,
+                                       E.node_covers(heap, wt,
+                                                     nodes=grower.nodes,
+                                                     D=grower.D)))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
             self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
         else:
@@ -66,7 +69,9 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                 col, thr, nal, val, heap, g = grower.grow(X, wt, y, key=k2,
                                                           mtries=mtries)
                 gains_tot = gains_tot + g
-                trees.append((col, thr, nal, val))
+                trees.append((col, thr, nal, val,
+                              E.node_covers(heap, wt, nodes=grower.nodes,
+                                            D=grower.D)))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
             self._trees = E.stack_trees(trees, grower.D)
         self._varimp_from_gains(np.asarray(gains_tot, np.float64))
@@ -74,6 +79,10 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
             "number_of_trees": ntrees, "max_depth": grower.D,
             "mtries": mtries, "sample_rate": sample_rate,
         }
+
+    def _contrib_scale_bias(self):
+        # DRF prediction is the tree average (probability space for binomial)
+        return 1.0 / self._trees.ntrees, 0.0
 
     def _score_matrix(self, X):
         K = self.nclasses

@@ -116,13 +116,19 @@ def build_histograms(bins, lv, stats, L, B):
 
 
 def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
-                     col_mask, B):
+                     col_mask, B, reg_lambda=0.0):
     """Vectorized DecidedNode.bestCol over every (leaf, col, threshold,
     NA-dir). col_mask: (L, C) bool — per-leaf column availability (mtries).
 
     hist: (L, C, B+1, 3); slot B is the NA bucket. Returns per-leaf arrays:
       did, gain, col, thr, na_left, leaf_w, leaf_wy.
     Split at t ∈ [0,B-1): left = bins ≤ t (+NA if na_left), right = rest.
+
+    reg_lambda > 0 turns the SE reduction into the XGBoost regularized
+    structure score: se = wyy - wy²/(w+λ). Since wyy is additive over a
+    leaf's children it cancels in the gain difference, so the argmax is
+    EXACTLY hist-mode XGBoost's Σ G²/(H+λ) split objective when the caller
+    feeds hessian-weighted stats (w = Σh, wy = Σg).
     """
     w = hist[..., 0]
     wy = hist[..., 1]
@@ -132,7 +138,8 @@ def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
     main_wyy, na_wyy = wyy[..., :B], wyy[..., B]
 
     def se(w_, wy_, wyy_):
-        return wyy_ - jnp.where(w_ > 0, wy_ * wy_ / jnp.maximum(w_, 1e-30), 0.0)
+        den = jnp.maximum(w_ + reg_lambda, 1e-30)
+        return wyy_ - jnp.where(w_ > 0, wy_ * wy_ / den, 0.0)
 
     tot_w = main_w.sum(-1) + na_w                      # (L, C) — same ∀ c
     tot_wy = main_wy.sum(-1) + na_wy
@@ -184,7 +191,7 @@ def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
 @functools.partial(jax.jit, static_argnames=("d", "B", "mtries"))
 def _level_step(X, stats, w_in, leaf, heap, active, colA, thrA, nalA, valA,
                 gains, col_mask, key, *, d, B, mtries,
-                min_rows, min_split_improvement):
+                min_rows, min_split_improvement, reg_lambda=0.0):
     L = 2 ** d
     C = X.shape[1]
     in_sample = active & (w_in > 0)
@@ -200,7 +207,8 @@ def _level_step(X, stats, w_in, leaf, heap, active, colA, thrA, nalA, valA,
     else:
         cmask = jnp.broadcast_to(col_mask[None, :], (L, C))
     did, gain, bcol, thr, nal, lw, lwy = find_best_splits(
-        hist, mn, mx, min_rows, min_split_improvement, cmask, B)
+        hist, mn, mx, min_rows, min_split_improvement, cmask, B,
+        reg_lambda=reg_lambda)
     base = 2 ** d - 1
     lvl_val = jnp.where(lw > 0, lwy / jnp.maximum(lw, 1e-30), 0.0)
     colA = jax.lax.dynamic_update_slice(
@@ -235,14 +243,44 @@ def _final_leaves(stats, leaf, active, w_in, valA, *, D):
     return jax.lax.dynamic_update_slice(valA, vals, (2 ** D - 1,))
 
 
-@functools.partial(jax.jit, static_argnames=("nodes", "scale"))
-def gamma_pass(heap, w, res, hess, val, *, nodes, scale=1.0):
-    """GammaPass (GBM.java:1235) on device: Newton leaf Σw·res / Σw·hess."""
+@functools.partial(jax.jit,
+                   static_argnames=("nodes", "scale", "reg_lambda",
+                                    "reg_alpha"))
+def gamma_pass(heap, w, res, hess, val, *, nodes, scale=1.0,
+               reg_lambda=0.0, reg_alpha=0.0):
+    """GammaPass (GBM.java:1235) on device: Newton leaf Σw·res / Σw·hess.
+    With reg_lambda/reg_alpha this is the XGBoost leaf weight
+    sign(G)·max(|G|−α, 0)/(H+λ)."""
     num = jax.ops.segment_sum(w * res, heap, num_segments=nodes)
     den = jax.ops.segment_sum(w * hess, heap, num_segments=nodes)
+    if reg_alpha:
+        num = jnp.sign(num) * jnp.maximum(jnp.abs(num) - reg_alpha, 0.0)
+    den = den + reg_lambda
     return jnp.where(den > 1e-10,
                      jnp.clip(scale * num / jnp.maximum(den, 1e-10), -19, 19),
                      val).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("nodes", "D"))
+def _node_covers_jit(heap, w, *, nodes, D):
+    cov = jax.ops.segment_sum(w, heap, num_segments=nodes)
+    for d in range(D - 1, -1, -1):
+        lo, hi = 2 ** d - 1, 2 ** (d + 1) - 1
+        kids = cov[2 * lo + 1: 2 * hi + 1].reshape(hi - lo, 2).sum(axis=1)
+        cov = cov.at[lo:hi].add(kids)
+    return cov.astype(jnp.float32)
+
+
+def node_covers(heap, w, *, nodes, D):
+    """Per-node training weight R_j (MOJO node-weight analog, used by
+    TreeSHAP): terminal weights from the row router, then children sums
+    propagate up the heap level by level."""
+    cov = _node_covers_jit(heap, w, nodes=nodes, D=D)
+    if _CPU_BACKEND:
+        # same flaky-CPU-collective guard as TreeGrower.grow: this program
+        # contains a psum over the sharded row axis — drain before piling on
+        jax.block_until_ready(cov)
+    return cov
 
 
 # ===========================================================================
@@ -257,6 +295,7 @@ class TreeArrays:
     na_left: object   # (T, nodes) bool
     value: object     # (T, nodes) f32 — prediction if stopped here
     depth: int
+    cover: object = None   # (T, nodes) f32 training weight per node (SHAP)
 
     @property
     def ntrees(self):
@@ -264,13 +303,17 @@ class TreeArrays:
 
 
 def stack_trees(tree_list, depth) -> TreeArrays:
-    """Stack per-tree device arrays into one ensemble — stays on device."""
+    """Stack per-tree device arrays into one ensemble — stays on device.
+    Accepts (col, thr, nal, val) or (col, thr, nal, val, cover) tuples."""
+    cover = None
+    if len(tree_list[0]) >= 5:
+        cover = jnp.stack([t[4] for t in tree_list])
     return TreeArrays(
         col=jnp.stack([t[0] for t in tree_list]),
         thr=jnp.stack([t[1] for t in tree_list]),
         na_left=jnp.stack([t[2] for t in tree_list]),
         value=jnp.stack([t[3] for t in tree_list]),
-        depth=depth)
+        depth=depth, cover=cover)
 
 
 def predict_ensemble(X, trees: TreeArrays, weights=None):
@@ -353,11 +396,12 @@ class TreeGrower:
     round-trips. Returns device arrays; used by the GBM/DRF/IF drivers."""
 
     def __init__(self, nbins: int, max_depth: int, min_rows: float,
-                 min_split_improvement: float):
+                 min_split_improvement: float, reg_lambda: float = 0.0):
         self.B = int(nbins)
         self.D = int(max_depth)
         self.min_rows = float(min_rows)
         self.msi = float(min_split_improvement)
+        self.reg_lambda = float(reg_lambda)
         self.nodes = 2 ** (self.D + 1) - 1
 
     def grow(self, X, w, grad, col_mask=None, key=None, mtries: int = 0):
@@ -385,12 +429,21 @@ class TreeGrower:
             leaf, heap, active, colA, thrA, nalA, valA, gains = _level_step(
                 X, stats, w, leaf, heap, active, colA, thrA, nalA, valA,
                 gains, col_mask, key, d=d, B=self.B, mtries=int(mtries),
-                min_rows=self.min_rows, min_split_improvement=self.msi)
+                min_rows=self.min_rows, min_split_improvement=self.msi,
+                reg_lambda=self.reg_lambda)
+            if _CPU_BACKEND:
+                # XLA CPU collectives abort flakily when programs containing
+                # all-reduces pile up in the async queue (virtual-device test
+                # mesh only): drain per level. And since the controller is
+                # synchronous here anyway, stop growing once every row is
+                # frozen — deep levels of unbalanced limits (max_depth 15+ on
+                # small data) would otherwise compile and run for nothing.
+                # TPU stays fully async at fixed depth.
+                jax.block_until_ready(valA)
+                if not bool(jnp.any(active)):
+                    return colA, thrA, nalA, valA, heap, gains
         valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
         if _CPU_BACKEND:
-            # XLA CPU collectives abort flakily when programs containing
-            # all-reduces pile up in the async queue (virtual-device test
-            # mesh only); drain the queue once per tree. TPU stays async.
             jax.block_until_ready(valA)
         return colA, thrA, nalA, valA, heap, gains
 

@@ -79,6 +79,36 @@ class SharedTreeEstimator(ModelBase):
         kth = jnp.sort(r)[k - 1]
         return r <= kth
 
+    def _per_level_mtries(self, C) -> int:
+        """col_sample_rate (GBM) / colsample_bylevel (XGBoost) → per-level
+        column subsampling, realized as the engine's per-(level,leaf) mtries
+        draw. 0 = disabled."""
+        rate = float(self.params.get("col_sample_rate") or 1.0)
+        if rate >= 1.0:
+            return 0
+        return max(1, int(round(rate * C)))
+
+    # ---- SHAP contributions (Model.PredictContributions analog) ----------
+    def predict_contributions(self, test_data: Frame) -> Frame:
+        """Per-row TreeSHAP feature contributions + BiasTerm, in margin
+        space; rows sum to the margin prediction (genmodel parity)."""
+        from h2o3_tpu.models.tree import contrib
+        assert getattr(self, "_trees", None) is not None, \
+            "contributions supported for regression/binomial tree models"
+        X = np.asarray(self._dinfo.matrix(test_data),
+                       np.float64)[: test_data.nrows]
+        phi = contrib.ensemble_shap(self._trees, X)
+        scale, bias0 = self._contrib_scale_bias()
+        phi *= scale
+        phi[:, -1] += bias0
+        names = list(self._dinfo.feature_names) + ["BiasTerm"]
+        from h2o3_tpu.core.frame import Vec
+        return Frame(names, [Vec.from_numpy(phi[:, j])
+                             for j in range(phi.shape[1])])
+
+    def _contrib_scale_bias(self):
+        return 1.0, 0.0
+
     def _varimp_from_gains(self, gains: np.ndarray):
         names = self._dinfo.feature_names
         tot = gains.sum() or 1.0
@@ -142,10 +172,21 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             pt = prev._trees
             assert pt.depth == grower.D, \
                 "checkpoint restart requires identical max_depth"
+            if pt.cover is not None:
+                pcov = pt.cover
+            else:
+                # prior model predates cover recording: rebuild covers by
+                # routing the current training rows through its trees (an
+                # approximation of the original in-sample weights, but keeps
+                # TreeSHAP's sum-to-margin property intact)
+                heaps, _ = E.predict_leaf_ids(X, pt)
+                pcov = [E.node_covers(heaps[i], w, nodes=grower.nodes,
+                                      D=grower.D) for i in range(pt.ntrees)]
             for i in range(pt.ntrees):
                 trees.append((jnp.asarray(pt.col[i]), jnp.asarray(pt.thr[i]),
                               jnp.asarray(pt.na_left[i]),
-                              jnp.asarray(pt.value[i])))
+                              jnp.asarray(pt.value[i]),
+                              jnp.asarray(pcov[i])))
             self._f0 = f0 = prev._f0
             F = f0 + lr * E.predict_ensemble(X, pt)
         gains_tot = jnp.zeros(X.shape[1], jnp.float32)
@@ -155,13 +196,15 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             res, hess = _grad_hess(dist, F, y)
             wt = self._sample_weights(w, k1, sample_rate)
             cmask = self._col_mask(X.shape[1], k2)
-            col, thr, nal, val, heap, g = grower.grow(X, wt, res,
-                                                      col_mask=cmask, key=k3)
+            col, thr, nal, val, heap, g = grower.grow(
+                X, wt, res, col_mask=cmask, key=k3,
+                mtries=self._per_level_mtries(X.shape[1]))
             gains_tot = gains_tot + g
             if dist != "gaussian":   # GammaPass Newton refit (device)
                 val = E.gamma_pass(heap, wt, res, hess, val,
                                    nodes=grower.nodes)
-            trees.append((col, thr, nal, val))
+            cover = E.node_covers(heap, wt, nodes=grower.nodes, D=grower.D)
+            trees.append((col, thr, nal, val, cover))
             F = F + lr * val[heap]
             if (t + 1) % interval == 0 or t == ntrees - 1:
                 self._record_history(t + 1, F, y, w, dist)
@@ -207,12 +250,15 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
                 key, kc = jax.random.split(key)
                 res = R[:, c]
                 col, thr, nal, val, heap, g = grower.grow(
-                    X, wt, res, col_mask=cmask, key=kc)
+                    X, wt, res, col_mask=cmask, key=kc,
+                    mtries=self._per_level_mtries(X.shape[1]))
                 gains_tot = gains_tot + g
                 absr = jnp.abs(res)
                 val = E.gamma_pass(heap, wt, res, absr * (1 - absr), val,
                                    nodes=grower.nodes, scale=(K - 1) / K)
-                trees_k[c].append((col, thr, nal, val))
+                cover = E.node_covers(heap, wt, nodes=grower.nodes,
+                                      D=grower.D)
+                trees_k[c].append((col, thr, nal, val, cover))
                 newF.append(F[:, c] + lr * val[heap])
             F = jnp.stack(newF, axis=1)
             if (t + 1) % interval == 0 or t == ntrees - 1:
@@ -237,6 +283,9 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             return jax.nn.softmax(jnp.stack(Fs, axis=1), axis=1)
         F = self._f0 + lr * E.predict_ensemble(X, self._trees)
         return _link_inv_dist(self._dist, F)
+
+    def _contrib_scale_bias(self):
+        return float(self.params["learn_rate"]), float(self._f0)
 
     # ---- scoring history / early stopping -------------------------------
     def _record_history(self, ntrees, F, y, w, dist):

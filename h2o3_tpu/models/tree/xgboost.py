@@ -1,0 +1,228 @@
+"""XGBoost — the TPU-native replacement for H2O's XGBoost extension.
+
+Reference: h2o-extensions/xgboost/ (~15k LoC Java glue around the native
+xgboost4j C++/CUDA booster): frame→DMatrix conversion
+(matrix/DenseMatrixFactory.java), per-node native boosters driven by node
+tasks (task/XGBoostUpdateTask.java:7 — booster.update per iteration :20),
+Rabit ring-allreduce histogram sync (rabit/RabitTrackerH2O.java:14), backend
+and tree_method selection (XGBoostModel.java:125,143,239-263). SURVEY.md §2.4
+names this the BASELINE "gpu_hist → TPU" target.
+
+TPU-native design: there is no external booster and no Rabit tracker — the
+same fused histogram level-programs that power GBM/DRF run XGBoost's
+`tree_method=hist` math directly on the MXU, and every histogram reduction is
+an XLA psum over ICI (the ring-allreduce is the compiler's problem, not a
+tracker process). The split objective is exact: engine.find_best_splits with
+reg_lambda feeds hessian-weighted stats (w=Σh, wy=Σg), making the gain
+argmax Σ G²/(H+λ) — hist-mode XGBoost's structure score — and leaf weights
+are sign(G)·max(|G|−α,0)/(H+λ) via engine.gamma_pass.
+
+Parameter surface mirrors h2o-py's H2OXGBoostEstimator (xgboost-style
+aliases accepted: eta, min_child_weight, colsample_bytree, max_bins,
+min_split_loss / gamma via min_split_improvement).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models.tree import engine as E
+from h2o3_tpu.models.tree.shared_tree import (
+    H2OGradientBoostingEstimator, SharedTreeEstimator, _link_inv_dist)
+
+
+def _objective_grad_hess(dist, F, y):
+    """True second-order (g, h) per objective — hist-mode booster math.
+    Sign convention follows the engine: res = −g (descent direction)."""
+    if dist == "gaussian":                       # reg:squarederror
+        return y - F, jnp.ones_like(F)
+    if dist == "bernoulli":                      # binary:logistic
+        p = jax.nn.sigmoid(F)
+        return y - p, jnp.maximum(p * (1 - p), 1e-6)
+    if dist == "poisson":                        # count:poisson
+        mu = jnp.exp(F)
+        return y - mu, mu
+    if dist == "gamma":                          # reg:gamma
+        mu = jnp.exp(F)
+        return y / mu - 1.0, jnp.maximum(y / mu, 1e-6)
+    if dist == "tweedie":
+        mu = jnp.exp(F)
+        return (y * jnp.power(mu, -0.5) - jnp.power(mu, 0.5),
+                jnp.maximum(0.5 * (y * jnp.power(mu, -0.5)
+                                   + jnp.power(mu, 0.5)), 1e-6))
+    raise NotImplementedError(f"XGBoost objective for {dist}")
+
+
+class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
+    """Inherits the GBM driver's scoring-history/early-stop machinery; the
+    boosting loop itself is replaced with hessian-weighted hist updates."""
+    algo = "xgboost"
+    _defaults = dict(SharedTreeEstimator._tree_defaults)
+    _defaults.update({
+        # xgboost defaults (XGBoostModel.XGBoostParameters)
+        "ntrees": 50, "max_depth": 6, "min_rows": 1.0, "learn_rate": 0.3,
+        "sample_rate": 1.0, "col_sample_rate": 1.0,
+        "col_sample_rate_per_tree": 1.0, "nbins": 256,
+        "reg_lambda": 1.0, "reg_alpha": 0.0, "min_split_improvement": 0.0,
+        "tree_method": "hist", "booster": "gbtree", "backend": "auto",
+        "scale_pos_weight": 1.0,
+        # accepted xgboost-style aliases (resolved in __init__)
+        "eta": None, "min_child_weight": None, "colsample_bytree": None,
+        "colsample_bylevel": None, "subsample": None, "max_bins": None,
+        "min_split_loss": None, "gamma": None, "max_leaves": 0,
+        "grow_policy": "depthwise", "dmatrix_type": "auto",
+    })
+    _ALIASES = {
+        "eta": "learn_rate", "min_child_weight": "min_rows",
+        "colsample_bytree": "col_sample_rate_per_tree",
+        "colsample_bylevel": "col_sample_rate",
+        "subsample": "sample_rate", "max_bins": "nbins",
+        "min_split_loss": "min_split_improvement",
+        "gamma": "min_split_improvement",
+    }
+
+    def __init__(self, **params):
+        super().__init__(**params)
+        for alias, target in self._ALIASES.items():
+            v = self.params.get(alias)
+            if v is not None:
+                self.params[target] = v
+        tm = self.params.get("tree_method", "hist")
+        assert tm in ("auto", "hist", "approx", "exact"), tm
+        assert self.params.get("booster", "gbtree") in ("gbtree", "dart"), \
+            "gblinear: use H2OGeneralizedLinearEstimator"
+
+    def _grower(self):
+        p = self.params
+        return E.TreeGrower(
+            nbins=int(p["nbins"]), max_depth=int(p["max_depth"]),
+            min_rows=float(p["min_rows"]),           # on Σhess = min_child_weight
+            min_split_improvement=float(p["min_split_improvement"]),
+            reg_lambda=float(p["reg_lambda"]))
+
+    # ---- boosting driver (_resolve_dist inherited from GBM) --------------
+    def _fit(self, frame: Frame, job):
+        dist = self._resolve_dist()
+        self._dist = dist
+        X, y, w = self._prep(frame)
+        if dist == "multinomial":
+            return self._fit_multinomial(X, y, w, job)
+        ntrees = int(self.params["ntrees"])
+        eta = float(self.params["learn_rate"])
+        lam = float(self.params["reg_lambda"])
+        alpha = float(self.params["reg_alpha"])
+        spw = float(self.params.get("scale_pos_weight") or 1.0)
+        seed = int(self.params.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
+        grower = self._grower()
+        if dist == "bernoulli" and spw != 1.0:
+            w = w * jnp.where(y > 0.5, spw, 1.0)
+        # xgboost starts from base_score=0.5 in link space ⇒ F0 = 0 for
+        # logistic/identity, log(0.5)-free; we use 0.5 raw / 0 margin
+        self._f0 = f0 = 0.0 if dist != "gaussian" else float(
+            np.asarray(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-30)))
+        F = jnp.full(X.shape[0], f0, jnp.float32)
+        sample_rate = float(self.params["sample_rate"])
+        trees = []
+        gains_tot = jnp.zeros(X.shape[1], jnp.float32)
+        interval = max(1, int(self.params.get("score_tree_interval") or 5))
+        for t in range(ntrees):
+            key, k1, k2, k3 = jax.random.split(key, 4)
+            g, h = _objective_grad_hess(dist, F, y)
+            wt = self._sample_weights(w, k1, sample_rate)
+            cmask = self._col_mask(X.shape[1], k2)
+            # hessian-weighted stats: w_stat=Σwh (→H), wy=Σwg (→G)
+            col, thr, nal, val, heap, gn = grower.grow(
+                X, wt * h, g / h, col_mask=cmask, key=k3,
+                mtries=self._per_level_mtries(X.shape[1]))
+            gains_tot = gains_tot + gn
+            val = E.gamma_pass(heap, wt, g, h, val, nodes=grower.nodes,
+                               reg_lambda=lam, reg_alpha=alpha)
+            cover = E.node_covers(heap, wt * h, nodes=grower.nodes,
+                                  D=grower.D)
+            trees.append((col, thr, nal, val, cover))
+            F = F + eta * val[heap]
+            if (t + 1) % interval == 0 or t == ntrees - 1:
+                self._record_history(t + 1, F, y, w, dist)
+                if self._should_stop():
+                    break
+            job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+        self._trees = E.stack_trees(trees, grower.D)
+        self._varimp_from_gains(np.asarray(gains_tot, np.float64))
+        self._output.model_summary = {
+            "number_of_trees": self._trees.ntrees, "max_depth": grower.D,
+            "objective": {"gaussian": "reg:squarederror",
+                          "bernoulli": "binary:logistic",
+                          "poisson": "count:poisson",
+                          "gamma": "reg:gamma",
+                          "tweedie": "reg:tweedie"}[dist],
+            "tree_method": "hist", "eta": eta, "reg_lambda": lam,
+        }
+
+    def _fit_multinomial(self, X, y, w, job):
+        K = self.nclasses
+        ntrees = int(self.params["ntrees"])
+        eta = float(self.params["learn_rate"])
+        lam = float(self.params["reg_lambda"])
+        alpha = float(self.params["reg_alpha"])
+        seed = int(self.params.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed > 0 else 42)
+        grower = self._grower()
+        yi = y.astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, K)
+        self._f0 = np.zeros(K, np.float32)
+        F = jnp.zeros((X.shape[0], K), jnp.float32)
+        sample_rate = float(self.params["sample_rate"])
+        trees_k = [[] for _ in range(K)]
+        gains_tot = jnp.zeros(X.shape[1], jnp.float32)
+        interval = max(1, int(self.params.get("score_tree_interval") or 5))
+        for t in range(ntrees):
+            key, k1, k2 = jax.random.split(key, 3)
+            P = jax.nn.softmax(F, axis=1)
+            wt = self._sample_weights(w, k1, sample_rate)
+            cmask = self._col_mask(X.shape[1], k2)
+            newF = []
+            for c in range(K):
+                key, kc = jax.random.split(key)
+                g = onehot[:, c] - P[:, c]
+                h = jnp.maximum(2.0 * P[:, c] * (1 - P[:, c]), 1e-6)
+                col, thr, nal, val, heap, gn = grower.grow(
+                    X, wt * h, g / h, col_mask=cmask, key=kc,
+                    mtries=self._per_level_mtries(X.shape[1]))
+                gains_tot = gains_tot + gn
+                val = E.gamma_pass(heap, wt, g, h, val, nodes=grower.nodes,
+                                   reg_lambda=lam, reg_alpha=alpha)
+                cover = E.node_covers(heap, wt * h, nodes=grower.nodes,
+                                      D=grower.D)
+                trees_k[c].append((col, thr, nal, val, cover))
+                newF.append(F[:, c] + eta * val[heap])
+            F = jnp.stack(newF, axis=1)
+            if (t + 1) % interval == 0 or t == ntrees - 1:
+                self._record_history_multi(t + 1, F, y, w)
+                if self._should_stop():
+                    break
+            job.update(0.1 + 0.8 * (t + 1) / ntrees, f"iter {t+1}")
+        self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
+        self._varimp_from_gains(np.asarray(gains_tot, np.float64))
+        self._output.model_summary = {
+            "number_of_trees": sum(t.ntrees for t in self._trees_k),
+            "max_depth": grower.D, "objective": "multi:softprob",
+        }
+
+    # ---- scoring ---------------------------------------------------------
+    def _score_matrix(self, X):
+        eta = float(self.params["learn_rate"])
+        if self._dist == "multinomial":
+            Fs = [eta * E.predict_ensemble(X, ta) for ta in self._trees_k]
+            return jax.nn.softmax(jnp.stack(Fs, axis=1), axis=1)
+        F = self._f0 + eta * E.predict_ensemble(X, self._trees)
+        return _link_inv_dist(self._dist, F)
+
+    @staticmethod
+    def available() -> bool:
+        """h2o.estimators.xgboost.H2OXGBoostEstimator.available() parity —
+        always true here: the booster is the in-tree TPU engine."""
+        return True

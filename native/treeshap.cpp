@@ -1,0 +1,167 @@
+// Exact path-dependent TreeSHAP over dense heap-order tree ensembles.
+//
+// The reference computes per-row SHAP contributions in Java inside the
+// genmodel scoring artifact (hex/genmodel PredictContributions for
+// GBM/DRF/XGBoost MOJOs); this is the native-runtime equivalent for the TPU
+// framework's dense heap trees (h2o3_tpu/models/tree/engine.py TreeArrays).
+// Algorithm: Lundberg & Lee's polynomial-time recursion (EXTEND / UNWIND
+// over the active decision path), implemented from the published algorithm.
+//
+// Tree encoding per tree t (heap order, node i children 2i+1 / 2i+2):
+//   col[t][i]   >= 0 split column, -1 leaf
+//   thr[t][i]   split threshold (x > thr goes right)
+//   nal[t][i]   NA goes left?  (uint8)
+//   val[t][i]   node value (prediction if play stops here)
+//   cover[t][i] training weight through the node (R_j)
+//
+// phi layout: (nrows, ncols+1); last slot is the bias term.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct PathElem {
+  int feature;       // -1 for the initial (empty) element
+  double zero_frac;  // fraction of "cold" (background) paths
+  double one_frac;   // 1 if x follows this branch, else 0
+  double pweight;    // permutation weight
+};
+
+// EXTEND: grow the path by one split (Lundberg Alg. 2).
+void extend(std::vector<PathElem>& p, int depth, double zero_frac,
+            double one_frac, int feature) {
+  p[depth] = {feature, zero_frac, one_frac, depth == 0 ? 1.0 : 0.0};
+  for (int i = depth - 1; i >= 0; --i) {
+    p[i + 1].pweight += one_frac * p[i].pweight * (i + 1) / double(depth + 1);
+    p[i].pweight = zero_frac * p[i].pweight * (depth - i) / double(depth + 1);
+  }
+}
+
+// UNWIND: undo an extend for the element at index `index` (Lundberg Alg. 3).
+void unwind(std::vector<PathElem>& p, int depth, int index) {
+  double one_frac = p[index].one_frac;
+  double zero_frac = p[index].zero_frac;
+  double n = p[depth].pweight;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_frac != 0.0) {
+      double tmp = p[i].pweight;
+      p[i].pweight = n * (depth + 1) / ((i + 1) * one_frac);
+      n = tmp - p[i].pweight * zero_frac * (depth - i) / double(depth + 1);
+    } else {
+      p[i].pweight = p[i].pweight * (depth + 1) / (zero_frac * (depth - i));
+    }
+  }
+  for (int i = index; i < depth; ++i) {
+    p[i].feature = p[i + 1].feature;
+    p[i].zero_frac = p[i + 1].zero_frac;
+    p[i].one_frac = p[i + 1].one_frac;
+  }
+}
+
+double unwound_sum(const std::vector<PathElem>& p, int depth, int index) {
+  double one_frac = p[index].one_frac;
+  double zero_frac = p[index].zero_frac;
+  double n = p[depth].pweight;
+  double total = 0.0;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_frac != 0.0) {
+      double t = n * (depth + 1) / ((i + 1) * one_frac);
+      total += t;
+      n = p[i].pweight - t * zero_frac * (depth - i) / double(depth + 1);
+    } else {
+      total += p[i].pweight / (zero_frac * (depth - i) / double(depth + 1));
+    }
+  }
+  return total;
+}
+
+struct Tree {
+  const int32_t* col;
+  const float* thr;
+  const uint8_t* nal;
+  const float* val;
+  const float* cover;
+  int nodes;
+};
+
+// Recursive walk (Lundberg Alg. 2 body). Depth ≤ ~16, stack use is fine.
+void tree_shap_recurse(const Tree& t, const double* x, double* phi,
+                       int node, int depth, std::vector<PathElem> path,
+                       double zero_frac, double one_frac, int pfeature) {
+  extend(path, depth, zero_frac, one_frac, pfeature);
+  int c = t.col[node];
+  if (c < 0 || 2 * node + 2 >= t.nodes ||
+      t.cover[2 * node + 1] + t.cover[2 * node + 2] <= 0.0) {
+    // leaf: credit every feature on the path
+    for (int i = 1; i <= depth; ++i) {
+      double w = unwound_sum(path, depth, i);
+      phi[path[i].feature] +=
+          w * (path[i].one_frac - path[i].zero_frac) * t.val[node];
+    }
+    return;
+  }
+  double xv = x[c];
+  bool isna = xv != xv;
+  bool right = isna ? !t.nal[node] : xv > t.thr[node];
+  int hot = right ? 2 * node + 2 : 2 * node + 1;
+  int cold = right ? 2 * node + 1 : 2 * node + 2;
+  double rnode = t.cover[node];
+  double rhot = t.cover[hot], rcold = t.cover[cold];
+  double incoming_zero = 1.0, incoming_one = 1.0;
+  // consolidate repeated feature on the path
+  int k = -1;
+  for (int i = 1; i <= depth; ++i)
+    if (path[i].feature == c) { k = i; break; }
+  if (k >= 0) {
+    incoming_zero = path[k].zero_frac;
+    incoming_one = path[k].one_frac;
+    unwind(path, depth, k);
+    depth -= 1;
+  }
+  if (rnode <= 0.0) rnode = 1.0;
+  tree_shap_recurse(t, x, phi, hot, depth + 1, path,
+                    incoming_zero * rhot / rnode, incoming_one, c);
+  tree_shap_recurse(t, x, phi, cold, depth + 1, path,
+                    incoming_zero * rcold / rnode, 0.0, c);
+}
+
+}  // namespace
+
+extern "C" {
+
+// phi must be zero-initialized (nrows × (ncols+1)), doubles.
+// Bias column gets Σ_t E[tree_t] = Σ_t Σ_leaf cover·val / cover_root.
+void treeshap_ensemble(int ntrees, int nodes, int max_depth, int ncols,
+                       int64_t nrows, const int32_t* col, const float* thr,
+                       const uint8_t* nal, const float* val,
+                       const float* cover, const double* X, double* phi) {
+  (void)max_depth;
+  for (int t = 0; t < ntrees; ++t) {
+    Tree tr{col + (int64_t)t * nodes, thr + (int64_t)t * nodes,
+            nal + (int64_t)t * nodes, val + (int64_t)t * nodes,
+            cover + (int64_t)t * nodes, nodes};
+    // expected value of this tree under the training distribution
+    double ev = 0.0;
+    {
+      // E[v] over terminal nodes: nodes whose own terminal weight is the
+      // cover minus children covers (rows that stopped there).
+      double root = tr.cover[0] > 0 ? tr.cover[0] : 1.0;
+      for (int i = 0; i < nodes; ++i) {
+        double own = tr.cover[i];
+        if (2 * i + 2 < nodes) own -= tr.cover[2 * i + 1] + tr.cover[2 * i + 2];
+        if (own > 0) ev += own * tr.val[i];
+      }
+      ev /= root;
+    }
+    std::vector<PathElem> init(max_depth + 2);
+    for (int64_t r = 0; r < nrows; ++r) {
+      double* ph = phi + r * (ncols + 1);
+      ph[ncols] += ev;
+      tree_shap_recurse(tr, X + r * ncols, ph, 0, 0, init, 1.0, 1.0, -1);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,117 @@
+"""TreeSHAP predict_contributions (genmodel PredictContributions parity):
+local accuracy (rows sum to margin) + exact Shapley values on a tiny tree."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+
+
+def _margin(model, f):
+    """GBM margin F(x) = f0 + lr·Σ val (undo the link)."""
+    import jax.numpy as jnp
+    from h2o3_tpu.models.tree import engine as E
+    X = np.asarray(model._dinfo.matrix(f), np.float32)[: f.nrows]
+    lr = float(model.params["learn_rate"])
+    return model._f0 + lr * np.asarray(
+        E.predict_ensemble(jnp.asarray(X), model._trees))
+
+
+def test_local_accuracy_gbm():
+    rng = np.random.default_rng(0)
+    n = 300
+    X = rng.normal(0, 1, (n, 5))
+    y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(5)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    from h2o3_tpu.models import H2OGradientBoostingEstimator
+    m = H2OGradientBoostingEstimator(ntrees=8, max_depth=4, seed=3)
+    m.train(y="y", training_frame=f)
+    contrib = m.predict_contributions(f)
+    assert contrib.names[-1] == "BiasTerm"
+    phi = contrib.to_numpy()
+    F = _margin(m, f)
+    assert np.allclose(phi.sum(axis=1), F, atol=1e-3)
+
+
+def test_local_accuracy_xgboost_regression():
+    rng = np.random.default_rng(1)
+    n = 200
+    X = rng.normal(0, 1, (n, 4))
+    y = 2 * X[:, 0] - X[:, 1] * X[:, 2]
+    f = Frame.from_dict({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+                         "d": X[:, 3], "y": y})
+    from h2o3_tpu.models import H2OXGBoostEstimator
+    m = H2OXGBoostEstimator(ntrees=5, max_depth=3, seed=3)
+    m.train(y="y", training_frame=f)
+    phi = m.predict_contributions(f).to_numpy()
+    F = _margin(m, f)
+    assert np.allclose(phi.sum(axis=1), F, atol=1e-3)
+
+
+def _brute_force_shap(col, thr, nal, val, cover, depth, x):
+    """Exponential-definition Shapley values for ONE heap tree.
+
+    E_S(x): expected tree output when features in S take x's values and the
+    rest follow the training distribution (path-dependent: split on j∉S →
+    average children weighted by cover)."""
+    nodes = len(col)
+
+    def expect(node, S):
+        c = col[node]
+        li, ri = 2 * node + 1, 2 * node + 2
+        terminal = c < 0 or li >= nodes or (cover[li] + cover[ri]) <= 0
+        if terminal:
+            return val[node]
+        if c in S:
+            go_right = np.isnan(x[c]) and not nal[node] or \
+                (not np.isnan(x[c]) and x[c] > thr[node])
+            return expect(ri if go_right else li, S)
+        tot = cover[li] + cover[ri]
+        return (cover[li] * expect(li, S) + cover[ri] * expect(ri, S)) / tot
+
+    C = len(x)
+    phi = np.zeros(C + 1)
+    feats = list(range(C))
+    import math
+    for j in feats:
+        others = [k for k in feats if k != j]
+        for r in range(len(others) + 1):
+            for S in itertools.combinations(others, r):
+                wgt = (math.factorial(len(S)) * math.factorial(C - len(S) - 1)
+                       / math.factorial(C))
+                phi[j] += wgt * (expect(0, set(S) | {j}) - expect(0, set(S)))
+    phi[C] = expect(0, set())
+    return phi
+
+
+def test_exact_vs_brute_force():
+    """Train a tiny depth-3, 3-feature GBM tree; native TreeSHAP must equal
+    the exponential Shapley definition."""
+    rng = np.random.default_rng(7)
+    n = 120
+    X = rng.normal(0, 1, (n, 3))
+    y = 1.5 * X[:, 0] - X[:, 1] + 0.5 * X[:, 0] * X[:, 2]
+    f = Frame.from_dict({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": y})
+    from h2o3_tpu.models import H2OGradientBoostingEstimator
+    m = H2OGradientBoostingEstimator(ntrees=2, max_depth=3, seed=5,
+                                     learn_rate=0.7)
+    m.train(y="y", training_frame=f)
+    t = m._trees
+    col = np.asarray(t.col)
+    thr = np.asarray(t.thr)
+    nal = np.asarray(t.na_left)
+    val = np.asarray(t.value)
+    cov = np.asarray(t.cover)
+    from h2o3_tpu.models.tree import contrib
+    Xq = np.asarray(X[:7], np.float64)
+    phi = contrib.ensemble_shap(t, Xq)
+    ref = np.zeros_like(phi)
+    for ti in range(t.ntrees):
+        for r in range(Xq.shape[0]):
+            ref[r] += _brute_force_shap(col[ti], thr[ti], nal[ti], val[ti],
+                                        cov[ti], t.depth, Xq[r])
+    assert np.allclose(phi, ref, atol=1e-4), (phi - ref)

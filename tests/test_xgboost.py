@@ -1,0 +1,78 @@
+"""XGBoost estimator — the TPU-native replacement for the xgboost extension
+(h2o-extensions/xgboost; hist semantics, Rabit → ICI psum)."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+
+
+def _cls_frame(n=600, c=6, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, c))
+    logit = 1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(c)}
+    cols["y"] = np.array(["no", "yes"], object)[y]
+    return Frame.from_dict(cols)
+
+
+def test_xgboost_binary():
+    from h2o3_tpu.models import H2OXGBoostEstimator
+    f = _cls_frame()
+    m = H2OXGBoostEstimator(ntrees=10, max_depth=4, seed=7)
+    m.train(y="y", training_frame=f)
+    assert m.auc() > 0.80
+    p = m.predict(f)
+    assert p.names == ["predict", "pno", "pyes"]
+    probs = p.to_numpy()[:, 1:]
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    for k in (f.key, p.key, m.key):
+        from h2o3_tpu.core.kvstore import DKV
+        DKV.remove(k)
+
+
+def test_xgboost_aliases_and_regularization():
+    from h2o3_tpu.models import H2OXGBoostEstimator
+    f = _cls_frame(n=400)
+    # xgboost-style aliases resolve onto the h2o params
+    m = H2OXGBoostEstimator(ntrees=5, eta=0.2, min_child_weight=2,
+                            subsample=0.9, colsample_bytree=0.8,
+                            max_bins=64, seed=1)
+    assert m.params["learn_rate"] == 0.2
+    assert m.params["min_rows"] == 2
+    assert m.params["nbins"] == 64
+    m.train(y="y", training_frame=f)
+    # heavy L2 shrinks leaf magnitudes → flatter probabilities
+    strong = H2OXGBoostEstimator(ntrees=5, reg_lambda=1000.0, seed=1)
+    strong.train(y="y", training_frame=f)
+    pw = np.abs(m.predict(f).to_numpy()[:, 2] - 0.5).mean()
+    ps = np.abs(strong.predict(f).to_numpy()[:, 2] - 0.5).mean()
+    assert ps < pw
+    from h2o3_tpu.core.kvstore import DKV
+    for k in list(DKV.keys()):
+        DKV.remove(k)
+
+
+def test_xgboost_regression_and_multiclass():
+    from h2o3_tpu.models import H2OXGBoostEstimator
+    rng = np.random.default_rng(5)
+    n = 500
+    X = rng.normal(0, 1, (n, 4))
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=n)
+    f = Frame.from_dict({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2],
+                         "d": X[:, 3], "y": y})
+    m = H2OXGBoostEstimator(ntrees=10, max_depth=4, seed=2)
+    m.train(y="y", training_frame=f)
+    assert m.rmse() < np.std(y)  # beats the mean predictor
+    # 3-class softprob
+    y3 = np.array(["a", "b", "c"], object)[
+        np.clip(np.digitize(X[:, 0], [-0.5, 0.5]), 0, 2)]
+    f3 = Frame.from_dict({"a": X[:, 0], "b": X[:, 1], "y": y3})
+    m3 = H2OXGBoostEstimator(ntrees=6, max_depth=3, seed=2)
+    m3.train(y="y", training_frame=f3)
+    pm = m3.model_performance(f3)
+    assert pm.logloss < np.log(3)
+    from h2o3_tpu.core.kvstore import DKV
+    for k in list(DKV.keys()):
+        DKV.remove(k)
