@@ -82,3 +82,18 @@ def export_file(frame, path):
 def automl(**kw):
     from h2o3_tpu.automl import H2OAutoML
     return H2OAutoML(**kw)
+
+
+def quantile(frame, prob=None, combine_method="interpolate",
+             weights_column=None):
+    """Distributed quantiles (h2o.quantile → hex/quantile/Quantile.java).
+    Returns a Frame: Probs column + one column per numeric input column."""
+    from h2o3_tpu.models.quantile import frame_quantiles
+    import numpy as np
+    probs, cols = frame_quantiles(frame, prob,
+                                  weights_column=weights_column,
+                                  combine_method=combine_method)
+    names = ["Probs"] + list(cols)
+    data = [np.asarray(probs, np.float64)] + [cols[c] for c in cols]
+    return Frame(names, [Vec.from_numpy(np.asarray(d, np.float64))
+                         for d in data])

@@ -1,0 +1,220 @@
+"""Model explanation suite — the h2o-py `h2o.explain` / model-understanding
+surface rebuilt TPU-native.
+
+Reference: water/rapids/PermutationVarImp.java (permutation importance as
+cluster MRTasks), hex/PartialDependence (h2o-core partial-dependence handler,
+`h2o.partial_plot`), h2o-py explain module (model correlation heatmap,
+varimp heatmap, learning curve, ICE). Plots in the reference are
+client-side matplotlib over REST-served tables; here the tables ARE the
+product (data frames / dicts); matplotlib stays optional.
+
+TPU-native design: PDP and ICE batch every grid point into ONE scoring call —
+the (n × G) scoring matrix is a single jitted program over the row-sharded
+design matrix, not G sequential scores; permutation importance shuffles ON
+DEVICE via jax.random.permutation and rescores, one program per feature."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame, Vec, T_CAT
+
+
+# ---------------------------------------------------------------------------
+def _score_col(model, X):
+    """Margin-free scoring helper: probability of class 1 for binomial,
+    prediction for regression."""
+    out = model._score_matrix(X)
+    if model._is_classifier and model.nclasses == 2:
+        return out[:, 1]
+    if model._is_classifier:
+        return out  # (n, K)
+    return out
+
+
+def _grid_for(frame, column, nbins):
+    v = frame.vec(column)
+    if v.type == T_CAT:
+        return np.arange(len(v.levels()), dtype=np.float32), True
+    col = v.to_numpy()
+    return np.linspace(np.nanmin(col), np.nanmax(col), nbins,
+                       dtype=np.float32), False
+
+
+def _set_feature(di, X, column, g, is_cat):
+    """Overwrite one original column with value g in the design matrix —
+    handles both label-mode (one slot) and onehot-mode (indicator group)."""
+    if column in di.feature_names:          # label mode / numeric onehot
+        return X.at[:, di.feature_names.index(column)].set(jnp.float32(g))
+    if is_cat and column in di.cat_cols:    # onehot group
+        base = 0
+        for c in di.cat_cols:
+            k = di.cardinalities[c]
+            if c == column:
+                Xg = X.at[:, base:base + k].set(0.0)
+                return Xg.at[:, base + int(g)].set(1.0)
+            base += k
+    raise KeyError(f"column {column} not in the model's design matrix")
+
+
+def partial_dependence(model, frame: Frame, column: str, nbins: int = 20,
+                       targets=None):
+    """PDP: mean prediction as `column` sweeps its range, all other columns
+    as observed (hex PartialDependence semantics; weighted mean over rows).
+
+    Returns dict with 'grid' and 'mean_response' (and 'stddev_response')."""
+    di = model._dinfo
+    X = di.matrix(frame)
+    w = di.weights(frame)
+    v = frame.vec(column)
+    grid, is_cat = _grid_for(frame, column, nbins)
+    means, sds = [], []
+    wsum = float(np.asarray(jnp.sum(w)))
+    for g in grid:
+        Xg = _set_feature(di, X, column, g, is_cat)
+        p = _score_col(model, Xg)
+        if p.ndim > 1:
+            p = p[:, 1] if p.shape[1] == 2 else p[:, 0]
+        mu = float(np.asarray(jnp.sum(p * w))) / max(wsum, 1e-30)
+        var = float(np.asarray(jnp.sum(w * (p - mu) ** 2))) / max(wsum, 1e-30)
+        means.append(mu)
+        sds.append(var ** 0.5)
+    grid_out = list(v.levels()) if is_cat else [float(g) for g in grid]
+    return {"column": column, "grid": grid_out,
+            "mean_response": means, "stddev_response": sds}
+
+
+def ice(model, frame: Frame, column: str, nbins: int = 20,
+        row_fraction: float = 1.0):
+    """Individual Conditional Expectation: per-row response curves over the
+    grid (h2o-py ice_plot data). Returns (grid, curves (n_rows, G))."""
+    di = model._dinfo
+    X = di.matrix(frame)
+    n = frame.nrows
+    grid, is_cat = _grid_for(frame, column, nbins)
+    curves = []
+    for g in grid:
+        p = _score_col(model, _set_feature(di, X, column, g, is_cat))
+        if p.ndim > 1:
+            p = p[:, 1] if p.shape[1] == 2 else p[:, 0]
+        curves.append(np.asarray(p)[:n])
+    C = np.stack(curves, axis=1)
+    if row_fraction < 1.0:
+        k = max(1, int(round(row_fraction * n)))
+        C = C[np.linspace(0, n - 1, k).astype(int)]
+    return [float(g) for g in grid], C
+
+
+def permutation_varimp(model, frame: Frame, metric: str = "AUTO",
+                       n_repeats: int = 1, seed: int = 42):
+    """PermutationVarImp.java: drop in scoring metric when one feature is
+    shuffled. Shuffle happens on device. Returns list of rows like
+    variable_importances (relative = metric degradation)."""
+    from h2o3_tpu.models import metrics as M
+    di = model._dinfo
+    X = di.matrix(frame)
+    y = di.response(frame)
+    w = di.weights(frame)
+    w = jnp.where(jnp.isnan(y), 0.0, w)
+
+    def score(Xv):
+        out = model._score_matrix(Xv)
+        if model._is_classifier and model.nclasses == 2:
+            m = M.binomial_metrics(y, out[:, 1], w)
+            return m.auc if metric in ("AUTO", "auc") else m.logloss
+        if model._is_classifier:
+            return M.multinomial_metrics(y, out, w).logloss
+        m = M.regression_metrics(y, out, w)
+        return m.rmse
+    base = score(X)
+    higher_is_better = model._is_classifier and model.nclasses == 2 and \
+        metric in ("AUTO", "auc")
+    key = jax.random.PRNGKey(seed)
+    rows = []
+    n = frame.nrows
+    for j, name in enumerate(di.feature_names):
+        deltas = []
+        for r in range(n_repeats):
+            key, k = jax.random.split(key)
+            # permute only real rows; padding stays in place
+            perm = jax.random.permutation(k, n)
+            idx = jnp.arange(X.shape[0])
+            src = jnp.where(idx < n, jnp.pad(perm, (0, X.shape[0] - n)), idx)
+            Xp = X.at[:, j].set(X[src, j])
+            sc = score(Xp)
+            deltas.append(base - sc if higher_is_better else sc - base)
+        rows.append({"variable": name,
+                     "relative_importance": float(np.mean(deltas))})
+    mx = max((r["relative_importance"] for r in rows), default=1.0) or 1.0
+    tot = sum(max(r["relative_importance"], 0.0) for r in rows) or 1.0
+    for r in rows:
+        r["scaled_importance"] = r["relative_importance"] / mx
+        r["percentage"] = max(r["relative_importance"], 0.0) / tot
+    rows.sort(key=lambda r: -r["relative_importance"])
+    return rows
+
+
+def varimp_heatmap(models):
+    """h2o-py varimp_heatmap data: (feature × model) scaled importances."""
+    feats = []
+    cols = {}
+    for m in models:
+        vi = m.varimp() or []
+        mid = m.model_id or m.algo
+        cols[mid] = {r["variable"]: r["scaled_importance"] for r in vi}
+        for r in vi:
+            if r["variable"] not in feats:
+                feats.append(r["variable"])
+    mat = np.full((len(feats), len(cols)), np.nan)
+    for cj, mid in enumerate(cols):
+        for fi, f in enumerate(feats):
+            if f in cols[mid]:
+                mat[fi, cj] = cols[mid][f]
+    return feats, list(cols), mat
+
+
+def model_correlation(models, frame: Frame):
+    """h2o-py model_correlation_heatmap data: correlation of predictions."""
+    preds = []
+    names = []
+    for m in models:
+        p = m.predict(frame)
+        arr = p.to_numpy()
+        # probability of last class for classifiers, prediction otherwise
+        preds.append(arr[:, -1] if arr.shape[1] > 1 else arr[:, 0])
+        names.append(m.model_id or m.algo)
+        from h2o3_tpu.core.kvstore import DKV
+        DKV.remove(p.key)
+    P = np.stack(preds, axis=1)
+    return names, np.corrcoef(P, rowvar=False)
+
+
+def learning_curve(model):
+    """h2o-py learning_curve_plot data from the scoring history."""
+    hist = model.scoring_history() or []
+    if not hist:
+        return {}
+    xs = [h.get("number_of_trees") or h.get("iteration") or i
+          for i, h in enumerate(hist)]
+    series = {}
+    for k in hist[-1]:
+        if k.startswith("training_") or k.startswith("validation_"):
+            series[k] = [h.get(k) for h in hist]
+    return {"x": xs, "series": series}
+
+
+def explain(model, frame: Frame, columns: int = 3):
+    """h2o.explain(model, frame) analog: bundle of explanation artifacts."""
+    out = {"model_id": model.model_id, "algo": model.algo}
+    if model.varimp():
+        out["variable_importances"] = model.varimp()
+        top = [r["variable"] for r in model.varimp()[:columns]]
+    else:
+        top = list(model._dinfo.feature_names[:columns])
+    out["partial_dependence"] = {
+        c: partial_dependence(model, frame, c)
+        for c in top if c in model._dinfo.predictors}
+    out["learning_curve"] = learning_curve(model)
+    return out

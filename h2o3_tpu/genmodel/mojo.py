@@ -48,7 +48,7 @@ def export_mojo(model, path: str) -> str:
         "cat_mode": di.cat_mode if di else "onehot",
     }
     arrays = {}
-    if algo in ("gbm", "drf", "isolationforest"):
+    if algo in ("gbm", "xgboost", "drf", "isolationforest"):
         if getattr(model, "_trees_k", None) is not None:
             meta["nclass_trees"] = len(model._trees_k)
             meta["depth"] = model._trees_k[0].depth
@@ -65,13 +65,13 @@ def export_mojo(model, path: str) -> str:
             arrays["thr_0"] = np.asarray(ta.thr)
             arrays["nal_0"] = np.asarray(ta.na_left)
             arrays["val_0"] = np.asarray(ta.value)
-            if algo == "gbm":
+            if algo in ("gbm", "xgboost"):
                 meta["f0"] = float(model._f0)
                 meta["dist"] = model._dist
             if algo == "isolationforest":
                 meta["min_len"] = model._min_len
                 meta["max_len"] = model._max_len
-        if algo == "gbm":
+        if algo in ("gbm", "xgboost"):
             meta["dist"] = model._dist
             meta["learn_rate"] = float(model.params["learn_rate"])
         if algo == "drf":
@@ -206,7 +206,7 @@ class MojoModel:
         X = self._row_to_matrix(data)
         m = self.meta
         algo = self.algo
-        if algo == "gbm":
+        if algo in ("gbm", "xgboost"):
             if "nclass_trees" in m:
                 K = m["nclass_trees"]
                 F = np.stack([m["f0"][c] + m["learn_rate"] *

@@ -341,9 +341,15 @@ def import_file(path: str, destination_frame: Optional[str] = None,
                 col_types: Optional[dict] = None,
                 header: Optional[bool] = None,
                 sep: Optional[str] = None) -> Frame:
-    """h2o.import_file analog: setup-guess then parse in one call."""
+    """h2o.import_file analog: setup-guess then parse in one call.
+    Columnar formats (parquet/ORC/feather/avro) dispatch to the Arrow-backed
+    providers (io/columnar.py); text formats go through ParseSetup."""
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+    from h2o3_tpu.io import columnar
+    colparser = columnar.sniff(path)
+    if colparser is not None:
+        return colparser(path, destination_frame)
     setup = parse_setup(path)
     if header is not None:
         setup.header = header

@@ -23,6 +23,7 @@ from h2o3_tpu.models.generic import H2OGenericEstimator
 from h2o3_tpu.models.segments import train_segments, SegmentModels
 from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
 from h2o3_tpu.models.tree.xgboost import H2OXGBoostEstimator
+from h2o3_tpu.models.infogram import H2OInfogram
 
 ESTIMATORS = {
     "kmeans": H2OKMeansEstimator,

@@ -1,0 +1,120 @@
+"""Infogram (admissible ML) — h2o-admissibleml / ai.h2o.admissibleml.
+
+Reference: h2o-admissibleml wraps hex Infogram: for every predictor compute
+(1) a relevance index — normalized variable importance from a supervised
+model on all predictors — and (2) an information index — normalized
+conditional mutual information of the predictor with the response, estimated
+by model performance. Features above both thresholds (default 0.1) are
+"admissible". The fair ("safety") variant conditions on protected columns:
+the information index becomes the predictor's information about the response
+NOT carried through the protected columns.
+
+TPU-native design: the CMI estimates are per-feature GBM fits on the shared
+histogram engine — each a short chips-resident training run; relevance comes
+from the full model's gain importances. No separate native library."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+
+class H2OInfogram:
+    algo = "infogram"
+
+    def __init__(self, protected_columns=None, net_information_threshold=0.1,
+                 relevance_index_threshold=0.1, safety_index_threshold=0.1,
+                 total_information_threshold=0.1, ntrees=20, max_depth=5,
+                 nbins=20, seed=-1, algorithm="gbm"):
+        self.protected_columns = list(protected_columns or [])
+        self.rel_thresh = relevance_index_threshold
+        self.info_thresh = (safety_index_threshold if protected_columns
+                            else net_information_threshold
+                            if net_information_threshold != 0.1
+                            else total_information_threshold)
+        self.ntrees = ntrees
+        self.max_depth = max_depth
+        self.nbins = nbins
+        self.seed = seed
+        self.algorithm = algorithm
+        self._result = None
+        self.key = None
+
+    # ------------------------------------------------------------------
+    def _perf(self, frame, x, y, is_cls):
+        """Normalized predictive performance of x → y (CMI estimate)."""
+        from h2o3_tpu.models import H2OGradientBoostingEstimator
+        m = H2OGradientBoostingEstimator(
+            ntrees=self.ntrees, max_depth=self.max_depth, nbins=self.nbins,
+            seed=self.seed if self.seed > 0 else 7)
+        m.train(x=x, y=y, training_frame=frame)
+        tm = m._output.training_metrics
+        DKV.remove(m.key)
+        if is_cls and getattr(tm, "auc", None) is not None:
+            return max(0.0, 2.0 * tm.auc - 1.0)          # Gini ∈ [0,1]
+        # regression: explained variance (R²) as the information proxy
+        yv = frame.vec(y).to_numpy()
+        r2 = 1.0 - tm.mse / max(float(np.nanvar(yv)), 1e-30)
+        return max(0.0, min(1.0, r2))
+
+    def train(self, x=None, y=None, training_frame=None):
+        f = training_frame
+        assert isinstance(f, Frame) and y is not None
+        prot = self.protected_columns
+        if x is None:
+            x = [c for c in f.names if c != y and c not in prot]
+        is_cls = f.vec(y).type == "enum"
+        # --- relevance: varimp of the full (non-protected) model ----------
+        from h2o3_tpu.models import H2OGradientBoostingEstimator
+        full = H2OGradientBoostingEstimator(
+            ntrees=self.ntrees, max_depth=self.max_depth, nbins=self.nbins,
+            seed=self.seed if self.seed > 0 else 7)
+        full.train(x=x, y=y, training_frame=f)
+        vi = {r["variable"]: r["relative_importance"]
+              for r in (full.varimp() or [])}
+        DKV.remove(full.key)
+        mx = max(vi.values()) if vi else 1.0
+        relevance = {c: vi.get(c, 0.0) / max(mx, 1e-30) for c in x}
+        # --- information index --------------------------------------------
+        info = {}
+        base = self._perf(f, prot, y, is_cls) if prot else 0.0
+        for c in x:
+            perf = self._perf(f, prot + [c], y, is_cls)
+            info[c] = max(0.0, perf - base)
+        mx = max(info.values()) if info else 1.0
+        info = {c: v / max(mx, 1e-30) for c, v in info.items()}
+        rows = []
+        for c in x:
+            admissible = (relevance[c] >= self.rel_thresh
+                          and info[c] >= self.info_thresh)
+            rows.append({
+                "column": c,
+                "relevance_index": float(relevance[c]),
+                ("safety_index" if prot else "total_information_index"):
+                    float(info[c]),
+                "admissible": bool(admissible),
+            })
+        ikey = "safety_index" if prot else "total_information_index"
+        rows.sort(key=lambda r: -(r["relevance_index"] + r[ikey]))
+        self._result = rows
+        self.key = DKV.make_key("infogram")
+        DKV.put(self.key, self)
+        return self
+
+    # ------------------------------------------------------------------
+    def get_admissible_features(self):
+        return [r["column"] for r in self._result if r["admissible"]]
+
+    def get_admissible_score_frame(self):
+        cols = list(self._result[0].keys()) if self._result else []
+        data = {k: np.array([r[k] for r in self._result],
+                            object if k in ("column",) else np.float64)
+                for k in cols}
+        data["admissible"] = data["admissible"].astype(np.float64)
+        return Frame.from_dict(data)
+
+    @property
+    def result(self):
+        return self._result

@@ -228,6 +228,7 @@ class ModelBase:
         "max_runtime_secs": 0.0, "standardize": True,
         "categorical_encoding": "AUTO", "distribution": "AUTO",
         "checkpoint": None, "export_checkpoints_dir": None,
+        "custom_metric_func": None, "custom_distribution_func": None,
     }
 
     def __init__(self, **params):
@@ -344,7 +345,19 @@ class ModelBase:
         w = di.weights(frame)
         w = jnp.where(jnp.isnan(y), 0.0, w)
         out = self._score_matrix(X)
-        return self._metrics_from_preds(y, out, w)
+        m = self._metrics_from_preds(y, out, w)
+        cmf = self.params.get("custom_metric_func")
+        if cmf and m is not None:
+            # water/udf CMetricFunc 3-phase contract, traced in one program
+            from h2o3_tpu.udf import resolve_udf
+            udf = resolve_udf(cmf)
+            # rows with w=0 (padding / missing response) must not poison the
+            # aggregate: neutralize y there (0·NaN would propagate)
+            ysafe = jnp.where(w > 0, jnp.nan_to_num(y), 0.0)
+            agg = udf.map(jnp.nan_to_num(out), ysafe, w)
+            m.custom_metric = {"name": udf.name,
+                               "value": float(udf.metric(agg))}
+        return m
 
     def _metrics_from_preds(self, y, out, w):
         if not self.supervised:
@@ -472,12 +485,46 @@ class ModelBase:
             return pd.DataFrame(vi)
         return vi
 
+    # ---- explanation surface (h2o-py explain module) ---------------------
+    def partial_plot(self, frame, cols=None, nbins: int = 20, plot=False):
+        """h2o model.partial_plot: PDP tables for the given columns."""
+        from h2o3_tpu import explain as EX
+        cols = cols or [r["variable"] for r in (self.varimp() or [])[:2]] \
+            or self._dinfo.predictors[:2]
+        return [EX.partial_dependence(self, frame, c, nbins=nbins)
+                for c in cols]
+
+    def permutation_importance(self, frame, metric="AUTO", n_repeats=1,
+                               seed=42):
+        """h2o model.permutation_importance (PermutationVarImp.java)."""
+        from h2o3_tpu import explain as EX
+        return EX.permutation_varimp(self, frame, metric=metric,
+                                     n_repeats=n_repeats, seed=seed)
+
+    def ice_plot(self, frame, column, nbins: int = 20):
+        from h2o3_tpu import explain as EX
+        return EX.ice(self, frame, column, nbins=nbins)
+
+    def learning_curve_plot(self):
+        from h2o3_tpu import explain as EX
+        return EX.learning_curve(self)
+
+    def explain(self, frame, columns: int = 3):
+        from h2o3_tpu import explain as EX
+        return EX.explain(self, frame, columns=columns)
+
     # ---- export (h2o-genmodel surface) -----------------------------------
     def download_mojo(self, path: str) -> str:
         from h2o3_tpu.genmodel.mojo import export_mojo
         return export_mojo(self, path)
 
     save_mojo = download_mojo
+
+    def download_pojo(self, path: str) -> str:
+        """Generate a dependency-free Java scoring class
+        (water/util/JCodeGen.java analog)."""
+        from h2o3_tpu.genmodel.pojo import export_pojo
+        return export_pojo(self, path)
 
     def save_model_details(self, path: str) -> str:
         import json

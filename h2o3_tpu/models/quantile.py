@@ -1,0 +1,146 @@
+"""Distributed quantiles — hex/quantile/Quantile.java rebuilt TPU-native.
+
+Reference: Quantile.java (~700 LoC): an MRTask histogram pass over chunks,
+then iterative range refinement until the target rank's bin is exact, with
+combine_method interpolation (Type-7-style) and observation weights; used by
+`h2o.quantile`, summary, and GBM's quantile-based binning
+(hex/tree/GlobalQuantilesCalc.java).
+
+TPU-native design: NO data-dependent iteration count — a FIXED number of
+histogram-refinement rounds (4 × 256 bins resolves the range to ~2^-32,
+below float32 ulp) inside ONE jitted program; every round's bin-count is a
+segment-sum over the row-sharded values whose cross-shard reduction is an
+ICI psum; all requested probabilities (and both bracketing order-statistic
+ranks of each) refine in parallel via vmap. The final value is the observed
+in-bin minimum (segment_min), i.e. an exact order statistic, and Type-7
+interpolation combines the two ranks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_B = 256          # bins per refinement round
+_ITERS = 4        # 256^4 = 2^32 range resolution
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _order_stats(x, w, ks, *, iters=_ITERS):
+    """k-th smallest (0-based, by cumulative weight) for each k in ks.
+
+    x: (n,) f32 with NaN for NA/padding (excluded via w=0)
+    w: (n,) f32 weights (0 = excluded)
+    ks: (P,) f32 target cumulative-weight ranks
+    """
+    valid = (w > 0) & ~jnp.isnan(x)
+    wv = jnp.where(valid, w, 0.0)
+    big = jnp.float32(3.0e38)
+    xs = jnp.where(valid, x, big)
+    lo0 = jnp.min(jnp.where(valid, x, big))
+    hi0 = jnp.max(jnp.where(valid, x, -big))
+
+    def one_rank(k):
+        def round_(c, _):
+            lo, hi, below = c
+            span = jnp.maximum(hi - lo, 1e-37)
+            b = jnp.floor((xs - lo) / span * _B).astype(jnp.int32)
+            b = jnp.clip(b, 0, _B - 1)
+            inr = valid & (xs >= lo) & (xs <= hi)
+            bi = jnp.where(inr, b, _B)
+            counts = jax.ops.segment_sum(jnp.where(inr, wv, 0.0), bi,
+                                         num_segments=_B + 1)[:_B]
+            mins = jax.ops.segment_min(jnp.where(inr, xs, big), bi,
+                                       num_segments=_B + 1)[:_B]
+            cum = below + jnp.cumsum(counts)
+            # first bin whose cumulative weight exceeds k
+            hit = (cum > k) & (counts > 0)
+            idx = jnp.argmax(hit)
+            nlo = lo + span * idx / _B
+            nhi = lo + span * (idx + 1) / _B
+            nbelow = jnp.where(idx > 0, cum[idx - 1], below)
+            # once the bin holds a single observed value we are exact:
+            # keep the observed min as the candidate
+            cand = mins[idx]
+            return (jnp.maximum(nlo, lo), jnp.minimum(nhi, hi), nbelow), cand
+
+        (_, _, _), cands = jax.lax.scan(round_, (lo0, hi0, 0.0),
+                                        None, length=iters)
+        return cands[-1]
+
+    return jax.vmap(one_rank)(ks)
+
+
+def quantile(values, probs, weights=None, combine_method="interpolate"):
+    """Weighted distributed quantiles of a device vector.
+
+    Type-7 interpolation on cumulative-weight ranks h = p·(W−1); with unit
+    weights this matches numpy's default. combine_method: "interpolate",
+    "low", "high", "average" (Quantile.java's combine modes).
+    """
+    x = jnp.asarray(values, jnp.float32)
+    w = (jnp.ones_like(x) if weights is None
+         else jnp.asarray(weights, jnp.float32))
+    w = jnp.where(jnp.isnan(x), 0.0, w)
+    W = float(np.asarray(jnp.sum(w)))
+    if W <= 0:
+        return np.full(len(probs), np.nan)
+    probs = np.asarray(probs, np.float64)
+    if np.any((probs < 0) | (probs > 1)):
+        raise ValueError(f"probabilities must be in [0, 1], got {probs}")
+    h = probs * (W - 1.0)
+    klo = np.floor(h)
+    khi = np.ceil(h)
+    ks = jnp.asarray(np.concatenate([klo, khi]), jnp.float32)
+    vals = np.asarray(_order_stats(x, w, ks), np.float64)
+    vlo, vhi = vals[: len(probs)], vals[len(probs):]
+    if combine_method in ("interpolate", None, "AUTO"):
+        g = h - klo
+        return vlo + g * (vhi - vlo)
+    if combine_method == "low":
+        return vlo
+    if combine_method == "high":
+        return vhi
+    if combine_method == "average":
+        return 0.5 * (vlo + vhi)
+    raise ValueError(f"combine_method {combine_method!r}")
+
+
+DEFAULT_PROBS = (0.01, 0.1, 0.25, 1 / 3, 0.5, 2 / 3, 0.75, 0.9, 0.99)
+
+
+def frame_quantiles(frame, probs=None, weights_column=None,
+                    combine_method="interpolate"):
+    """h2o.quantile surface: per-numeric-column quantiles → column dict.
+    Mirrors water/api QuantilesHandler + rapids (quantile ...)."""
+    from h2o3_tpu.core.frame import T_NUM, T_TIME
+    probs = list(probs) if probs is not None else list(DEFAULT_PROBS)
+    w = None
+    if weights_column:
+        w = frame.matrix([weights_column])[:, 0]
+    out = {}
+    for name in frame.names:
+        v = frame.vec(name)
+        if v.type not in (T_NUM, T_TIME, "int", "real"):
+            continue
+        if name == weights_column:
+            continue
+        col = frame.matrix([name])[:, 0]
+        out[name] = quantile(col, probs, weights=w,
+                             combine_method=combine_method)
+    return probs, out
+
+
+def global_quantile_edges(X, w, nbins: int):
+    """GlobalQuantilesCalc.java analog: per-column bin edges at uniform
+    quantile probabilities, for histogram_type=QuantilesGlobal tree binning.
+    Returns (C, nbins-1) edges (device)."""
+    C = X.shape[1]
+    probs = np.linspace(0.0, 1.0, nbins + 1)[1:-1]
+    cols = []
+    for c in range(C):
+        cols.append(quantile(X[:, c], probs, weights=w))
+    return jnp.asarray(np.stack(cols, axis=0), jnp.float32)

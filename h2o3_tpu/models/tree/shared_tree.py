@@ -138,6 +138,12 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
     def _fit(self, frame: Frame, job):
         dist = self._resolve_dist()
         self._dist = dist
+        # custom distribution UDF (water/udf CDistributionFunc)
+        self._udf_dist = None
+        if dist == "custom":
+            from h2o3_tpu.udf import resolve_udf
+            self._udf_dist = resolve_udf(
+                self.params.get("custom_distribution_func"))
         X, y, w = self._prep(frame)
         if dist == "multinomial":
             return self._fit_multinomial(X, y, w, job)
@@ -150,7 +156,9 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         ysum = float(np.asarray(jnp.sum(w * y)))
         ybar = ysum / max(wsum, 1e-30)
         # init F0 (SharedTree init + DistributionFactory links)
-        if dist == "bernoulli":
+        if dist == "custom":
+            f0 = float(self._udf_dist.init_f0(ybar))
+        elif dist == "bernoulli":
             p0 = min(max(ybar, 1e-10), 1 - 1e-10)
             f0 = math.log(p0 / (1 - p0))
         elif dist in ("poisson", "gamma", "tweedie"):
@@ -193,7 +201,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
         for t in range(len(trees), ntrees):
             key, k1, k2, k3 = jax.random.split(key, 4)
-            res, hess = _grad_hess(dist, F, y)
+            res, hess = _grad_hess(dist, F, y, udf=self._udf_dist)
             wt = self._sample_weights(w, k1, sample_rate)
             cmask = self._col_mask(X.shape[1], k2)
             col, thr, nal, val, heap, g = grower.grow(
@@ -282,14 +290,15 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
                   for c, ta in enumerate(self._trees_k)]
             return jax.nn.softmax(jnp.stack(Fs, axis=1), axis=1)
         F = self._f0 + lr * E.predict_ensemble(X, self._trees)
-        return _link_inv_dist(self._dist, F)
+        return _link_inv_dist(self._dist, F,
+                              udf=getattr(self, "_udf_dist", None))
 
     def _contrib_scale_bias(self):
         return float(self.params["learn_rate"]), float(self._f0)
 
     # ---- scoring history / early stopping -------------------------------
     def _record_history(self, ntrees, F, y, w, dist):
-        mu = _link_inv_dist(dist, F)
+        mu = _link_inv_dist(dist, F, udf=getattr(self, "_udf_dist", None))
         from h2o3_tpu.models import metrics as M
         if self._is_classifier:
             m = M.binomial_metrics(y, mu[:, 1], w)
@@ -335,8 +344,10 @@ def _bernoulli_grad(F, y):
     return y - p, p * (1 - p)
 
 
-def _grad_hess(dist, F, y):
+def _grad_hess(dist, F, y, udf=None):
     """ComputePredAndRes (GBM.java:981): per-row pseudo-residual + hessian."""
+    if udf is not None:
+        return udf.grad_hess(F, y)
     if dist == "gaussian":
         return y - F, jnp.ones_like(F)
     if dist == "bernoulli" or dist == "quasibinomial":
@@ -356,7 +367,9 @@ def _grad_hess(dist, F, y):
     raise NotImplementedError(f"GBM distribution {dist}")
 
 
-def _link_inv_dist(dist, F):
+def _link_inv_dist(dist, F, udf=None):
+    if udf is not None:
+        return udf.link_inv(F)
     if dist in ("bernoulli", "quasibinomial"):
         p = jax.nn.sigmoid(F)
         return jnp.stack([1 - p, p], axis=1)

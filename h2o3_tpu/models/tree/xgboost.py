@@ -44,7 +44,7 @@ def _objective_grad_hess(dist, F, y):
         return y - p, jnp.maximum(p * (1 - p), 1e-6)
     if dist == "poisson":                        # count:poisson
         mu = jnp.exp(F)
-        return y - mu, mu
+        return y - mu, jnp.maximum(mu, 1e-6)
     if dist == "gamma":                          # reg:gamma
         mu = jnp.exp(F)
         return y / mu - 1.0, jnp.maximum(y / mu, 1e-6)
@@ -91,9 +91,15 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
             if v is not None:
                 self.params[target] = v
         tm = self.params.get("tree_method", "hist")
-        assert tm in ("auto", "hist", "approx", "exact"), tm
+        assert tm in ("auto", "hist", "approx", "exact"), \
+            f"tree_method must be auto/hist/approx/exact, got {tm!r}"
         assert self.params.get("booster", "gbtree") in ("gbtree", "dart"), \
             "gblinear: use H2OGeneralizedLinearEstimator"
+        for unsupported in ("checkpoint", "custom_distribution_func"):
+            if self.params.get(unsupported):
+                raise NotImplementedError(
+                    f"{unsupported} is not supported by the xgboost builder "
+                    f"(use H2OGradientBoostingEstimator)")
 
     def _grower(self):
         p = self.params
@@ -118,6 +124,7 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         seed = int(self.params.get("seed") or -1)
         key = jax.random.PRNGKey(seed if seed > 0 else 42)
         grower = self._grower()
+        w_metric = w      # scale_pos_weight reweights the OBJECTIVE only
         if dist == "bernoulli" and spw != 1.0:
             w = w * jnp.where(y > 0.5, spw, 1.0)
         # xgboost starts from base_score=0.5 in link space ⇒ F0 = 0 for
@@ -146,7 +153,7 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
             trees.append((col, thr, nal, val, cover))
             F = F + eta * val[heap]
             if (t + 1) % interval == 0 or t == ntrees - 1:
-                self._record_history(t + 1, F, y, w, dist)
+                self._record_history(t + 1, F, y, w_metric, dist)
                 if self._should_stop():
                     break
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")

@@ -848,15 +848,19 @@ def _groupby(a, e):
 
 @prim("quantile")
 def _quantile(a, e):
+    """(quantile fr probs ["interpolate"|...]) — device histogram-refinement
+    quantiles (hex/quantile/Quantile.java path), not a host sort."""
+    from h2o3_tpu.models.quantile import quantile as devq
     f = _eval(a[0], e)
     probs = _eval(a[1], e)
     probs = probs if isinstance(probs, list) else [probs]
+    method = _eval(a[2], e) if len(a) > 2 else "interpolate"
     cols = _numeric_cols(f)
     out_cols = [np.asarray(probs, np.float64)]
     names = ["Probs"]
     for c in cols:
-        col = f.vec(c).to_numpy()
-        out_cols.append(np.nanquantile(col, probs))
+        col = f.matrix([c])[:, 0]
+        out_cols.append(devq(col, probs, combine_method=method))
         names.append(c)
     return _new_frame(names, out_cols)
 

@@ -1,0 +1,79 @@
+"""User-defined functions — water/udf rebuilt for a single-controller runtime.
+
+Reference: water/udf (CFuncRef/CFuncLoader, CDistributionFunc custom GBM
+distributions, CMetricFunc custom model metrics) + h2o-extensions/jython-cfunc:
+users upload jars of Java/Jython functions into the DKV and reference them as
+"lang:keyname=ClassName" in `custom_distribution_func` /
+`custom_metric_func` parameters.
+
+TPU-native design: UDFs are Python objects whose array math is written in
+jax.numpy — they are traced INTO the jitted training/scoring programs (no
+interpreter callback per row; the reference pays a JVM/Jython call per row).
+They register under the same DKV the frames/models live in, referenced as
+"python:<key>" strings for h2o-py parameter parity."""
+
+from __future__ import annotations
+
+from h2o3_tpu.core.kvstore import DKV
+
+_PREFIX = "udf_"
+
+
+class CustomDistribution:
+    """Custom GBM distribution (water/udf/CDistributionFunc analog).
+
+    Subclass and override; all array math must be jax.numpy (it runs inside
+    the jitted boosting programs):
+      link_inv(F)      — inverse link: margin → prediction/probability
+      grad_hess(F, y)  — pseudo-residual (gradient ascent dir) and hessian
+      init_f0(ybar)    — initial margin from the weighted response mean
+    """
+
+    def link_inv(self, F):
+        return F
+
+    def grad_hess(self, F, y):
+        raise NotImplementedError
+
+    def init_f0(self, ybar: float) -> float:
+        return float(ybar)
+
+
+class CustomMetric:
+    """Custom model metric (water/udf/CMetricFunc analog): map/reduce/metric
+    with the same 3-phase contract as the reference."""
+
+    name = "custom"
+
+    def map(self, pred, y, w):
+        """Per-row values → (num, den)-style array tuple (jnp math)."""
+        raise NotImplementedError
+
+    def reduce(self, l, r):
+        return tuple(a + b for a, b in zip(l, r))
+
+    def metric(self, agg) -> float:
+        raise NotImplementedError
+
+
+def register_udf(key: str, obj) -> str:
+    """Register a UDF; returns the "python:<key>" reference string."""
+    DKV.put(_PREFIX + key, obj)
+    return f"python:{key}"
+
+
+def resolve_udf(ref):
+    """Accept a UDF object, a "python:key" reference, or a bare key."""
+    if isinstance(ref, (CustomDistribution, CustomMetric)):
+        return ref
+    if not isinstance(ref, str):
+        raise TypeError(f"not a UDF reference: {ref!r}")
+    key = ref.split(":", 1)[1] if ":" in ref else ref
+    obj = DKV.get(_PREFIX + key)
+    if obj is None:
+        raise KeyError(f"no UDF registered under {key!r}")
+    return obj
+
+
+def remove_udf(key: str):
+    DKV.remove(_PREFIX + key)

@@ -123,8 +123,11 @@ void tree_shap_recurse(const Tree& t, const double* x, double* phi,
   if (rnode <= 0.0) rnode = 1.0;
   tree_shap_recurse(t, x, phi, hot, depth + 1, path,
                     incoming_zero * rhot / rnode, incoming_one, c);
-  tree_shap_recurse(t, x, phi, cold, depth + 1, path,
-                    incoming_zero * rcold / rnode, 0.0, c);
+  // a zero-cover cold branch carries no background mass: recursing would
+  // put 0/0 into UNWIND (possible with min_child_weight=0 splits)
+  if (incoming_zero * rcold > 0.0)
+    tree_shap_recurse(t, x, phi, cold, depth + 1, path,
+                      incoming_zero * rcold / rnode, 0.0, c);
 }
 
 }  // namespace

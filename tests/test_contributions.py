@@ -115,3 +115,19 @@ def test_exact_vs_brute_force():
             ref[r] += _brute_force_shap(col[ti], thr[ti], nal[ti], val[ti],
                                         cov[ti], t.depth, Xq[r])
     assert np.allclose(phi, ref, atol=1e-4), (phi - ref)
+
+
+def test_zero_cover_children_finite():
+    """min_child_weight=0 can create zero-cover split children; TreeSHAP
+    must stay finite (zero-mass cold branches are skipped)."""
+    rng = np.random.default_rng(9)
+    n = 150
+    X = rng.normal(0, 1, (n, 3))
+    y = (X[:, 0] > 0).astype(float)
+    f = Frame.from_dict({"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "y": y})
+    from h2o3_tpu.models import H2OXGBoostEstimator
+    m = H2OXGBoostEstimator(ntrees=4, max_depth=4, min_child_weight=0,
+                            seed=1)
+    m.train(y="y", training_frame=f)
+    phi = m.predict_contributions(f).to_numpy()
+    assert np.isfinite(phi).all()

@@ -1,0 +1,49 @@
+"""Infogram / admissible ML (h2o-admissibleml parity)."""
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+
+
+def test_infogram_core_separates_signal_from_noise():
+    rng = np.random.default_rng(0)
+    n = 500
+    strong = rng.normal(0, 1, n)
+    weak = rng.normal(0, 1, n)
+    noise = rng.normal(0, 1, n)
+    y = (strong + 0.3 * weak + 0.2 * rng.normal(size=n) > 0).astype(int)
+    f = Frame.from_dict({
+        "strong": strong, "weak": weak, "noise": noise,
+        "y": np.array(["n", "p"], object)[y]})
+    from h2o3_tpu.models import H2OInfogram
+    ig = H2OInfogram(ntrees=10, max_depth=3, seed=1)
+    ig.train(y="y", training_frame=f)
+    res = {r["column"]: r for r in ig.result}
+    assert res["strong"]["relevance_index"] == 1.0
+    assert res["strong"]["admissible"]
+    assert res["noise"]["total_information_index"] < \
+        res["strong"]["total_information_index"]
+    adm = ig.get_admissible_features()
+    assert "strong" in adm and "noise" not in adm
+    sf = ig.get_admissible_score_frame()
+    assert sf.nrows == 3
+
+
+def test_infogram_fair_variant_flags_proxy():
+    rng = np.random.default_rng(1)
+    n = 600
+    protected = rng.integers(0, 2, n).astype(float)
+    proxy = protected + 0.1 * rng.normal(size=n)      # leaks protected
+    legit = rng.normal(0, 1, n)
+    y = (legit + protected + 0.2 * rng.normal(size=n) > 0.5).astype(int)
+    f = Frame.from_dict({
+        "prot": protected, "proxy": proxy, "legit": legit,
+        "y": np.array(["n", "p"], object)[y]})
+    from h2o3_tpu.models import H2OInfogram
+    ig = H2OInfogram(protected_columns=["prot"], ntrees=10, max_depth=3,
+                     seed=1)
+    ig.train(x=["proxy", "legit"], y="y", training_frame=f)
+    res = {r["column"]: r for r in ig.result}
+    # legit adds info beyond protected; proxy adds almost none
+    assert res["legit"]["safety_index"] > res["proxy"]["safety_index"]
+    assert res["legit"]["admissible"]

@@ -1,0 +1,69 @@
+"""Distributed quantile (hex/quantile/Quantile.java parity): device
+histogram-refinement must match numpy order statistics / Type-7."""
+
+import numpy as np
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Frame
+
+
+def test_quantile_matches_numpy():
+    from h2o3_tpu.models.quantile import quantile
+    rng = np.random.default_rng(0)
+    x = rng.normal(10, 5, 5000).astype(np.float32)
+    probs = [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]
+    got = quantile(x, probs)
+    want = np.quantile(x.astype(np.float64), probs)
+    assert np.allclose(got, want, rtol=1e-5, atol=1e-4), (got, want)
+
+
+def test_quantile_with_nas_and_methods():
+    from h2o3_tpu.models.quantile import quantile
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-100, 100, 2000).astype(np.float32)
+    x[::7] = np.nan
+    probs = [0.3, 0.5, 0.8]
+    got = quantile(x, probs)
+    want = np.nanquantile(x.astype(np.float64), probs)
+    assert np.allclose(got, want, rtol=1e-5, atol=1e-3)
+    lo = quantile(x, probs, combine_method="low")
+    hi = quantile(x, probs, combine_method="high")
+    av = quantile(x, probs, combine_method="average")
+    assert np.all(lo <= hi + 1e-6)
+    assert np.allclose(av, 0.5 * (lo + hi), atol=1e-5)
+
+
+def test_quantile_weighted():
+    from h2o3_tpu.models.quantile import quantile
+    # weight-2 == duplicating the row
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+    w = np.array([1.0, 2.0, 1.0, 1.0, 1.0], np.float32)
+    xdup = np.array([1, 2, 2, 3, 4, 5], np.float64)
+    got = quantile(x, [0.5], weights=w)
+    want = np.quantile(xdup, [0.5])
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_h2o_quantile_frame_surface():
+    rng = np.random.default_rng(2)
+    f = Frame.from_dict({"a": rng.normal(size=300),
+                         "b": rng.uniform(0, 1, 300),
+                         "c": np.array(["x", "y"], object)[
+                             rng.integers(0, 2, 300)]})
+    q = h2o3_tpu.quantile(f, prob=[0.25, 0.5, 0.75])
+    assert q.names[0] == "Probs"
+    assert "a" in q.names and "b" in q.names and "c" not in q.names
+    assert q.nrows == 3
+    got = q.to_numpy()
+    want_a = np.quantile(f.vec("a").to_numpy(), [0.25, 0.5, 0.75])
+    assert np.allclose(got[:, q.names.index("a")], want_a, atol=1e-4)
+
+
+def test_rapids_quantile_prim():
+    rng = np.random.default_rng(3)
+    f = Frame.from_dict({"v": rng.normal(5, 2, 400)})
+    from h2o3_tpu.rapids import rapids_exec
+    out = rapids_exec(f"(quantile {f.key} [0.1 0.5 0.9] \"interpolate\")")
+    vals = out.to_numpy()
+    want = np.quantile(f.vec("v").to_numpy(), [0.1, 0.5, 0.9])
+    assert np.allclose(vals[:, 1], want, atol=1e-4)

@@ -76,3 +76,20 @@ def test_xgboost_regression_and_multiclass():
     from h2o3_tpu.core.kvstore import DKV
     for k in list(DKV.keys()):
         DKV.remove(k)
+
+
+def test_xgboost_mojo_roundtrip(tmp_path):
+    from h2o3_tpu.models import H2OXGBoostEstimator
+    f = _cls_frame(n=300)
+    m = H2OXGBoostEstimator(ntrees=5, max_depth=3, seed=4)
+    m.train(y="y", training_frame=f)
+    path = str(tmp_path / "xgb.mojo")
+    m.download_mojo(path)
+    import h2o3_tpu
+    scorer = h2o3_tpu.import_mojo(path)
+    Xn = f.to_numpy()[:25, :-1]
+    rows = [{n: Xn[i, j] for j, n in enumerate(f.names[:-1])}
+            for i in range(25)]
+    out = scorer.predict(rows)
+    want = m.predict(f).to_numpy()[:25, 2]
+    assert np.allclose(out["probs"][:, 1], want, atol=1e-5)
