@@ -288,6 +288,11 @@ class Frame:
         self.key = key or DKV.make_key("frame")
         self._matrix_cache: dict = {}
         DKV.put(self.key, self)
+        # Cleaner wakeup point: account this frame, spill cold ones if the
+        # HBM budget is exceeded (water/Cleaner.java:11)
+        from h2o3_tpu.core.memory import MANAGER
+        MANAGER.touch(self.key)
+        MANAGER.maybe_clean()
 
     # ---- construction ---------------------------------------------------
     @staticmethod
@@ -440,3 +445,22 @@ class Frame:
 
     def __repr__(self):
         return f"<Frame {self.key} {self.nrows}x{self.ncols} {self.names[:8]}>"
+
+
+# ---------------------------------------------------------------------------
+def rebalance_frame(frame: "Frame", key: Optional[str] = None) -> "Frame":
+    """RebalanceDataSet.java analog: rebuild every Vec against the CURRENT
+    cloud sharding/padding. H2O re-chunks to re-spread work across nodes;
+    here re-sharding matters after the mesh shape changed (frames created
+    under an old mesh keep their old layout) or to defragment after slicing."""
+    names, vecs = [], []
+    for n, v in zip(frame.names, frame.vecs):
+        if v.type == T_STR:
+            vecs.append(Vec.from_numpy(v.host_data, type=T_STR))
+        else:
+            col = v.to_numpy()
+            mask = np.isnan(col) if v.type != T_CAT else np.isnan(col)
+            vecs.append(Vec._from_floats(np.where(mask, 0.0, col), mask,
+                                         v.type, v.domain))
+        names.append(n)
+    return Frame(names, vecs, key)

@@ -36,7 +36,13 @@ class _DKV:
 
     def get(self, key: str, default=None):
         with self._mutex:
-            return self._store.get(key, default)
+            v = self._store.get(key, default)
+        if v is not None and getattr(v, "spilled", False):
+            # Cleaner spilled this frame to ice; reload transparently
+            # (water/Value.java mem/disk duality)
+            from h2o3_tpu.core.memory import resolve
+            return resolve(v)
+        return v
 
     def __contains__(self, key: str) -> bool:
         with self._mutex:

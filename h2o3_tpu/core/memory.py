@@ -1,0 +1,138 @@
+"""Memory manager — water/MemoryManager.java + water/Cleaner.java rebuilt.
+
+Reference: MemoryManager (allocation accounting, OOM callbacks),
+Cleaner.java:11 (a background "user-mode swap": LRU-ages cached Values and
+spills cold ones to ice_root disk, reloading transparently on access),
+FrameSizeMonitor.java.
+
+TPU-native design: the scarce resource is device HBM, not JVM heap. The
+manager accounts the HBM bytes of every registered Frame, and when a
+configurable budget is exceeded, LRU-spills whole cold frames to the ice
+directory (.hex snapshots via io/persist) and frees their device buffers.
+Access through `DKV.get` transparently reloads (Value.java's mem/disk
+duality, frame-granular instead of chunk-granular — device_put of a whole
+column set is one bulk host→HBM transfer, which is how TPUs like it).
+There is no background thread: `maybe_clean()` runs at registration points
+(frame creation), the moral equivalent of Cleaner wakeups."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+DEFAULT_BUDGET = int(os.environ.get("H2O3_TPU_HBM_BUDGET_MB", "0")) * 2**20
+
+
+class MemoryManager:
+    def __init__(self, ice_root: str | None = None,
+                 budget_bytes: int = DEFAULT_BUDGET):
+        self.ice_root = ice_root or os.path.join(
+            os.path.expanduser("~"), ".h2o3_tpu_ice")
+        self.budget = budget_bytes          # 0 = unlimited (no spilling)
+        self._touch: dict[str, float] = {}  # frame key -> last access
+        self._spilled: dict[str, str] = {}  # frame key -> snapshot path
+        self._pinned: set[str] = set()
+
+    # ---- accounting (MemoryManager.java) --------------------------------
+    def frame_bytes(self, frame) -> int:
+        total = 0
+        for v in frame.vecs:
+            for arr in (getattr(v, "data", None), getattr(v, "mask", None)):
+                if arr is not None:
+                    total += int(np.prod(arr.shape)) * arr.dtype.itemsize
+        return total
+
+    def total_bytes(self) -> int:
+        from h2o3_tpu.core.frame import Frame
+        from h2o3_tpu.core.kvstore import DKV
+        return sum(self.frame_bytes(o) for k in DKV.keys()
+                   if isinstance(o := DKV.get(k), Frame)
+                   and k not in self._spilled)
+
+    def touch(self, key: str):
+        self._touch[key] = time.time()
+
+    def pin(self, key: str):
+        self._pinned.add(key)
+
+    def unpin(self, key: str):
+        self._pinned.discard(key)
+
+    # ---- the Cleaner (Cleaner.java:11) ----------------------------------
+    def maybe_clean(self):
+        """Spill LRU frames until under budget (no-op when budget==0)."""
+        if not self.budget:
+            return []
+        from h2o3_tpu.core.frame import Frame
+        from h2o3_tpu.core.kvstore import DKV
+        live = [(k, DKV.get(k)) for k in DKV.keys()]
+        frames = [(k, o) for k, o in live
+                  if isinstance(o, Frame) and k not in self._spilled
+                  and k not in self._pinned]
+        used = sum(self.frame_bytes(o) for _, o in frames)
+        if used <= self.budget:
+            return []
+        frames.sort(key=lambda kv: self._touch.get(kv[0], 0.0))
+        spilled = []
+        for k, f in frames:
+            if used <= self.budget:
+                break
+            used -= self.frame_bytes(f)
+            self.spill(k, f)
+            spilled.append(k)
+        return spilled
+
+    def spill(self, key: str, frame=None):
+        """Write the frame to ice and drop its device buffers."""
+        from h2o3_tpu.core.kvstore import DKV
+        from h2o3_tpu.io.persist import export_frame
+        frame = frame if frame is not None else DKV.get(key)
+        os.makedirs(self.ice_root, exist_ok=True)
+        path = os.path.join(self.ice_root, f"{key}.hex")
+        export_frame(frame, path)
+        self._spilled[key] = path
+        DKV.atomic(key, lambda _old: _Spilled(key, path))
+        return path
+
+    def load(self, key: str):
+        """Reload a spilled frame into HBM (Value.loadPersist analog)."""
+        from h2o3_tpu.core.kvstore import DKV
+        from h2o3_tpu.io.persist import import_frame
+        path = self._spilled.pop(key)
+        f = import_frame(path, key=key)
+        DKV.put(key, f)
+        self.touch(key)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return f
+
+    def is_spilled(self, key: str) -> bool:
+        return key in self._spilled
+
+    def stats(self) -> dict:
+        return {"ice_root": self.ice_root, "budget_bytes": self.budget,
+                "resident_bytes": self.total_bytes(),
+                "spilled": sorted(self._spilled)}
+
+
+class _Spilled:
+    """Registry placeholder for a spilled frame; DKV.get resolves it."""
+
+    def __init__(self, key, path):
+        self.key = key
+        self.path = path
+        self.spilled = True
+
+
+MANAGER = MemoryManager()
+
+
+def resolve(obj):
+    """Transparent reload when a registry hit is a spill placeholder."""
+    if isinstance(obj, _Spilled):
+        return MANAGER.load(obj.key)
+    return obj
