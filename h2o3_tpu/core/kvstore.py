@@ -44,6 +44,12 @@ class _DKV:
             return resolve(v)
         return v
 
+    def raw_get(self, key: str, default=None):
+        """Registry hit WITHOUT spill resolution — for the memory manager's
+        accounting/cleaning, which must not fault spilled frames back in."""
+        with self._mutex:
+            return self._store.get(key, default)
+
     def __contains__(self, key: str) -> bool:
         with self._mutex:
             return key in self._store

@@ -45,11 +45,12 @@ class MemoryManager:
         return total
 
     def total_bytes(self) -> int:
+        # raw_get: accounting must never fault spilled frames back into HBM
         from h2o3_tpu.core.frame import Frame
         from h2o3_tpu.core.kvstore import DKV
         return sum(self.frame_bytes(o) for k in DKV.keys()
-                   if isinstance(o := DKV.get(k), Frame)
-                   and k not in self._spilled)
+                   if k not in self._spilled
+                   and isinstance(o := DKV.raw_get(k), Frame))
 
     def touch(self, key: str):
         self._touch[key] = time.time()
@@ -67,10 +68,10 @@ class MemoryManager:
             return []
         from h2o3_tpu.core.frame import Frame
         from h2o3_tpu.core.kvstore import DKV
-        live = [(k, DKV.get(k)) for k in DKV.keys()]
+        live = [(k, DKV.raw_get(k)) for k in DKV.keys()
+                if k not in self._spilled]
         frames = [(k, o) for k, o in live
-                  if isinstance(o, Frame) and k not in self._spilled
-                  and k not in self._pinned]
+                  if isinstance(o, Frame) and k not in self._pinned]
         used = sum(self.frame_bytes(o) for _, o in frames)
         if used <= self.budget:
             return []
@@ -100,7 +101,15 @@ class MemoryManager:
         """Reload a spilled frame into HBM (Value.loadPersist analog)."""
         from h2o3_tpu.core.kvstore import DKV
         from h2o3_tpu.io.persist import import_frame
-        path = self._spilled.pop(key)
+        path = self._spilled.pop(key, None)
+        if path is None:
+            # concurrent loader won the race — wait for its DKV.put to land
+            for _ in range(2000):
+                v = DKV.raw_get(key)
+                if not getattr(v, "spilled", False):
+                    return v
+                time.sleep(0.005)
+            raise TimeoutError(f"spilled frame {key!r} never reloaded")
         f = import_frame(path, key=key)
         DKV.put(key, f)
         self.touch(key)

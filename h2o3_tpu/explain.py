@@ -47,6 +47,14 @@ def _set_feature(di, X, column, g, is_cat):
     """Overwrite one original column with value g in the design matrix —
     handles both label-mode (one slot) and onehot-mode (indicator group)."""
     if column in di.feature_names:          # label mode / numeric onehot
+        # the design matrix holds standardized values for numeric columns
+        # only in onehot mode with standardize=True (label-mode/tree models
+        # keep raw units even though standardize defaults True) — transform
+        # the raw grid value to match
+        if not is_cat and getattr(di, "standardize", False) \
+                and getattr(di, "cat_mode", "label") == "onehot" \
+                and column in getattr(di, "means", {}):
+            g = (float(g) - di.means[column]) / max(di.sigmas[column], 1e-10)
         return X.at[:, di.feature_names.index(column)].set(jnp.float32(g))
     if is_cat and column in di.cat_cols:    # onehot group
         base = 0

@@ -249,8 +249,10 @@ class MojoModel:
             eta = Xi @ beta
             link = m["link"]
             mu = (eta if link == "identity" else
-                  1 / (1 + np.exp(-eta)) if link == "logit" else
-                  np.exp(eta) if link == "log" else 1.0 / eta)
+                  1 / (1 + np.exp(-np.clip(eta, -40, 40)))
+                  if link == "logit" else
+                  np.exp(np.clip(eta, -700, 700)) if link == "log"
+                  else 1.0 / eta)
             if m["family"] in ("binomial", "quasibinomial"):
                 return self._cls_out(np.stack([1 - mu, mu], 1))
             return {"predict": mu}

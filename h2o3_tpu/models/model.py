@@ -181,6 +181,29 @@ class DataInfo:
         return f
 
 
+def _fold_custom_metric(udf, mapped):
+    """Apply the CMetricFunc 3-phase contract (water/udf): map emits per-row
+    component tuples; reduce is an associative combiner folded down to the
+    final aggregate. Vectorized: pairwise binary-tree halving, so jnp-math
+    combiners run on device. Pre-aggregated scalars pass through unchanged."""
+    tup = mapped if isinstance(mapped, tuple) else (mapped,)
+    if jnp.asarray(tup[0]).ndim == 0:
+        return mapped                      # map already produced the aggregate
+    comps = tuple(jnp.atleast_1d(jnp.asarray(c)) for c in tup)
+    while comps[0].shape[0] > 1:
+        n = comps[0].shape[0]
+        even = n - (n % 2)
+        red = udf.reduce(tuple(c[0:even:2] for c in comps),
+                         tuple(c[1:even:2] for c in comps))
+        red = tuple(jnp.atleast_1d(jnp.asarray(a)) for a in red)
+        if n % 2:
+            red = tuple(jnp.concatenate([a, c[-1:]])
+                        for a, c in zip(red, comps))
+        comps = red
+    agg = tuple(c[0] for c in comps)
+    return agg if isinstance(mapped, tuple) else agg[0]
+
+
 def _remap_domain(v: Vec, want: list) -> Vec:
     lookup = {l: i for i, l in enumerate(want)}
     src = v.to_numpy()
@@ -354,7 +377,8 @@ class ModelBase:
             # rows with w=0 (padding / missing response) must not poison the
             # aggregate: neutralize y there (0·NaN would propagate)
             ysafe = jnp.where(w > 0, jnp.nan_to_num(y), 0.0)
-            agg = udf.map(jnp.nan_to_num(out), ysafe, w)
+            agg = _fold_custom_metric(udf, udf.map(jnp.nan_to_num(out),
+                                                   ysafe, w))
             m.custom_metric = {"name": udf.name,
                                "value": float(udf.metric(agg))}
         return m

@@ -106,7 +106,9 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         return E.TreeGrower(
             nbins=int(p["nbins"]), max_depth=int(p["max_depth"]),
             min_rows=float(p["min_rows"]),           # on Σhess = min_child_weight
-            min_split_improvement=float(p["min_split_improvement"]),
+            # engine gain is the un-halved SE reduction = 2× xgboost's
+            # ½·[G_L²/(H_L+λ)+G_R²/(H_R+λ)−G_P²/(H_P+λ)] — double γ to match
+            min_split_improvement=2.0 * float(p["min_split_improvement"]),
             reg_lambda=float(p["reg_lambda"]))
 
     # ---- boosting driver (_resolve_dist inherited from GBM) --------------
@@ -122,15 +124,14 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         alpha = float(self.params["reg_alpha"])
         spw = float(self.params.get("scale_pos_weight") or 1.0)
         seed = int(self.params.get("seed") or -1)
-        key = jax.random.PRNGKey(seed if seed > 0 else 42)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 42)
         grower = self._grower()
         w_metric = w      # scale_pos_weight reweights the OBJECTIVE only
         if dist == "bernoulli" and spw != 1.0:
             w = w * jnp.where(y > 0.5, spw, 1.0)
-        # xgboost starts from base_score=0.5 in link space ⇒ F0 = 0 for
-        # logistic/identity, log(0.5)-free; we use 0.5 raw / 0 margin
-        self._f0 = f0 = 0.0 if dist != "gaussian" else float(
-            np.asarray(jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-30)))
+        # xgboost base_score=0.5: margin F0 = 0 for logistic; for
+        # reg:squarederror the 0.5 IS the raw prediction (not the mean)
+        self._f0 = f0 = 0.5 if dist == "gaussian" else 0.0
         F = jnp.full(X.shape[0], f0, jnp.float32)
         sample_rate = float(self.params["sample_rate"])
         trees = []
@@ -176,7 +177,7 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         lam = float(self.params["reg_lambda"])
         alpha = float(self.params["reg_alpha"])
         seed = int(self.params.get("seed") or -1)
-        key = jax.random.PRNGKey(seed if seed > 0 else 42)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 42)
         grower = self._grower()
         yi = y.astype(jnp.int32)
         onehot = jax.nn.one_hot(yi, K)

@@ -46,7 +46,11 @@ class CustomMetric:
     name = "custom"
 
     def map(self, pred, y, w):
-        """Per-row values → (num, den)-style array tuple (jnp math)."""
+        """Phase 1: receives FULL column arrays (pred, y, w) and returns a
+        tuple of components — either per-row arrays (length n, the reference
+        per-row contract, vectorized) or already-reduced scalars. Per-row
+        outputs are folded with reduce() pairwise on device; scalar outputs
+        skip reduce and go straight to metric()."""
         raise NotImplementedError
 
     def reduce(self, l, r):

@@ -72,3 +72,54 @@ def test_heatmaps_and_learning_curve():
     assert "training_rmse" in lc["series"]
     ex = m.explain(f)
     assert "partial_dependence" in ex and ex["variable_importances"]
+
+
+def test_pdp_standardized_model_sweeps_raw_units():
+    """Round-1 advisor finding: PDP grids are in raw column units but the
+    design matrix is standardized for standardize=True models — the sweep
+    must transform grid values, or curves are wildly wrong for columns with
+    large means. A GLM on y ~ x with mean(x)=100 must produce a PDP whose
+    response range matches the data's probability range, not saturate."""
+    import numpy as np
+    from h2o3_tpu.core.frame import Frame
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu import explain as EX
+    from h2o3_tpu.models import H2OGeneralizedLinearEstimator
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(100.0, 5.0, 600)          # big mean, modest sigma
+    p = 1 / (1 + np.exp(-(x - 100.0) / 5.0))
+    y = (rng.random(600) < p).astype(int)
+    f = Frame.from_dict({"x": x,
+                         "y": np.array(["n", "p"], object)[y]})
+    m = H2OGeneralizedLinearEstimator(family="binomial", lambda_=0.0,
+                                      standardize=True)
+    m.train(y="y", training_frame=f)
+    pd = EX.partial_dependence(m, f, "x", nbins=11)
+    resp = np.array(pd["mean_response"])
+    # monotone increasing and actually spanning (not pinned at 0/1 by a
+    # z-score-200 sweep): ends near the data's own extremes
+    assert resp[0] < 0.35 and resp[-1] > 0.65
+    assert np.all(np.diff(resp) > -1e-6)
+    DKV.remove(f.key)
+
+
+def test_pdp_tree_model_label_mode_not_standardized():
+    """Trees (label mode) keep raw units in the design matrix even though
+    standardize defaults True — the PDP sweep must NOT z-score the grid."""
+    import numpy as np
+    from h2o3_tpu.core.frame import Frame
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu import explain as EX
+    from h2o3_tpu.models import H2OGradientBoostingEstimator
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(100.0, 5.0, 600)
+    y = (x > 100).astype(np.float64) + rng.normal(0, .05, 600)
+    f = Frame.from_dict({"x": x, "y": y})
+    m = H2OGradientBoostingEstimator(ntrees=20, max_depth=3, seed=1)
+    m.train(y="y", training_frame=f)
+    pd = EX.partial_dependence(m, f, "x", nbins=11)
+    resp = np.array(pd["mean_response"])
+    assert resp[-1] - resp[0] > 0.5, resp   # flat curve = z-scored sweep bug
+    DKV.remove(f.key)

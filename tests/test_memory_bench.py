@@ -32,6 +32,12 @@ def test_budget_lru_spills_cold_frame(tmp_path):
     from h2o3_tpu.core.memory import MANAGER
     old_budget, old_ice = MANAGER.budget, MANAGER.ice_root
     MANAGER.ice_root = str(tmp_path)
+    # hermetic: frames leaked by earlier tests would otherwise be the LRU
+    # spill victims instead of `cold` (order-dependent failure, round 1)
+    from h2o3_tpu.core.frame import Frame as _F
+    for k in list(DKV.keys()):
+        if isinstance(DKV.raw_get(k), _F):
+            DKV.remove(k)
     try:
         cold = Frame.from_dict({"x": np.zeros(20000)})
         MANAGER.budget = MANAGER.total_bytes() + 1000   # barely above usage
