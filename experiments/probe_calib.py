@@ -1,0 +1,26 @@
+import time, numpy as np, jax, jax.numpy as jnp
+def timeit(f, *a, n=10, warm=3):
+    for _ in range(warm): jax.block_until_ready(f(*a))
+    t0 = time.time()
+    for _ in range(n): r = f(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+rng = np.random.default_rng(0)
+N = 2_000_000
+v = jnp.asarray(rng.normal(0,1,N), jnp.float32)
+big = jnp.asarray(rng.normal(0,1,(4096, 4096)), jnp.bfloat16)
+add = jax.jit(lambda x: x + 1.0)
+mm = jax.jit(lambda a: a @ a)
+red = jax.jit(lambda x: x.sum())
+print("elementwise add 2M f32 :", timeit(add, v)*1e3, "ms  (8MB r+w)")
+print("sum 2M f32             :", timeit(red, v)*1e3, "ms")
+t = timeit(mm, big)
+print("matmul 4096^3 bf16     :", t*1e3, "ms ->", 2*4096**3/t/1e12, "TFLOP/s")
+v8 = jnp.asarray(rng.normal(0,1,(8, N)), jnp.float32)
+add8 = jax.jit(lambda x: x + 1.0)
+print("elementwise add (8,2M) :", timeit(add8, v8)*1e3, "ms  (128MB)")
+# chained 10 adds in one jit — per-dispatch overhead check
+def ten(x):
+    for _ in range(10): x = x + 1.0
+    return x
+print("10x add in one jit     :", timeit(jax.jit(ten), v)*1e3, "ms")

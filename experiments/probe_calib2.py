@@ -1,0 +1,28 @@
+import time, numpy as np, jax, jax.numpy as jnp
+
+def timeit(f, *a, n=10, warm=3):
+    """Sync via scalar readback (block_until_ready is a no-op via axon)."""
+    for _ in range(warm): r = f(*a)
+    _ = float(jnp.asarray(r).ravel()[0].astype(jnp.float32))
+    t0 = time.time()
+    for _ in range(n): r = f(*a)
+    _ = float(jnp.asarray(r).ravel()[0].astype(jnp.float32))
+    return (time.time() - t0) / n
+
+rng = np.random.default_rng(0)
+N = 2_000_000
+v = jnp.asarray(rng.normal(0,1,N), jnp.float32)
+big = jnp.asarray(rng.normal(0,1,(4096, 4096)), jnp.bfloat16)
+print("elementwise add 2M f32 :", timeit(jax.jit(lambda x: x + 1.0), v)*1e3, "ms (8MB)")
+t = timeit(jax.jit(lambda a: a @ a), big)
+print("matmul 4096^3 bf16     :", t*1e3, "ms ->", 2*4096**3/t/1e12, "TFLOP/s")
+v8 = jnp.asarray(rng.normal(0,1,(16, N)), jnp.float32)
+print("elementwise add (16,2M):", timeit(jax.jit(lambda x: x + 1.0), v8)*1e3, "ms (256MB rw)")
+codes = jnp.asarray(rng.integers(0, 256, (N, 28)), jnp.uint8)
+perm = jnp.asarray(rng.permutation(N), jnp.int32)
+vals = jnp.asarray(rng.normal(0,1,N), jnp.float32)
+print("gather codes (N,28)[perm]:", timeit(jax.jit(lambda c,p: c[p]), codes, perm)*1e3, "ms")
+print("scatter perm (N,) f32    :", timeit(jax.jit(lambda v,p: jnp.zeros_like(v).at[p].set(v)), vals, perm)*1e3, "ms")
+print("sort_key_val (N,)        :", timeit(jax.jit(lambda k,v: jax.lax.sort_key_val(k,v)), perm, perm)*1e3, "ms")
+print("cumsum f32 (N,)          :", timeit(jax.jit(lambda v: jnp.cumsum(v)), vals)*1e3, "ms")
+print("segment_sum 256 (N,)     :", timeit(jax.jit(lambda v,l: jax.ops.segment_sum(v, l, num_segments=256)), vals, perm % 256)*1e3, "ms")

@@ -1,0 +1,36 @@
+import time, numpy as np, jax, jax.numpy as jnp
+
+def sync(r): _ = float(jnp.asarray(r).ravel()[0].astype(jnp.float32))
+def timeit(f, *a, n=3):
+    for _ in range(2): r = f(*a)
+    sync(r)
+    t0 = time.time()
+    for _ in range(n): r = f(*a)
+    sync(r)
+    return (time.time() - t0) / n
+
+rng = np.random.default_rng(0)
+big = jnp.asarray(rng.normal(0,1,(4096, 4096)), jnp.bfloat16)
+
+def chain_mm(k):
+    def f(a):
+        x = a
+        for _ in range(k):
+            x = (x @ a)
+        return x
+    return jax.jit(f)
+
+t1 = timeit(chain_mm(1), big)
+t20 = timeit(chain_mm(20), big)
+per = (t20 - t1) / 19
+print(f"mm x1: {t1*1e3:.2f}ms  x20: {t20*1e3:.2f}ms  -> per-mm {per*1e3:.3f}ms = {2*4096**3/per/1e12:.0f} TFLOP/s, dispatch overhead ~{(t1-per)*1e3:.2f}ms")
+
+v16 = jnp.asarray(rng.normal(0,1,(16, 2_000_000)), jnp.float32)
+def chain_add(k):
+    def f(x):
+        for i in range(k): x = x + 1.0
+        return x
+    return jax.jit(f)
+a1 = timeit(chain_add(1), v16); a20 = timeit(chain_add(20), v16)
+pera = (a20 - a1) / 19
+print(f"add(128MB) x1: {a1*1e3:.2f}ms x20: {a20*1e3:.2f}ms -> per-add {pera*1e3:.3f}ms = {2*128/pera/1e3:.0f} GB/s")

@@ -1,0 +1,66 @@
+"""Measure gather/scatter/cumsum at bench scale (n=11M) with in-jit loops to
+amortize the ~25ms tunnel latency."""
+import time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+
+N, C = 11_000_000, 28
+rng = np.random.default_rng(0)
+codes_T = jnp.asarray(rng.integers(0, 256, (C, N)), jnp.int32)   # (C, n)
+codes_R = jnp.asarray(rng.integers(0, 256, (N, C)), jnp.int32)   # (n, C)
+codes_R8 = codes_R.astype(jnp.uint8)
+perm = jnp.asarray(rng.permutation(N), jnp.int32)
+stats = jnp.asarray(rng.normal(0, 1, (8, N)), jnp.float32)
+vals = jnp.asarray(rng.normal(0, 1, N), jnp.float32)
+
+def sync(r): _ = float(jnp.asarray(r).ravel()[0].astype(jnp.float32))
+
+def timek(f, *a, k=8):
+    r = f(*a); sync(r)
+    t0 = time.time(); r = f(*a); sync(r)
+    return (time.time() - t0) / k
+
+K = 8
+@jax.jit
+def gather_T(c, p):
+    def body(i, acc):
+        return acc + c[:, (p + i)].astype(jnp.int32).sum()
+    return lax.fori_loop(0, K, body, jnp.int32(0))
+
+@jax.jit
+def gather_R(c, p):
+    def body(i, acc):
+        return acc + c[(p + i)].astype(jnp.int32).sum()
+    return lax.fori_loop(0, K, body, jnp.int32(0))
+
+@jax.jit
+def gather_stats(s, p):
+    def body(i, acc):
+        return acc + s[:, (p + i)].sum()
+    return lax.fori_loop(0, K, body, jnp.float32(0))
+
+@jax.jit
+def scatter_perm(v, p):
+    def body(i, acc):
+        out = jnp.zeros_like(v).at[(p + i) % N].set(v)
+        return acc + out[0]
+    return lax.fori_loop(0, K, body, jnp.float32(0))
+
+@jax.jit
+def cumsum_n(v):
+    def body(i, acc):
+        return acc + jnp.cumsum(v + i)[-1]
+    return lax.fori_loop(0, K, body, jnp.float32(0))
+
+@jax.jit
+def transpose_RT(c):
+    def body(i, acc):
+        return acc + (c + i).T.astype(jnp.int32)[:, ::1024].sum()
+    return lax.fori_loop(0, K, body, jnp.int32(0))
+
+print("gather codes (C,n)[:,perm] int32:", timek(gather_T, codes_T, perm)*1e3, "ms")
+print("gather codes (n,C)[perm] int32  :", timek(gather_R, codes_R, perm)*1e3, "ms")
+print("gather codes (n,C)[perm] uint8  :", timek(gather_R, codes_R8, perm)*1e3, "ms")
+print("gather stats (8,n)[:,perm] f32  :", timek(gather_stats, stats, perm)*1e3, "ms")
+print("scatter (n,) f32 perm           :", timek(scatter_perm, vals, perm)*1e3, "ms")
+print("cumsum (n,) f32                 :", timek(cumsum_n, vals)*1e3, "ms")
+print("transpose (n,C)->(C,n) int32    :", timek(transpose_RT, codes_R)*1e3, "ms")

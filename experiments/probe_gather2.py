@@ -1,0 +1,52 @@
+import sys, time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+N, C = 11_000_000, 28
+rng = np.random.default_rng(0)
+perm = jnp.asarray(rng.permutation(N), jnp.int32)
+vals = jnp.asarray(rng.normal(0, 1, N), jnp.float32)
+def sync(r): _ = float(jnp.asarray(r).ravel()[0].astype(jnp.float32))
+def timek(f, *a, k=4):
+    t0=time.time(); r = f(*a); sync(r); print("  (compile+1st:", time.time()-t0, "s)", flush=True)
+    t0 = time.time(); r = f(*a); sync(r)
+    return (time.time() - t0) / k
+K = 4
+which = sys.argv[1]
+if which == "gatherR":
+    codes_R = jnp.asarray(rng.integers(0, 256, (N, C)), jnp.int32)
+    @jax.jit
+    def f(c, p):
+        def body(i, acc): return acc + c[(p + i)].astype(jnp.int32).sum()
+        return lax.fori_loop(0, K, body, jnp.int32(0))
+    print("gather (n,C)[perm] int32:", timek(f, codes_R, perm)*1e3, "ms", flush=True)
+elif which == "gatherT":
+    codes_T = jnp.asarray(rng.integers(0, 256, (C, N)), jnp.int32)
+    @jax.jit
+    def f(c, p):
+        def body(i, acc): return acc + c[:, (p + i)].astype(jnp.int32).sum()
+        return lax.fori_loop(0, K, body, jnp.int32(0))
+    print("gather (C,n)[:,perm] int32:", timek(f, codes_T, perm)*1e3, "ms", flush=True)
+elif which == "scatter":
+    @jax.jit
+    def f(v, p):
+        def body(i, acc):
+            out = jnp.zeros_like(v).at[(p + i) % N].set(v)
+            return acc + out[0]
+        return lax.fori_loop(0, K, body, jnp.float32(0))
+    print("scatter (n,) f32:", timek(f, vals, perm)*1e3, "ms", flush=True)
+elif which == "cumsum":
+    @jax.jit
+    def f(v):
+        def body(i, acc): return acc + jnp.cumsum(v + i)[-1]
+        return lax.fori_loop(0, K, body, jnp.float32(0))
+    print("cumsum (n,) f32:", timek(f, vals)*1e3, "ms", flush=True)
+elif which == "sort":
+    @jax.jit
+    def f(v, p):
+        def body(i, carry):
+            k2, v2 = lax.sort_key_val(p + i, carry)
+            return v2
+        return lax.fori_loop(0, K, body, vals)
+    print("sort_key_val (n,):", timek(f, vals, perm)*1e3, "ms", flush=True)

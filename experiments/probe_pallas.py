@@ -1,0 +1,42 @@
+"""Probe: does Pallas/Mosaic compile through the axon TPU tunnel, and what do
+the primitive ops of a pre-binned histogram engine cost at bench scale?"""
+import time, numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+print("backend:", jax.default_backend(), jax.devices())
+
+def timeit(f, *a, n=5, warm=2):
+    for _ in range(warm):
+        jax.block_until_ready(f(*a))
+    t0 = time.time()
+    for _ in range(n):
+        r = f(*a)
+    jax.block_until_ready(r)
+    return (time.time() - t0) / n
+
+# trivial pallas kernel
+def k(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+x = jnp.ones((256, 256), jnp.float32)
+y = pl.pallas_call(k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+print("pallas trivial OK:", float(y.sum()))
+
+N, C = 2_000_000, 28
+rng = np.random.default_rng(0)
+codes = jnp.asarray(rng.integers(0, 256, (N, C)), jnp.uint8)
+codes_T = jnp.asarray(np.asarray(codes).T)          # (C, N)
+stats8 = jnp.asarray(rng.normal(0, 1, (8, N)), jnp.float32)
+perm = jnp.asarray(rng.permutation(N), jnp.int32)
+vals = jnp.asarray(rng.normal(0, 1, N), jnp.float32)
+
+g1 = jax.jit(lambda c, p: c[p])                     # gather rows (N,C) uint8
+g2 = jax.jit(lambda c, p: c[:, p])                  # gather cols of (C,N)
+sc = jax.jit(lambda v, p: jnp.zeros_like(v).at[p].set(v))   # perm scatter
+srt = jax.jit(lambda k, v: jax.lax.sort_key_val(k, v))
+print("gather codes (N,C)[perm]  :", timeit(g1, codes, perm)*1e3, "ms")
+print("gather codes (C,N)[:,perm]:", timeit(g2, codes_T, perm)*1e3, "ms")
+print("scatter perm (N,) f32     :", timeit(sc, vals, perm)*1e3, "ms")
+print("sort_key_val int32 (N,)   :", timeit(srt, perm, perm)*1e3, "ms")
+cs = jax.jit(lambda v: jnp.cumsum(v))
+print("cumsum f32 (N,)           :", timeit(cs, vals)*1e3, "ms")

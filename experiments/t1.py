@@ -1,0 +1,23 @@
+import sys, time, numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+sys.path.insert(0, "/root/repo")
+print("devices:", jax.devices(), flush=True)
+from h2o3_tpu.ops.hist_pallas import hist_pallas, hist_segsum, BLOCK_ROWS, N_STATS
+rng = np.random.default_rng(0)
+L, B, C_pad = 8, 256, 32
+nblk = 16
+n_pad = nblk * BLOCK_ROWS
+codes = jnp.asarray(rng.integers(0, B, (n_pad, C_pad)), jnp.int32)
+stats = jnp.asarray(rng.normal(0, 1, (N_STATS, n_pad)), jnp.float32)
+bl = jnp.asarray(np.sort(rng.integers(0, L, nblk)), jnp.int32)
+t0=time.time()
+h_ref = hist_segsum(codes, stats, bl, n_leaves=L, n_bins=B)
+h_ref_np = np.asarray(h_ref)
+print("segsum done", time.time()-t0, "s", flush=True)
+t0=time.time()
+h_pal = hist_pallas(codes, stats, bl, n_leaves=L, n_bins=B)
+h_pal_np = np.asarray(h_pal)
+print("pallas done", time.time()-t0, "s", flush=True)
+err = np.abs(h_ref_np - h_pal_np).max()
+print("correctness max|diff|:", err, flush=True)

@@ -296,6 +296,10 @@ class TreeArrays:
     value: object     # (T, nodes) f32 — prediction if stopped here
     depth: int
     cover: object = None   # (T, nodes) f32 training weight per node (SHAP)
+    # categorical SET splits (water/util/IcedBitSet.java analog): per-node
+    # go-right bitset over level ids, plus which columns are categorical
+    catbits: object = None      # (T, nodes, W) uint32 or None
+    col_is_cat: object = None   # (C,) bool or None
 
     @property
     def ntrees(self):
@@ -317,7 +321,9 @@ def stack_trees(tree_list, depth) -> TreeArrays:
 
 
 def predict_ensemble(X, trees: TreeArrays, weights=None):
-    """Σ_t value[t, leaf_t(row)] — fixed-depth gather walk per tree."""
+    """Σ_t value[t, leaf_t(row)] — fixed-depth gather walk per tree.
+    Categorical SET-split nodes route by bitset membership of the level id
+    (hex/genmodel GenModel.bitSetContains analog)."""
     col = jnp.asarray(trees.col)
     thr = jnp.asarray(trees.thr)
     nal = jnp.asarray(trees.na_left)
@@ -325,6 +331,12 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
     tw = (jnp.asarray(weights, jnp.float32) if weights is not None
           else jnp.ones(trees.ntrees, jnp.float32))
     depth = trees.depth
+    has_cat = trees.catbits is not None and trees.col_is_cat is not None \
+        and bool(np.any(np.asarray(trees.col_is_cat)))
+    if has_cat:
+        catbits = jnp.asarray(trees.catbits)
+        iscat = jnp.asarray(np.asarray(trees.col_is_cat))
+        nb = catbits.shape[-1] * 32
 
     @jax.jit
     def run(X, col, thr, nal, val, tw):
@@ -339,7 +351,14 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
                 cc = jnp.maximum(c, 0)
                 x = jnp.take_along_axis(X, cc[:, None], axis=1)[:, 0]
                 isna = jnp.isnan(x)
-                right = jnp.where(isna, ~nal[t][node], x > thr[t][node])
+                right = x > thr[t][node]
+                if has_cat:
+                    code = jnp.clip(jnp.nan_to_num(x).astype(jnp.int32),
+                                    0, nb - 1)
+                    word = catbits[t][node, code // 32]
+                    bit = (word >> (code % 32).astype(jnp.uint32)) & 1
+                    right = jnp.where(iscat[cc], bit == 1, right)
+                right = jnp.where(isna, ~nal[t][node], right)
                 child = 2 * node + 1 + right.astype(jnp.int32)
                 return jnp.where(leafish, node, child)
 

@@ -1,0 +1,146 @@
+"""Pallas TPU kernel: leaf-partitioned histogram accumulation — the rebuild
+of H2O's ScoreBuildHistogram2 hot loop (SURVEY §2.4 row 1).
+
+Reference semantics: hex/tree/ScoreBuildHistogram2.java:20-60 accumulates
+per-(leaf, column) histograms of {w, wY, wYY} over binned rows, with private
+per-thread copies merged in reduce (DHistogram.java:59-70, :338). The
+reference avoids CAS by giving each (column, row-range) task a private copy.
+
+TPU-native design: rows are kept PARTITIONED by leaf (leaf-aligned blocks of
+R rows, maintained by the grower's stable-partition step), so a histogram is
+a sequence of per-block accumulations that all land in the SAME output tile
+while consecutive grid steps visit the same leaf — Pallas keeps the output
+block resident in VMEM across those steps (the grouped-matmul revisiting
+pattern) and flushes once per (leaf, column-tile). The per-block compute is
+a one-hot expansion of the bin codes (VPU compare against a broadcasted
+iota) contracted with the per-row stats panel on the MXU:
+
+    hist[s, b] += stats[s, r] @ onehot[r, b]      (8, R) x (R, B) -> (8, B)
+
+There is no CAS, no private copies, and no reduce tree: cross-shard merging
+is a single psum over the mesh row axis done by the caller.
+
+Stats panel rows (sublane dim, padded to 8): 0=row count, 1=weight w,
+2=w*grad, 3=w*hess — count feeds the partition bookkeeping, w feeds
+min_rows, (wg, wh) feed split gain and Newton leaf values
+(hex/tree/DHistogram.java _vals packing analog).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # Pallas import is deferred-safe: exotic envs may lack Mosaic
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+# Rows per partition block == rows per kernel grid step. Must divide n_pad.
+BLOCK_ROWS = 1024
+# Stats panel sublane count (f32 tile granule).
+N_STATS = 8
+# Column tile per grid step.
+COL_TILE = 8
+
+
+def _hist_kernel(bl_ref, codes_ref, stats_ref, out_ref, *, n_cols, n_bins):
+    """One grid step: accumulate one (leaf, column-tile) partial histogram.
+
+    codes_ref: (BLOCK_ROWS, COL_PAD) int32 — bin codes for this row block
+    stats_ref: (N_STATS, BLOCK_ROWS) f32 — stats panel (already permuted)
+    out_ref:   (1, COL_TILE, N_STATS, n_bins) f32 — hist[leaf, ct] tile
+    bl_ref:    scalar-prefetch (NBLK,) int32 — block -> leaf id
+    """
+    j = pl.program_id(1)
+    first = jnp.logical_or(j == 0, bl_ref[j] != bl_ref[jnp.maximum(j - 1, 0)])
+
+    stats = stats_ref[...]                                    # (8, R)
+    iota = lax.broadcasted_iota(jnp.int32, (BLOCK_ROWS, n_bins), 1)
+
+    parts = []
+    for c in range(COL_TILE):
+        code_c = codes_ref[:, c][:, None]                     # (R, 1)
+        oh = (iota == code_c).astype(jnp.float32)             # (R, B)
+        h = lax.dot_general(stats, oh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        parts.append(h)                                       # (8, B)
+    h_tile = jnp.stack(parts)[None]                           # (1, CT, 8, B)
+
+    @pl.when(first)
+    def _init():
+        out_ref[...] = h_tile
+
+    @pl.when(jnp.logical_not(first))
+    def _acc():
+        out_ref[...] = out_ref[...] + h_tile
+
+
+@functools.partial(jax.jit, static_argnames=("n_leaves", "n_bins"))
+def hist_pallas(codes_p, stats_p, block_leaf, *, n_leaves, n_bins):
+    """hist (n_leaves, C_pad, N_STATS, n_bins) f32 from partitioned codes.
+
+    codes_p: (n_pad, C_pad) int32, rows grouped by leaf in BLOCK_ROWS-aligned
+             segments (pad rows carry zero stats); C_pad multiple of COL_TILE
+    stats_p: (N_STATS, n_pad) f32 stats panel in the same row order
+    block_leaf: (n_pad // BLOCK_ROWS,) int32 — leaf owning each block,
+             non-decreasing
+    """
+    n_pad, c_pad = codes_p.shape
+    nblk = n_pad // BLOCK_ROWS
+    n_ct = c_pad // COL_TILE
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_ct, nblk),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, COL_TILE),
+                         lambda ct, j, bl: (j, ct)),
+            pl.BlockSpec((N_STATS, BLOCK_ROWS),
+                         lambda ct, j, bl: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, COL_TILE, N_STATS, n_bins),
+                               lambda ct, j, bl: (bl[j], ct, 0, 0)),
+    )
+    kernel = functools.partial(_hist_kernel, n_cols=c_pad, n_bins=n_bins)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_leaves, c_pad, N_STATS, n_bins), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(block_leaf, codes_p, stats_p)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n_leaves", "n_bins"))
+def hist_segsum(codes_p, stats_p, block_leaf, *, n_leaves, n_bins):
+    """Reference/CPU fallback: same contract via segment-sum (scatter-add is
+    fast on CPU, where the virtual-mesh tests run)."""
+    n_pad, c_pad = codes_p.shape
+    leaf_of_slot = jnp.repeat(block_leaf, BLOCK_ROWS)          # (n_pad,)
+    base = leaf_of_slot * n_bins
+
+    def one_col(c):
+        idx = base + codes_p[:, c]
+        return jax.ops.segment_sum(stats_p.T, idx,
+                                   num_segments=n_leaves * n_bins)
+
+    hs = lax.map(one_col, jnp.arange(c_pad))       # (C, L*B, 8)
+    return hs.reshape(c_pad, n_leaves, n_bins, N_STATS) \
+             .transpose(1, 0, 3, 2)
+
+
+def build_hist(codes_p, stats_p, block_leaf, *, n_leaves, n_bins):
+    """Dispatch: Pallas on TPU, segment-sum elsewhere."""
+    if _HAVE_PALLAS and jax.default_backend() == "tpu":
+        return hist_pallas(codes_p, stats_p, block_leaf,
+                           n_leaves=n_leaves, n_bins=n_bins)
+    return hist_segsum(codes_p, stats_p, block_leaf,
+                       n_leaves=n_leaves, n_bins=n_bins)
