@@ -1,11 +1,13 @@
-"""Headline benchmark: GBM (bernoulli) training throughput on HIGGS-like data.
+"""Headline benchmark: GBM (bernoulli) training throughput on HIGGS-shaped
+data — 11M rows x 28 features, depth 8, 255 value bins, sustained trees/s.
 
 BASELINE.json metric: "HIGGS + airlines-1B GBM wall-clock vs H100 gpu_hist".
 The reference publishes no absolute number ("published": {}); the comparison
-point used here is XGBoost `gpu_hist` on HIGGS-class data on one H100:
-~11M rows × 28 features × 500 trees (depth 8) in ≈35 s ≈ 157M row·trees/s.
-We report sustained row·trees/s of the TPU histogram tree engine and
-vs_baseline = throughput / 157e6 (>1.0 beats the H100 reference point).
+point is XGBoost `gpu_hist` on HIGGS on one H100: ~11M rows x 28 features x
+500 trees (depth 8, 256 bins) in ~35 s ~= 157M row*trees/s. We report
+sustained row*trees/s of the binned tree engine (global quantile codes +
+Pallas histogram kernel — the same `hist` algorithm family) at the SAME
+shape: full 11M rows, depth 8, 255+NA bins, no extrapolation.
 
 Prints ONE JSON line.
 """
@@ -20,55 +22,65 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    # persistent compile cache: first bench run pays XLA compilation (slow
-    # through the remote-compile relay), later runs start hot
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    import h2o3_tpu
-    from h2o3_tpu.models.tree import engine as E
-    from h2o3_tpu.models.tree.shared_tree import _grad_hess
+    from h2o3_tpu.models.tree import binned as BN
 
-    h2o3_tpu.init()
-    N, C = 1_000_000, 28
-    DEPTH, NBINS, NTREES = 6, 32, 20
-    rng = np.random.default_rng(0)
-    Xh = rng.normal(0, 1, (N, C)).astype(np.float32)
-    wgt = 1.5 * Xh[:, 0] - Xh[:, 1] + 0.5 * Xh[:, 2] * Xh[:, 3]
-    yh = (rng.random(N) < 1 / (1 + np.exp(-wgt))).astype(np.float32)
+    N, C = 11_000_000, 28
+    DEPTH, NBINS = 8, 255
+    WARM, CHUNK, NCHUNK = 10, 10, 4          # 10 warmup + 40 timed trees
 
-    from h2o3_tpu.parallel import mrtask as mr
-    X = mr.device_put_rows(Xh)
-    y = mr.device_put_rows(yh)
-    w = jnp.ones(N, jnp.float32)
+    # generate HIGGS-like data ON DEVICE (host->device of 1.2GB through the
+    # remote relay would dominate; the benchmark measures training, not IO)
+    key = jax.random.PRNGKey(7)
+    kx, kn, ky = jax.random.split(key, 3)
 
-    grower = E.TreeGrower(nbins=NBINS, max_depth=DEPTH, min_rows=10,
-                          min_split_improvement=1e-5)
-    F = jnp.zeros(N, jnp.float32)
+    @jax.jit
+    def gen(kx, kn, ky):
+        X = jax.random.normal(kx, (N, C), jnp.float32)
+        logit = (1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.6 * X[:, 2] * X[:, 3]
+                 + 0.4 * jnp.sin(X[:, 4]) + 0.3 * X[:, 5] * X[:, 6])
+        y = (jax.random.uniform(ky, (N,)) <
+             jax.nn.sigmoid(logit)).astype(jnp.float32)
+        return X, y
 
-    import jax.random as jrandom
-    key = jrandom.PRNGKey(0)
+    X, y = gen(kx, kn, ky)
 
-    def one_tree(F, k):
-        res, hess = _grad_hess("bernoulli", F, y)
-        col, thr, nal, val, heap, _ = grower.grow(X, w, res, key=k)
-        val = E.gamma_pass(heap, w, res, hess, val, nodes=grower.nodes)
-        return F + 0.1 * val[heap]
+    # bin spec from a host-side sample (29MB readback), codes on device
+    Xs = np.asarray(X[: 1 << 18])
+    spec = BN.make_bins(Xs, np.zeros(C, bool), NBINS)
+    codes = BN.quantize(X, spec)
+    del X
 
-    # warmup: compile every per-level kernel (sync via scalar readback —
-    # block_until_ready is unreliable through the axon relay)
-    key, k = jrandom.split(key)
-    F = one_tree(F, k)
-    float(F.sum())
+    grower = BN.BinnedGrower(spec, max_depth=DEPTH, min_rows=1.0,
+                             min_split_improvement=0.0)
+    trainer = BN.gbm_chunk_trainer(grower, N, dist="bernoulli", eta=0.1,
+                                   sample_rate=1.0, mtries=0, k_trees=CHUNK)
+    n_pad = grower.layout(N)
+    y1 = BN.pad_rows(y, n_pad)
+    w1 = BN.pad_rows(jnp.ones(N, jnp.float32), n_pad)
+    p0 = float(jnp.mean(y))
+    F = jnp.where(jnp.arange(n_pad) < N,
+                  float(np.log(p0 / (1 - p0))), 0.0).astype(jnp.float32)
+
+    k = jax.random.PRNGKey(0)
+    # warmup: compile + first chunk (sync via scalar readback — large
+    # block_until_ready readbacks are unreliable through the axon relay)
+    k, kc = jax.random.split(k)
+    F, _ = trainer(codes, y1, w1, F, kc)
+    float(F[0])
+
     t0 = time.time()
-    for _ in range(NTREES):
-        key, k = jrandom.split(key)
-        F = one_tree(F, k)
-    float(F.sum())
+    for _ in range(NCHUNK):
+        k, kc = jax.random.split(k)
+        F, _ = trainer(codes, y1, w1, F, kc)
+    float(F[0])
     dt = time.time() - t0
 
-    throughput = N * NTREES / dt
-    baseline = 157e6  # H100 gpu_hist row·trees/s reference point (see header)
+    ntrees = CHUNK * NCHUNK
+    throughput = N * ntrees / dt
+    baseline = 157e6  # H100 gpu_hist row*trees/s reference point (header)
     print(json.dumps({
         "metric": "gbm_hist_row_trees_per_sec",
         "value": round(throughput),

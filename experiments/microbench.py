@@ -1,0 +1,194 @@
+"""Microbenchmarks on the real chip to pick the histogram engine design.
+
+Axon-relay rules learned the hard way: block_until_ready doesn't wait, and
+any multi-MB device->host transfer costs ~100s of ms through the tunnel. So
+every timed fn must END in a scalar (device-side reduction), and we sync via
+float(scalar).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def timeit(name, fn, *args, n=3, work=1):
+    float(fn(*args))  # compile + warm
+    t0 = time.time()
+    for _ in range(n):
+        s = fn(*args)
+    s = float(s)
+    dt = (time.time() - t0) / n
+    print(f"{name}: {dt*1e3:.2f} ms   [{s:.3g}]")
+    return dt
+
+
+N = 11_000_000
+rng = np.random.default_rng(0)
+
+# 0. relay round-trip latency for a trivial scalar
+z = jnp.float32(1.0)
+timeit("scalar round-trip", jax.jit(lambda z: z + 1), z, n=10)
+
+# 1. matmul peak bf16: chain of 40 4k matmuls inside one jit
+M = 4096
+a = jnp.asarray(rng.normal(size=(M, M)), jnp.bfloat16)
+
+@jax.jit
+def mm(a):
+    x = a
+    for _ in range(40):
+        x = jnp.dot(x, a * 1e-3, preferred_element_type=jnp.bfloat16)
+    return x.astype(jnp.float32).sum()
+
+dt = timeit("40x 4k bf16 matmul", mm, a)
+print(f"  -> {40*2*M**3/dt/1e12:.1f} TFLOP/s")
+
+# 2. HBM stream
+x = jnp.asarray(rng.normal(size=(N * 4,)), jnp.float32)
+
+@jax.jit
+def ew(x):
+    return (x * 1.0001 + 1.0).sum()
+
+dt = timeit("stream 176MB f32 read", ew, x)
+print(f"  -> {x.size*4/dt/1e9:.0f} GB/s read")
+
+# 3. gather rows: (N, 4) f32 by random perm
+tbl = jnp.asarray(rng.normal(size=(N, 4)), jnp.float32)
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+@jax.jit
+def gather_rows(tbl, perm):
+    return jnp.take(tbl, perm, axis=0).sum()
+
+dt = timeit("gather 11M rows of 16B (random)", gather_rows, tbl, perm)
+print(f"  -> {N/dt/1e6:.0f} M rows/s")
+
+col = tbl[:, 0]
+
+@jax.jit
+def gather_elem(col, perm):
+    return jnp.take(col, perm).sum()
+
+dt = timeit("gather 11M f32 scalars (random)", gather_elem, col, perm)
+print(f"  -> {N/dt/1e6:.0f} M elems/s")
+
+# 3c. sorted-ish gather (locality): perm = identity + small noise
+perm_loc = jnp.asarray(
+    np.clip(np.arange(N) + rng.integers(-32, 32, N), 0, N - 1).astype(np.int32))
+dt = timeit("gather 11M f32 scalars (local +-32)", gather_elem, col, perm_loc)
+print(f"  -> {N/dt/1e6:.0f} M elems/s")
+
+# 4. segment_sum histogram-shaped
+L, nb = 64, 256
+leaf = jnp.asarray(rng.integers(0, L, N).astype(np.int32))
+codes = jnp.asarray(rng.integers(0, nb, (N, 8)).astype(np.int8))
+stats = jnp.asarray(rng.normal(size=(N, 2)), jnp.float32)
+
+@jax.jit
+def seghist(leaf, codes, stats):
+    def one_col(c):
+        idx = leaf * nb + codes[:, c].astype(jnp.int32)
+        return jax.ops.segment_sum(stats, idx, num_segments=L * nb)
+    return jax.lax.map(one_col, jnp.arange(8)).sum()
+
+dt = timeit("segment_sum hist 8 cols L=64 nb=256", seghist, leaf, codes, stats)
+print(f"  -> {8*N/dt/1e6:.0f} M updates/s")
+
+# 4b. segment_sum with SORTED ids (contiguous segments)
+leaf_sorted = jnp.sort(leaf)
+
+@jax.jit
+def segsorted(leaf_sorted, stats):
+    return jax.ops.segment_sum(stats, leaf_sorted, num_segments=L,
+                               indices_are_sorted=True).sum()
+
+dt = timeit("segment_sum 11M->64 sorted ids", segsorted, leaf_sorted, stats)
+print(f"  -> {N/dt/1e6:.0f} M updates/s")
+
+# 5. cumsum + argsort, scalar-ended
+@jax.jit
+def csum(col):
+    return jnp.cumsum(col).sum()
+
+dt = timeit("cumsum 11M f32", csum, col)
+keys = jnp.asarray(rng.integers(0, 1 << 30, N).astype(np.int32))
+
+@jax.jit
+def asort(keys):
+    return jnp.argsort(keys).sum()
+
+dt = timeit("argsort 11M int32", asort, keys)
+
+@jax.jit
+def ssort(keys):
+    return jnp.sort(keys).sum()
+
+dt = timeit("sort 11M int32", ssort, keys)
+
+# 7. one-hot matmul histogram cost model: scan over 512 tiles,
+#    per tile (CBnb=2048, TR=1024) @ (TR, 128)
+TR, CB = 1024, 8
+NT = 512
+codes8 = jnp.asarray(rng.integers(0, nb, (NT * TR, CB)).astype(np.int8))
+stats2 = jnp.asarray(rng.normal(size=(NT * TR, 2)), jnp.float32)
+leaf2 = jnp.asarray(rng.integers(0, 64, NT * TR).astype(np.int32))
+
+@jax.jit
+def onehot_mm(codes8, stats2, leaf2):
+    def tile(carry, t):
+        cb = jax.lax.dynamic_slice(codes8, (t * TR, 0), (TR, CB))
+        st = jax.lax.dynamic_slice(stats2, (t * TR, 0), (TR, 2))
+        lf = jax.lax.dynamic_slice(leaf2, (t * TR,), (TR,))
+        oh = (cb.astype(jnp.int32)[:, :, None] ==
+              jnp.arange(nb, dtype=jnp.int32)[None, None, :])
+        oh = oh.reshape(TR, CB * nb).astype(jnp.bfloat16)
+        R = (jax.nn.one_hot(lf % 64, 64, dtype=jnp.bfloat16)[:, :, None]
+             * st[:, None, :].astype(jnp.bfloat16)).reshape(TR, 128)
+        h = jax.lax.dot_general(oh, R, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return carry + h, t
+
+    init = jnp.zeros((CB * nb, 128), jnp.float32)
+    out, _ = jax.lax.scan(tile, init, jnp.arange(NT))
+    return out.sum()
+
+dt = timeit("onehot-mm 512 tiles (524k rows, 8cols, nb=256, N=128)",
+            onehot_mm, codes8, stats2, leaf2)
+rows = NT * TR
+persec = rows * CB / dt
+print(f"  -> {persec/1e6:.1f} M row·cols/s -> level(11M,28c) = "
+      f"{N*28/persec*1e3:.0f} ms")
+
+# 8. code-sorted segment-matmul cost model: per column, gather stats panel by
+#    static perm, then tile-matmul leaf-onehot(64)xstats over code blocks.
+#    Cost ~ gather(11M) + matmul (TR,128)x... per tile: (128, TR) @ (TR, 128)
+panel = jnp.concatenate([stats2, jnp.zeros((NT * TR, 2), jnp.float32)], 1)
+
+@jax.jit
+def sorted_segmm(panel, perm_, leaf2):
+    g = jnp.take(panel, perm_, axis=0)            # the per-column gather
+    lf = jnp.take(leaf2, perm_)
+
+    def tile(carry, t):
+        st = jax.lax.dynamic_slice(g, (t * TR, 0), (TR, 4))
+        lfT = jax.lax.dynamic_slice(lf, (t * TR,), (TR,))
+        ohl = jax.nn.one_hot(lfT % 64, 64, dtype=jnp.bfloat16)  # (TR, 64)
+        h = jax.lax.dot_general(ohl, st.astype(jnp.bfloat16),
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return carry + h, t
+
+    out, _ = jax.lax.scan(tile, jnp.zeros((64, 4), jnp.float32),
+                          jnp.arange(NT))
+    return out.sum()
+
+perm2 = jnp.asarray(rng.permutation(NT * TR).astype(np.int32))
+dt = timeit("code-sorted segmm 524k rows 1 col (gather+mm)",
+            sorted_segmm, panel, perm2, leaf2)
+print(f"  -> per col: {dt*1e3:.1f} ms for 524k rows -> "
+      f"level(11M,28c) = {dt*N/ (NT*TR) * 28 * 1e3:.0f} ms")

@@ -275,9 +275,23 @@ def _h_predict(h: _Handler, mid, fid):
         DKV.remove(pred.key)
         pred.key = dest
         DKV.put(dest, pred)
+    # metrics alongside the predictions when the frame carries the response
+    # (hex/Model.java:2077 BigScore + ModelMetricsHandler). Metric errors
+    # surface in the response rather than being swallowed.
+    mm_json = []
+    resp = (m._dinfo.response_name if getattr(m, "_dinfo", None) else None)
+    if resp and resp in f.names:
+        try:
+            perf = m.model_performance(f)
+            if perf is not None and hasattr(perf, "to_dict"):
+                mm_json = [dict(perf.to_dict(),
+                                frame={"name": f.key},
+                                model={"name": m.key})]
+        except Exception as ex:      # noqa: BLE001
+            mm_json = [{"error": repr(ex)}]
     h._send({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
              "predictions_frame": {"name": pred.key},
-             "model_metrics": []})
+             "model_metrics": mm_json})
 
 
 def _h_jobs(h: _Handler):
@@ -342,6 +356,119 @@ def _h_about(h: _Handler):
                          {"name": "Backend", "value": "jax/tpu"}]})
 
 
+def _h_model_metrics(h: _Handler, mid, fid=None):
+    """/3/ModelMetrics/models/{m}[/frames/{f}] — ModelMetricsHandler."""
+    m = DKV.get(mid)
+    if m is None:
+        return h._error("model not found", 404)
+    if fid is not None:
+        f = DKV.get(fid)
+        if f is None:
+            return h._error("frame not found", 404)
+        perf = m.model_performance(f)
+    else:
+        perf = m.model_performance()
+    mm = [dict(perf.to_dict(), model={"name": mid})] \
+        if perf is not None and hasattr(perf, "to_dict") else []
+    h._send({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
+             "model_metrics": mm})
+
+
+def _h_grids(h: _Handler):
+    grids = [k for k in DKV.keys()
+             if getattr(DKV.get(k), "grid_id", None) == k]
+    h._send({"__meta": {"schema_type": "GridsV99"},
+             "grids": [{"grid_id": {"name": g}} for g in grids]})
+
+
+def _h_grid(h: _Handler, gid):
+    g = DKV.get(gid)
+    if g is None or not hasattr(g, "models"):
+        return h._error("grid not found", 404)
+    h._send({"__meta": {"schema_type": "GridSchemaV99"},
+             "grid_id": {"name": gid},
+             "model_ids": [{"name": m.key} for m in g.models],
+             "hyper_names": list(getattr(g, "hyper_params", {}).keys())})
+
+
+def _h_automl_build(h: _Handler):
+    """POST /99/AutoMLBuilder — AutoMLBuilderHandler analog."""
+    from h2o3_tpu.automl.automl import H2OAutoML
+    p = h._params()
+    spec = p.get("build_control", {})
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    inp = p.get("input_spec", {})
+    if isinstance(inp, str):
+        inp = json.loads(inp)
+    stop = spec.get("stopping_criteria", {})
+
+    def _get_tf(d):
+        v = d.get("training_frame", "")
+        return v.get("name") if isinstance(v, dict) else v
+
+    train = DKV.get(p.get("training_frame") or _get_tf(inp) or "")
+    if train is None:
+        return h._error("training_frame not found", 404)
+    y = p.get("response_column") or inp.get("response_column")
+    if isinstance(y, dict):
+        y = y.get("column_name")
+    aml = H2OAutoML(
+        max_models=int(p.get("max_models") or stop.get("max_models") or 5),
+        seed=int(p.get("seed") or stop.get("seed") or 42),
+        project_name=p.get("project_name") or spec.get("project_name"))
+    from h2o3_tpu.core.jobs import Job
+    job = Job(description="AutoML build", dest=aml.project_name)
+    job.start(lambda j: aml.train(y=y, training_frame=train))
+    job.join()
+    h._send({"__meta": {"schema_type": "AutoMLBuilderV99"},
+             "job": {"key": {"name": job.key}},
+             "automl_id": {"name": aml.project_name}})
+
+
+def _h_automl(h: _Handler, pid):
+    aml = DKV.get(pid)
+    if aml is None or not hasattr(aml, "leaderboard_obj"):
+        return h._error("automl not found", 404)
+    lb = aml.leaderboard_obj
+    rows = lb.rows if lb is not None and hasattr(lb, "rows") else []
+    h._send({"__meta": {"schema_type": "AutoMLV99"},
+             "automl_id": {"name": pid},
+             "leaderboard_table": {"rows": rows},
+             "leader": rows[0] if rows else None})
+
+
+def _h_logs(h: _Handler, *_):
+    from h2o3_tpu.utils import log as _log
+    h._send({"__meta": {"schema_type": "LogsV3"},
+             "log": "\n".join(_log.recent(500))})
+
+
+def _h_timeline(h: _Handler):
+    from h2o3_tpu.utils.timeline import TIMELINE
+    try:
+        events = TIMELINE.snapshot()
+    except Exception:
+        events = []
+    h._send({"__meta": {"schema_type": "TimelineV3"},
+             "events": events[-512:]})
+
+
+def _h_metadata_endpoints(h: _Handler):
+    """/3/Metadata/endpoints — SchemaServer.java analog: live route
+    metadata that client-bindings codegen consumes."""
+    routes = []
+    for pat, m, fn in ROUTES:
+        routes.append({
+            "url_pattern": pat.pattern,
+            "http_method": m,
+            "handler_method": fn.__name__,
+            "summary": (fn.__doc__ or "").strip().split("\n")[0],
+        })
+    h._send({"__meta": {"schema_type": "EndpointsListV3"},
+             "routes": routes, "num_routes": len(routes)})
+
+
 ROUTES = [
     (re.compile(r"/3/Cloud"), "GET", _h_cloud),
     (re.compile(r"/3/About"), "GET", _h_about),
@@ -362,6 +489,19 @@ ROUTES = [
     (re.compile(r"/3/Jobs"), "GET", _h_jobs),
     (re.compile(r"/3/Jobs/([^/]+)"), "GET", _h_job),
     (re.compile(r"/99/Rapids"), "POST", _h_rapids),
+    (re.compile(r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)"), "POST",
+     _h_model_metrics),
+    (re.compile(r"/3/ModelMetrics/models/([^/]+)/frames/([^/]+)"), "GET",
+     _h_model_metrics),
+    (re.compile(r"/3/ModelMetrics/models/([^/]+)"), "GET", _h_model_metrics),
+    (re.compile(r"/99/Grids"), "GET", _h_grids),
+    (re.compile(r"/99/Grids/([^/]+)"), "GET", _h_grid),
+    (re.compile(r"/99/AutoMLBuilder"), "POST", _h_automl_build),
+    (re.compile(r"/99/AutoML/([^/]+)"), "GET", _h_automl),
+    (re.compile(r"/3/Logs/download"), "GET", _h_logs),
+    (re.compile(r"/3/Logs/nodes/([^/]+)/files/([^/]+)"), "GET", _h_logs),
+    (re.compile(r"/3/Timeline"), "GET", _h_timeline),
+    (re.compile(r"/3/Metadata/endpoints"), "GET", _h_metadata_endpoints),
     (re.compile(r"/3/InitID"), "GET", _h_init_session),
     (re.compile(r"/3/InitID"), "DELETE", _h_end_session),
     (re.compile(r"/3/Shutdown"), "POST", _h_shutdown),

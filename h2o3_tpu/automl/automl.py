@@ -112,6 +112,7 @@ class H2OAutoML:
         self.include_algos = ({a.lower() for a in include_algos}
                               if include_algos else None)
         self.project_name = project_name or DKV.make_key("automl")
+        DKV.put(self.project_name, self)
         self.leaderboard_obj = None
         self.event_log: list = []
         self.leader = None

@@ -1,13 +1,29 @@
 """Generic model — hex/generic/: import a MOJO as a first-class in-cluster
-model (scoreable via the normal predict path / REST)."""
+model (scoreable via the normal predict path / REST).
+
+Accepts BOTH artifact families:
+  * this framework's own npz-zip MOJOs (genmodel/mojo.py), and
+  * genuine reference-format H2O-3 MOJO zips (model.ini + trees/*.bin,
+    hex/genmodel layout) via genmodel/h2o_mojo.py.
+"""
 
 from __future__ import annotations
+
+import zipfile
 
 import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.genmodel.mojo import MojoModel
+
+
+def _is_reference_mojo(path: str) -> bool:
+    try:
+        with zipfile.ZipFile(path) as z:
+            return "model.ini" in z.namelist()
+    except Exception:
+        return False
 
 
 class H2OGenericEstimator:
@@ -17,21 +33,33 @@ class H2OGenericEstimator:
         self.params = {"path": path}
         self.key = model_key or DKV.make_key("generic")
         self._scorer: MojoModel | None = None
+        self._ref = None                 # reference-format H2OMojoModel
         if path:
-            self._scorer = MojoModel.load(path)
+            self._load(path)
             DKV.put(self.key, self)
+
+    def _load(self, path: str):
+        if _is_reference_mojo(path):
+            from h2o3_tpu.genmodel.h2o_mojo import import_h2o_mojo
+            self._ref = import_h2o_mojo(path)
+        else:
+            self._scorer = MojoModel.load(path)
 
     def train(self, training_frame=None, **kw):
         path = kw.get("path") or self.params.get("path")
-        self._scorer = MojoModel.load(path)
+        self._load(path)
         DKV.put(self.key, self)
         return self
 
     @property
     def original_algo(self):
+        if self._ref is not None:
+            return self._ref.algo
         return self._scorer.algo if self._scorer else None
 
     def predict(self, test_data: Frame) -> Frame:
+        if self._ref is not None:
+            return self._predict_reference(test_data)
         sc = self._scorer
         m = sc.meta
         rows = []
@@ -65,3 +93,33 @@ class H2OGenericEstimator:
             for k, v in out.items():
                 cols[k if k != "predict" else "predict"] = v
         return Frame.from_dict(cols)
+
+    # ---- reference-format MOJO scoring path ------------------------------
+    def _predict_reference(self, test_data: Frame) -> Frame:
+        mm = self._ref
+        n = test_data.nrows
+        feats = mm.columns[: mm.n_features]
+        X = np.full((n, mm.n_features), np.nan, np.float32)
+        for j, cname in enumerate(feats):
+            if cname not in test_data.names:
+                continue
+            v = test_data.vec(cname)
+            x = np.asarray(v.to_numpy(), np.float32)[:n]
+            if v.type == "enum" and j in mm.domains:
+                # remap frame levels onto the mojo's domain order
+                remap = {lv: k for k, lv in enumerate(mm.domains[j])}
+                codes = np.full(n, np.nan, np.float32)
+                for k, lv in enumerate(v.domain):
+                    codes[x == k] = remap.get(lv, np.nan)
+                x = codes
+            X[:, j] = x
+        out = mm.predict_raw(X)
+        resp_dom = mm.domains.get(len(mm.columns) - 1)
+        if out.ndim == 2 and resp_dom:
+            pred = np.argmax(out, axis=1).astype(np.float64)
+            cols = {"predict": pred}
+            for k, lvl in enumerate(resp_dom[: out.shape[1]]):
+                cols[f"p{lvl}"] = out[:, k].astype(np.float64)
+            return Frame.from_dict(cols)
+        return Frame.from_dict({"predict": np.asarray(out, np.float64)
+                                .reshape(n)})

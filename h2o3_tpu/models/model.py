@@ -538,7 +538,14 @@ class ModelBase:
         return EX.explain(self, frame, columns=columns)
 
     # ---- export (h2o-genmodel surface) -----------------------------------
-    def download_mojo(self, path: str) -> str:
+    def download_mojo(self, path: str, format: str = "native") -> str:
+        """format="native": this framework's npz-zip artifact.
+        format="h2o3": genuine reference-layout MOJO zip (tree models) that
+        the stock h2o-genmodel JAR scores unmodified
+        (hex/tree/SharedTreeMojoWriter.java layout)."""
+        if format == "h2o3":
+            from h2o3_tpu.genmodel.h2o_mojo import export_h2o_mojo
+            return export_h2o_mojo(self, path)
         from h2o3_tpu.genmodel.mojo import export_mojo
         return export_mojo(self, path)
 

@@ -97,7 +97,7 @@ def quantile(values, probs, weights=None, combine_method="interpolate"):
     ks = jnp.asarray(np.concatenate([klo, khi]), jnp.float32)
     vals = np.asarray(_order_stats(x, w, ks), np.float64)
     vlo, vhi = vals[: len(probs)], vals[len(probs):]
-    if combine_method in ("interpolate", None, "AUTO"):
+    if combine_method in ("interpolate", "interpolated", None, "AUTO"):
         g = h - klo
         return vlo + g * (vhi - vlo)
     if combine_method == "low":

@@ -85,25 +85,33 @@ def make_bins(X, is_cat, nbins: int, sample: int = 1 << 18) -> BinSpec:
                    b_val=b_val, n_bins=nb, c_pad=cp)
 
 
-@functools.partial(jax.jit, static_argnames=("b_val", "c_pad"))
-def _quantize(X, edges, *, b_val, c_pad):
-    """codes[r,c] = #edges < x (0..b_val-1), NA -> b_val. Output is padded
-    with a trailing dummy row (code 0) and dummy columns for the kernel."""
+@functools.partial(jax.jit, static_argnames=("b_val", "c_pad", "n_pad"))
+def _quantize(X, edges, *, b_val, c_pad, n_pad):
+    """codes[r,c] = #edges < x (0..b_val-1), NA -> b_val. Rows are padded to
+    the kernel block multiple with dummy rows (code 0, zero stats) and dummy
+    columns for the kernel's column tiling."""
     n, C = X.shape
 
     def one_col(x, e):
         code = jnp.searchsorted(e, x, side="left").astype(jnp.int32)
         return jnp.where(jnp.isnan(x), b_val, code)
 
-    codes = jax.vmap(one_col, in_axes=(1, 1), out_axes=1)(X, edges)
-    codes = jnp.clip(codes, 0, b_val)
-    out = jnp.zeros((n + 1, c_pad), jnp.int32)
+    codes = jax.vmap(one_col, in_axes=(1, 0), out_axes=0)(X, edges)
+    codes = jnp.clip(codes, 0, b_val)                    # (C, n)
+    out = jnp.zeros((c_pad, n_pad), jnp.int32)
     return lax.dynamic_update_slice(out, codes, (0, 0))
 
 
 def quantize(X, spec: BinSpec):
+    n = X.shape[0]
+    n_pad = -(-(n + 1) // R) * R
     return _quantize(X, jnp.asarray(spec.edges),
-                     b_val=spec.b_val, c_pad=spec.c_pad)
+                     b_val=spec.b_val, c_pad=spec.c_pad, n_pad=n_pad)
+
+
+def pad_rows(x, n_pad: int):
+    """Zero-pad a per-row vector to the quantize() row layout."""
+    return jnp.pad(x, (0, n_pad - x.shape[0]))
 
 
 # ===========================================================================
@@ -118,46 +126,49 @@ def _se_gain(wl, gl, wr, gr_, wp, gp, lam):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("b_val", "use_hess", "l_max"))
+    static_argnames=("b_val", "use_hess", "any_cat"))
 def find_splits_binned(hist, is_cat, mono, cmask, lo, hi, *, b_val,
-                       min_rows, msi, lam, use_hess, l_max):
+                       min_rows, msi, lam, use_hess, any_cat=True):
     """Vectorized bestCol over every (leaf, col, threshold/subset, NA-dir).
 
-    hist: (L, C_pad, 8, BP) — stats rows 0=cnt 1=w 2=wg 3=wh
+    hist: (L, C_pad, 4, BP) — stats rows 0=w 1=wg 2=wh (3 spare)
     is_cat: (C_pad,) bool; mono: (C_pad,) int32 in {-1,0,1}
     cmask: (L, C_pad) bool column availability (mtries / padding)
     lo, hi: (L,) f32 monotone value bounds for each leaf
 
     Returns dict of per-leaf arrays: did, col, bin, nal, route (L, BP) bool,
-    val_l, val_r (clamped), gain, plus per-leaf totals (cnt_t, w_t, val_t).
+    val_l, val_r (clamped), gain, plus per-leaf totals (w_t, val_t).
     """
     L, C, _, BP = hist.shape
-    cnt = hist[:, :, 0, :]
-    w = hist[:, :, 1, :]
-    wg = hist[:, :, 2, :]
-    wh = hist[:, :, 3, :]
+    w = hist[:, :, 0, :]
+    wg = hist[:, :, 1, :]
+    wh = hist[:, :, 2, :]
     den = wh if use_hess else w
 
     B = b_val
-    v_cnt, na_cnt = cnt[..., :B], cnt[..., B]
     v_w, na_w = w[..., :B], w[..., B]
     v_wg, na_wg = wg[..., :B], wg[..., B]
+    v_wh, na_wh = wh[..., :B], wh[..., B]
     v_den, na_den = den[..., :B], den[..., B]
 
     # ---- parent totals (identical for every real column; col 0 is real) --
-    cnt_t = v_cnt[:, 0].sum(-1) + na_cnt[:, 0]
     w_t = v_w[:, 0].sum(-1) + na_w[:, 0]
     wg_t = v_wg[:, 0].sum(-1) + na_wg[:, 0]
+    wh_t = v_wh[:, 0].sum(-1) + na_wh[:, 0]
     den_t = v_den[:, 0].sum(-1) + na_den[:, 0]
-    val_t = wg_t / jnp.maximum(den_t, 1e-30)
+    # leaf VALUES are always the Newton step wg/wh (GammaPass,
+    # GBM.java:1235); `den`/use_hess only selects the split-gain objective
+    val_t = wg_t / jnp.maximum(wh_t, 1e-30)
 
     # ---- categorical: sort bins by mean gradient (optimal-subset order) --
-    ratio = jnp.where(v_den > 1e-30, v_wg / jnp.maximum(v_den, 1e-30),
-                      jnp.inf)                              # empty bins last
-    order = jnp.argsort(ratio, axis=-1)                     # (L, C, B)
-    sc_w = jnp.take_along_axis(v_w, order, -1)
-    sc_wg = jnp.take_along_axis(v_wg, order, -1)
-    sc_den = jnp.take_along_axis(v_den, order, -1)
+    # (statically skipped when the frame has no categorical columns)
+    if any_cat:
+        ratio = jnp.where(v_den > 1e-30, v_wg / jnp.maximum(v_den, 1e-30),
+                          jnp.inf)                          # empty bins last
+        order = jnp.argsort(ratio, axis=-1)                 # (L, C, B)
+        sc_w = jnp.take_along_axis(v_w, order, -1)
+        sc_wg = jnp.take_along_axis(v_wg, order, -1)
+        sc_den = jnp.take_along_axis(v_den, order, -1)
 
     def eval_axis(aw, awg, aden):
         """Prefix-split gains along the (possibly re-ordered) bin axis.
@@ -187,11 +198,13 @@ def find_splits_binned(hist, is_cat, mono, cmask, lo, hi, *, b_val,
         return jnp.maximum(g0, g1), g1 > g0
 
     gn_num, nal_num = eval_axis(v_w, v_wg, v_den)           # natural order
-    gn_cat, nal_cat = eval_axis(sc_w, sc_wg, sc_den)        # sorted order
-
-    catC = is_cat[None, :, None]
-    gain_all = jnp.where(catC, gn_cat, gn_num)              # (L, C, B-1)
-    nal_all = jnp.where(catC, nal_cat, nal_num)
+    if any_cat:
+        gn_cat, nal_cat = eval_axis(sc_w, sc_wg, sc_den)    # sorted order
+        catC = is_cat[None, :, None]
+        gain_all = jnp.where(catC, gn_cat, gn_num)          # (L, C, B-1)
+        nal_all = jnp.where(catC, nal_cat, nal_num)
+    else:
+        gain_all, nal_all = gn_num, nal_num
     gain_all = jnp.where(cmask[:, :, None], gain_all, -jnp.inf)
 
     flat = gain_all.reshape(L, C * (B - 1))
@@ -208,12 +221,15 @@ def find_splits_binned(hist, is_cat, mono, cmask, lo, hi, *, b_val,
         a, bcol[:, None, None], 1)[:, 0]
     bin_ids = jnp.arange(BP)[None, :]                       # (1, BP)
     num_right = bin_ids > bbin[:, None]                     # natural order
-    rank_of_bin = jnp.argsort(takeL(order), axis=-1)        # (L, B)
-    rank_pad = jnp.pad(rank_of_bin, ((0, 0), (0, BP - B)),
-                       constant_values=BP)
-    cat_right = rank_pad > bbin[:, None]
-    leaf_cat = is_cat[bcol]
-    route = jnp.where(leaf_cat[:, None], cat_right, num_right)
+    if any_cat:
+        rank_of_bin = jnp.argsort(takeL(order), axis=-1)    # (L, B)
+        rank_pad = jnp.pad(rank_of_bin, ((0, 0), (0, BP - B)),
+                           constant_values=BP)
+        cat_right = rank_pad > bbin[:, None]
+        leaf_cat = is_cat[bcol]
+        route = jnp.where(leaf_cat[:, None], cat_right, num_right)
+    else:
+        route = num_right
     # NA code: by chosen NA direction
     route = route.at[:, B].set(~bnal)
     route = jnp.where(did[:, None], route, False)           # frozen: stay
@@ -221,27 +237,26 @@ def find_splits_binned(hist, is_cat, mono, cmask, lo, hi, *, b_val,
     # ---- child values (Newton wg/wh) with monotone clamping --------------
     bw = takeL(v_w)
     bg = takeL(v_wg)
-    bd = takeL(v_den)
-    bc = takeL(v_cnt)
-    ncl = jnp.pad(na_cnt[:, 0:1], ((0, 0), (0, 0)))
+    bh = takeL(v_wh)
     goes_left = ~route[:, :B]
-    cnt_l = (bc * goes_left).sum(-1) + jnp.where(bnal, na_cnt[:, 0], 0.0)
-    w_l = (bw * goes_left).sum(-1) + jnp.where(bnal, na_w[:, 0], 0.0)
-    g_l = (bg * goes_left).sum(-1) + jnp.where(bnal, na_wg[:, 0], 0.0)
-    d_l = (bd * goes_left).sum(-1) + jnp.where(bnal, na_den[:, 0], 0.0)
-    val_l = g_l / jnp.maximum(d_l, 1e-30)
+    # NA-bin mass of the CHOSEN column (each column sees different NA rows)
+    takeL1 = lambda a: jnp.take_along_axis(   # noqa: E731  (L,C)->(L,)
+        a, bcol[:, None], 1)[:, 0]
+    w_l = (bw * goes_left).sum(-1) + jnp.where(bnal, takeL1(na_w), 0.0)
+    g_l = (bg * goes_left).sum(-1) + jnp.where(bnal, takeL1(na_wg), 0.0)
+    h_l = (bh * goes_left).sum(-1) + jnp.where(bnal, takeL1(na_wh), 0.0)
+    val_l = g_l / jnp.maximum(h_l, 1e-30)
     g_r = wg_t - g_l
-    d_r = den_t - d_l
-    val_r = g_r / jnp.maximum(d_r, 1e-30)
+    h_r = wh_t - h_l
+    val_r = g_r / jnp.maximum(h_r, 1e-30)
     val_l = jnp.clip(val_l, lo, hi)
     val_r = jnp.clip(val_r, lo, hi)
     val_tc = jnp.clip(val_t, lo, hi)
 
     return dict(did=did, col=bcol, bin=bbin, nal=bnal, route=route,
                 gain=jnp.where(did, jnp.maximum(bgain, 0.0), 0.0),
-                cnt_l=cnt_l, cnt_r=cnt_t - cnt_l,
                 val_l=val_l, val_r=val_r, val_t=val_tc,
-                w_t=w_t, wg_l=g_l, wh_l=d_l, _unused=ncl)
+                w_t=w_t, w_l=w_l, wg_l=g_l, wh_l=h_l)
 
 
 # ===========================================================================
@@ -271,179 +286,124 @@ class BinnedGrower:
 
     # ---- static layout ---------------------------------------------------
     def layout(self, n: int):
-        nblk = -(-n // R) + self.L
-        return nblk, nblk * R
+        """Slots for n data rows + 1 dummy, padded to the kernel block."""
+        n_pad = -(-(n + 1) // R) * R
+        return n_pad
 
-    def _init_partition(self, n: int):
-        nblk, n_pad = self.layout(n)
-        data_blocks = -(-n // R)
-        # leaf 0 owns the data blocks; every other leaf owns one pad block
-        offb0 = np.concatenate([[0], [data_blocks],
-                                data_blocks + np.arange(1, self.L + 1)])
-        perm0 = np.full(n_pad, n, np.int32)
-        perm0[:n] = np.arange(n, dtype=np.int32)
-        return jnp.asarray(perm0), jnp.asarray(offb0[:self.L + 1],
-                                               jnp.int32)
+    def grow(self, codes, stats, F, *, eta, clip_val, key, mtries: int = 0):
+        """Grow ONE tree and apply its margin update — all device-resident.
 
-    # ---- one level (traced inside fori_loop) -----------------------------
-    def _level(self, d, state, codes, stats8, n, mtries_key=None,
-               mtries: int = 0):
-        (perm, offb, hm, froz, lo, hi, colA, binA, nalA, routeA, valA,
-         gains) = state
-        L, D, BP = self.L, self.D, self.spec.n_bins
-        nblk, n_pad = self.layout(n)
-        C = self.spec.c_pad
+        codes: (C_pad, n_pad) i32 bin codes, COLUMN-major (dummy rows
+               carry zero stats)
+        stats: (S_STATS, n_pad) f32 — rows 0=w 1=w*grad 2=w*hess 3=0
+        F:     (n_pad,) f32 margins (updated in the terminal route pass)
 
-        codes_p = codes[perm]                          # (n_pad, C) int32
-        stats_p = stats8[:, perm]                      # (8, n_pad) f32
-        block_leaf = (jnp.searchsorted(offb, jnp.arange(nblk),
-                                       side="right") - 1).astype(jnp.int32)
-        hist = HP.build_hist(codes_p, stats_p, block_leaf,
-                             n_leaves=L, n_bins=BP)
-
-        c_real = int(self.spec.is_cat.size)
-        if mtries and mtries < c_real:
-            # per-(leaf, level) column sampling (DRF per-node semantics)
-            r = jax.random.uniform(jax.random.fold_in(mtries_key, d), (L, C))
-            r = jnp.where(jnp.arange(C) < c_real, r, 2.0)
-            kth = jnp.sort(r, axis=1)[:, mtries - 1:mtries]
-            cmask = r <= kth
-        else:
-            cmask = jnp.broadcast_to(
-                (jnp.arange(C) < c_real)[None], (L, C))
-
-        s = find_splits_binned(
-            hist, self.is_cat_dev, self.mono, cmask, lo, hi,
-            b_val=self.spec.b_val, min_rows=self.min_rows, msi=self.msi,
-            lam=self.lam, use_hess=self.use_hess, l_max=L)
-
-        live = jnp.arange(L) < (1 << d)                # leaves of this level
-        valid_hm = live & (hm < self.nodes)
-        did = s["did"] & valid_hm & ~froz
-
-        # ---- write node arrays at heap ids -------------------------------
-        tgt = jnp.where(valid_hm, hm, self.nodes)      # OOB -> dropped
-        colA = colA.at[tgt].set(jnp.where(did, s["col"], -1), mode="drop")
-        binA = binA.at[tgt].set(jnp.where(did, s["bin"], -1), mode="drop")
-        nalA = nalA.at[tgt].set(s["nal"], mode="drop")
-        routeA = routeA.at[tgt].set(s["route"], mode="drop")
-        valA = valA.at[tgt].set(s["val_t"], mode="drop")
-        kidL = jnp.where(did, 2 * hm + 1, self.nodes)
-        kidR = jnp.where(did, 2 * hm + 2, self.nodes)
-        valA = valA.at[kidL].set(s["val_l"], mode="drop")
-        valA = valA.at[kidR].set(s["val_r"], mode="drop")
-        gains = gains.at[jnp.where(did, s["col"], C)].add(
-            s["gain"], mode="drop")
-
-        # ---- route rows: stable partition --------------------------------
-        leaf_slot = jnp.repeat(block_leaf, R)          # (n_pad,)
-        col_slot = s["col"][leaf_slot]
-        code_s = jnp.take_along_axis(
-            codes_p, col_slot[:, None], axis=1)[:, 0]
-        gr = s["route"].reshape(L * BP)[leaf_slot * BP + code_s]
-        real = perm < n
-        child = 2 * leaf_slot + gr.astype(jnp.int32)
-
-        # child counts straight from the histogram (no row scatter); a
-        # non-split leaf keeps everything in its "left" slot 2l
-        l_ids = jnp.arange(L)
-        idxL = jnp.where(valid_hm, 2 * l_ids, L)       # OOB -> dropped
-        idxR = jnp.where(did, 2 * l_ids + 1, L)
-        cnt_tot = s["cnt_l"] + s["cnt_r"]
-        cnt2 = jnp.zeros(L, jnp.float32) \
-            .at[idxL].add(jnp.where(did, s["cnt_l"], cnt_tot),
-                          mode="drop") \
-            .at[idxR].add(s["cnt_r"], mode="drop")
-        cnt2i = jnp.round(cnt2).astype(jnp.int32)
-
-        blocks2 = jnp.maximum(1, -(-cnt2i // R))
-        offb2 = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                                 jnp.cumsum(blocks2)]).astype(jnp.int32)
-
-        # stable rank within child via segmented exclusive cumsums
-        xl = (real & ~gr).astype(jnp.int32)
-        xr = (real & gr).astype(jnp.int32)
-        exl = jnp.cumsum(xl) - xl
-        exr = jnp.cumsum(xr) - xr
-        offs = offb * R                                # (L+1,) slot offsets
-        basel = exl[jnp.minimum(offs[:-1], n_pad - 1)]
-        baser = exr[jnp.minimum(offs[:-1], n_pad - 1)]
-        rank = jnp.where(gr, exr - baser[leaf_slot], exl - basel[leaf_slot])
-        # frozen/unsplit leaves: everyone is a "left" child of slot 2l
-        pos = offb2[jnp.minimum(child, L)] * R + rank
-        pos = jnp.where(real, pos, n_pad)              # pads dropped
-        perm2 = jnp.full(n_pad, n, jnp.int32).at[pos].set(
-            jnp.where(real, perm, n), mode="drop")
-
-        # ---- heap map / frozen / bounds for next level -------------------
-        l2 = jnp.arange(L)
-        parent = l2 // 2
-        is_r = (l2 % 2) == 1
-        pd = did[parent]
-        pvalid = hm[parent] < self.nodes
-        # split parent: children get real heap ids; unsplit parent: rows
-        # stay at the parent's terminal node via the left slot; right slot
-        # and invalid parents get the OOB sentinel
-        hm2 = jnp.where(pd, 2 * hm[parent] + 1 + is_r.astype(jnp.int32),
-                        jnp.where(is_r, self.nodes, hm[parent]))
-        hm2 = jnp.where(pvalid, hm2, self.nodes)
-        froz2 = ~pd | ~pvalid                         # terminal continuation
-        # monotone bounds: children of a monotone split get a shared midpoint
-        mc = self.mono[s["col"]]                       # (L,) constraint sign
-        mid = 0.5 * (s["val_l"] + s["val_r"])
-        lo2 = jnp.where(pd,
-                        jnp.where(is_r & (mc[parent] > 0), mid[parent],
-                                  jnp.where(~is_r & (mc[parent] < 0),
-                                            mid[parent], lo[parent])),
-                        lo[parent])
-        hi2 = jnp.where(pd,
-                        jnp.where(~is_r & (mc[parent] > 0), mid[parent],
-                                  jnp.where(is_r & (mc[parent] < 0),
-                                            mid[parent], hi[parent])),
-                        hi[parent])
-
-        return (perm2, offb2, hm2, froz2, lo2, hi2, colA, binA, nalA,
-                routeA, valA, gains), block_leaf
-
-    # ---- grow one tree (D fused levels), return node arrays + row preds --
-    def grow(self, codes, stats8, n: int, key, mtries: int = 0):
-        L, D = self.L, self.D
-        nblk, n_pad = self.layout(n)
-        perm0, offb0 = self._init_partition(n)
-        hm0 = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                               jnp.full(L - 1, self.nodes, jnp.int32)])
-        froz0 = jnp.arange(L) != 0
+        Returns dict(col, bin, nal, route, val, cover, gains, F).
+        Per-row state is ONE heap-id int32 array; no row reordering ever
+        happens (measured: TPU gathers are 10x slower than the histogram
+        kernel — see ops/hist_pallas.py header).
+        """
+        spec, D = self.spec, self.D
+        C, n_pad = codes.shape
+        BP = spec.n_bins
         big = jnp.float32(3e38)
-        state = (perm0, offb0, hm0, froz0,
-                 jnp.full(L, -big), jnp.full(L, big),
-                 jnp.full(self.nodes, -1, jnp.int32),
-                 jnp.full(self.nodes, -1, jnp.int32),
-                 jnp.zeros(self.nodes, bool),
-                 jnp.zeros((self.nodes, self.spec.n_bins), bool),
-                 jnp.zeros(self.nodes, jnp.float32),
-                 jnp.zeros(self.spec.c_pad + 1, jnp.float32))
+        nodes_p = -(-(self.nodes + 1) // 128) * 128
+        heap = jnp.zeros(n_pad, jnp.int32)
+        colA = jnp.full(self.nodes, -1, jnp.int32)
+        binA = jnp.full(self.nodes, -1, jnp.int32)
+        nalA = jnp.zeros(self.nodes, bool)
+        routeA = jnp.zeros((self.nodes, BP), bool)
+        valA = jnp.zeros(self.nodes, jnp.float32)
+        coverA = jnp.zeros(self.nodes, jnp.float32)
+        gains = jnp.zeros(C + 1, jnp.float32)
+        c_real = int(spec.is_cat.size)
 
-        def body(d, st):
-            st2, _ = self._level(d, st, codes, stats8, n,
-                                 mtries_key=key, mtries=mtries)
-            return st2
+        lo = jnp.full(1, -big)
+        hi = jnp.full(1, big)
+        any_cat = bool(spec.is_cat.any())
+        zerovt = jnp.zeros((8, 128), jnp.float32)
+        prev = None                    # routing tables of level d-1
+        for d in range(D):
+            L = 1 << d
+            base = L - 1
+            if prev is not None:
+                heap, _ = HP.sbh_route(codes, heap, prev["tbl"],
+                                       prev["route_f"], zerovt,
+                                       F, base=(L >> 1) - 1, L=L >> 1,
+                                       any_cat=any_cat,
+                                       na_code=spec.b_val)
+            hist = HP.sbh_hist(codes, heap, stats, base=base, L=L,
+                               n_bins=BP)[:L, :C]
 
-        state = lax.fori_loop(0, D, body, state)
-        (perm, offb, hm, froz, lo, hi, colA, binA, nalA, routeA, valA,
-         gains) = state
-        # terminal heap id per slot (for the F update / leaf preds)
-        block_leaf = (jnp.searchsorted(offb, jnp.arange(nblk),
-                                       side="right") - 1).astype(jnp.int32)
-        leaf_slot = jnp.repeat(block_leaf, R)
-        heap_slot = hm[jnp.minimum(leaf_slot, L - 1)]
-        heap_slot = jnp.minimum(heap_slot, self.nodes - 1)
-        return dict(col=colA, bin=binA, nal=nalA, route=routeA, val=valA,
-                    gains=gains[:self.spec.c_pad], perm=perm,
-                    heap_slot=heap_slot)
+            if mtries and mtries < c_real:
+                r = jax.random.uniform(jax.random.fold_in(key, d),
+                                       (L, C))
+                r = jnp.where(jnp.arange(C) < c_real, r, 2.0)
+                kth = jnp.sort(r, axis=1)[:, mtries - 1:mtries]
+                cmask = r <= kth
+            else:
+                cmask = jnp.broadcast_to(
+                    (jnp.arange(C) < c_real)[None], (L, C))
+
+            s = find_splits_binned(
+                hist, self.is_cat_dev, self.mono, cmask, lo, hi,
+                b_val=spec.b_val, min_rows=self.min_rows, msi=self.msi,
+                lam=self.lam, use_hess=self.use_hess, any_cat=any_cat)
+
+            did = s["did"]
+            ids = jnp.arange(L)
+            tgt = base + ids
+            colA = colA.at[tgt].set(jnp.where(did, s["col"], -1))
+            binA = binA.at[tgt].set(jnp.where(did, s["bin"], -1))
+            nalA = nalA.at[tgt].set(s["nal"])
+            routeA = routeA.at[tgt].set(s["route"])
+            valA = valA.at[tgt].set(s["val_t"])
+            coverA = coverA.at[tgt].set(s["w_t"])
+            kidL = jnp.where(did, 2 * tgt + 1, self.nodes)
+            kidR = jnp.where(did, 2 * tgt + 2, self.nodes)
+            valA = valA.at[kidL].set(s["val_l"], mode="drop")
+            valA = valA.at[kidR].set(s["val_r"], mode="drop")
+            coverA = coverA.at[kidL].set(s["w_l"], mode="drop")
+            coverA = coverA.at[kidR].set(s["w_t"] - s["w_l"], mode="drop")
+            gains = gains.at[jnp.where(did, s["col"], C)].add(s["gain"])
+
+            # ---- routing tables for the next level -----------------------
+            Lp = max(8, L)
+            tbl = jnp.zeros((8, Lp), jnp.float32)
+            tbl = tbl.at[0, :L].set(s["col"].astype(jnp.float32))
+            tbl = tbl.at[1, :L].set(did.astype(jnp.float32))
+            tbl = tbl.at[2, :L].set(s["bin"].astype(jnp.float32))
+            tbl = tbl.at[3, :L].set(s["nal"].astype(jnp.float32))
+            route_f = jnp.zeros((Lp, BP), jnp.float32)
+            route_f = route_f.at[:L].set(s["route"].astype(jnp.float32))
+            prev = dict(tbl=tbl, route_f=route_f)
+
+            # ---- monotone bounds for children ----------------------------
+            mc = self.mono[s["col"]]
+            mid = 0.5 * (s["val_l"] + s["val_r"])
+            lo_l = jnp.where(mc < 0, jnp.maximum(lo, mid), lo)
+            hi_l = jnp.where(mc > 0, jnp.minimum(hi, mid), hi)
+            lo_r = jnp.where(mc > 0, jnp.maximum(lo, mid), lo)
+            hi_r = jnp.where(mc < 0, jnp.minimum(hi, mid), hi)
+            lo = jnp.stack([jnp.where(did, lo_l, lo),
+                            jnp.where(did, lo_r, lo)], 1).reshape(2 * L)
+            hi = jnp.stack([jnp.where(did, hi_l, hi),
+                            jnp.where(did, hi_r, hi)], 1).reshape(2 * L)
+
+        # terminal pass: route the last level + fused F update
+        L = 1 << D
+        valt = jnp.clip(valA, -clip_val, clip_val) if clip_val else valA
+        valtab = jnp.zeros((8, nodes_p), jnp.float32).at[0, : self.nodes]             .set(valt)
+        heap, F = HP.sbh_route(codes, heap, prev["tbl"], prev["route_f"],
+                               valtab, F, base=(L >> 1) - 1, L=L >> 1,
+                               eta=eta, emit_f=True, any_cat=any_cat,
+                               na_code=spec.b_val)
+        return dict(col=colA, bin=binA, nal=nalA, route=routeA, val=valt,
+                    cover=coverA, gains=gains[:C], F=F, heap=heap)
 
 
 # ===========================================================================
-# Chunked boosting driver: ONE dispatch trains K trees (lax.scan), the host
+# Chunked boosting driver: ONE dispatch trains K trees (lax.scan); the host
 # only sees tree arrays + updated margins between chunks (scoring / early
 # stopping cadence — SharedTree.doScoringAndSaveModel analog).
 def _grad_hess_binned(dist, F, y):
@@ -468,14 +428,20 @@ def _grad_hess_binned(dist, F, y):
     raise NotImplementedError(f"binned engine distribution {dist}")
 
 
-_TRAINER_CACHE: dict = {}
-
-
-def pack_route(route, n_bins):
+def pack_route(route, n_bins, b_val=None):
     """(nodes, BP) bool -> (nodes, BP//32) uint32 bitset (IcedBitSet analog,
-    water/util/IcedBitSet.java)."""
+    water/util/IcedBitSet.java). With b_val given, slots >= b_val-1 replicate
+    slot b_val-1 so float-scoring code clipping of high-cardinality
+    categorical levels routes like training's capped codes (the NA slot is
+    never consulted by the scorer — NaN takes the nal path first)."""
     nodes = route.shape[0]
-    r = route[:, :n_bins].reshape(nodes, n_bins // 32, 32)
+    r = route[:, :n_bins]
+    if b_val is not None and b_val < n_bins:
+        r = jnp.concatenate(
+            [r[:, : b_val - 1],
+             jnp.broadcast_to(r[:, b_val - 1: b_val],
+                              (nodes, n_bins - b_val + 1))], axis=1)
+    r = r.reshape(nodes, n_bins // 32, 32)
     return (r.astype(jnp.uint32) <<
             jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
         -1, dtype=jnp.uint32)
@@ -484,19 +450,27 @@ def pack_route(route, n_bins):
 def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
                       sample_rate: float, mtries: int, k_trees: int,
                       clip_val: float = 19.0):
-    """Build (and cache) the jitted K-tree training program."""
-    key_ = (id(grower.spec), grower.D, grower.min_rows, grower.msi,
-            grower.lam, grower.use_hess, n, dist, eta, sample_rate,
-            mtries, k_trees, clip_val)
-    fn = _TRAINER_CACHE.get(key_)
+    """Build (and cache) the jitted K-tree training program.
+
+    Contract: codes (C_pad, n_pad) i32 from `quantize` (n real rows, the
+    rest dummies); y1/w1/F are (n_pad,) f32 with zeros beyond row n.
+    Returns (new F, stacked tree arrays) per call.
+    """
+    # cache on the grower INSTANCE: a global id()-keyed cache can hand a
+    # recycled id a stale closure over another grower's bin edges
+    cache = getattr(grower, "_trainer_cache", None)
+    if cache is None:
+        cache = grower._trainer_cache = {}
+    key_ = (n, dist, eta, sample_rate, mtries, k_trees, clip_val)
+    fn = cache.get(key_)
     if fn is not None:
         return fn
 
     gaussian = dist == "gaussian"
+    cv = 0.0 if gaussian else clip_val
 
     @jax.jit
     def run(codes, y1, w1, F, key):
-        """codes (n+1, C_pad) int32; y1/w1/F (n+1,) f32 (slot n = dummy)."""
         def per_tree(carry, k):
             F, key = carry
             key, ks, kt = jax.random.split(key, 3)
@@ -506,25 +480,19 @@ def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
                 wt = w1 * (u < sample_rate)
             else:
                 wt = w1
-            stats8 = jnp.zeros((8, n + 1), jnp.float32)
-            stats8 = stats8.at[0, :n].set(1.0)            # partition counts
-            stats8 = stats8.at[1].set(wt)                 # min_rows weight
-            stats8 = stats8.at[2].set(wt * g)             # Newton numerator
-            stats8 = stats8.at[3].set(wt * h)             # Newton denominator
-            out = grower.grow(codes, stats8, n, kt, mtries=mtries)
-            val = out["val"] if gaussian else \
-                jnp.clip(out["val"], -clip_val, clip_val)
-            F = F.at[out["perm"]].add(
-                eta * val[out["heap_slot"]], mode="drop")
-            F = F.at[n].set(0.0)
+            stats = jnp.stack(
+                [wt, wt * g, wt * h, jnp.zeros_like(wt)], axis=0)
+            out = grower.grow(codes, stats, F, eta=eta, clip_val=cv,
+                              key=kt, mtries=mtries)
+            F = out["F"]
             tree = (out["col"], out["bin"], out["nal"],
-                    pack_route(out["route"], grower.spec.n_bins), val,
-                    out["gains"])
+                    pack_route(out["route"], grower.spec.n_bins,
+                               grower.spec.b_val),
+                    out["val"], out["gains"], out["cover"])
             return (F, key), tree
 
-        (F, _), trees = lax.scan(per_tree, (F, key),
-                                 jnp.arange(k_trees))
+        (F, _), trees = lax.scan(per_tree, (F, key), jnp.arange(k_trees))
         return F, trees
 
-    _TRAINER_CACHE[key_] = run
+    cache[key_] = run
     return run

@@ -41,6 +41,16 @@ def _lib():
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double)]
+        lib.treeshap_ensemble_cat.restype = None
+        lib.treeshap_ensemble_cat.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
         _LIB = lib
     return _LIB
 
@@ -59,6 +69,26 @@ def ensemble_shap(trees, X: np.ndarray) -> np.ndarray:
     n, C = X.shape
     T, nodes = col.shape
     phi = np.zeros((n, C + 1), np.float64)
+    has_cat = (trees.catbits is not None and trees.col_is_cat is not None
+               and bool(np.any(np.asarray(trees.col_is_cat))))
+    if has_cat:
+        catb = np.ascontiguousarray(np.asarray(trees.catbits), np.uint32)
+        iscat = np.zeros(C, np.uint8)
+        flags = np.asarray(trees.col_is_cat, bool)
+        iscat[: min(C, flags.size)] = flags[:C]
+        _lib().treeshap_ensemble_cat(
+            T, nodes, trees.depth, C, n,
+            col.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            thr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            nal.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            cov.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            catb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            iscat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            int(catb.shape[-1]),
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            phi.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return phi
     _lib().treeshap_ensemble(
         T, nodes, trees.depth, C, n,
         col.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),

@@ -41,6 +41,7 @@ class SharedTreeEstimator(ModelBase):
         "stopping_metric": "AUTO", "stopping_tolerance": 1e-3,
         "build_tree_one_node": False, "histogram_type": "AUTO",
         "calibrate_model": False, "balance_classes": False,
+        "monotone_constraints": None,
     }
 
     def _cat_mode(self):
@@ -144,6 +145,8 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             from h2o3_tpu.udf import resolve_udf
             self._udf_dist = resolve_udf(
                 self.params.get("custom_distribution_func"))
+        if self._binned_ok(dist):
+            return self._fit_binned(frame, job, dist)
         X, y, w = self._prep(frame)
         if dist == "multinomial":
             return self._fit_multinomial(X, y, w, job)
@@ -224,6 +227,120 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         self._output.model_summary = {
             "number_of_trees": self._trees.ntrees, "max_depth": grower.D,
             "distribution": dist, "learn_rate": lr, "init_f": f0,
+        }
+
+    # ---- binned fast path (GlobalQuantilesCalc / tree_method=hist) -------
+    def _binned_ok(self, dist) -> bool:
+        """Default engine: globally pre-binned codes + the Pallas histogram
+        kernel (SURVEY §2.4 row 1). `histogram_type="UniformAdaptive"`
+        selects the H2O-exact per-level adaptive engine instead."""
+        ht = str(self.params.get("histogram_type") or "AUTO").lower()
+        if ht not in ("auto", "quantilesglobal", "binned"):
+            return False
+        if dist not in ("gaussian", "bernoulli", "quasibinomial", "poisson",
+                        "gamma", "tweedie", "laplace"):
+            return False
+        if self.params.get("checkpoint"):
+            return False      # checkpoint restart lives on the adaptive path
+        if float(self.params.get("col_sample_rate_per_tree") or 1.0) < 1.0:
+            return False      # per-tree column sampling: adaptive path only
+        return True
+
+    def _fit_binned(self, frame: Frame, job, dist):
+        from h2o3_tpu.models.tree import binned as BN
+        p = self.params
+        di = self._dinfo
+        X, y, w = self._prep(frame)
+        n = int(frame.nrows)
+        X, y, w = X[:n], y[:n], w[:n]
+        C = X.shape[1]
+        is_cat = np.array([c in di.cat_cols for c in di.predictors], bool)
+        cards = [di.cardinalities[c] for c in di.cat_cols]
+        nbins = int(p["nbins"])
+        nbins_cats = int(p.get("nbins_cats") or 1024)
+        b_val = max(nbins, min(nbins_cats, max(cards, default=0)))
+        b_val = int(min(255, max(b_val, 4)))
+        # bin edges come from a row sample: STRIDED device slice (a head
+        # slice would bias quantiles on ordered data), tiny readback
+        stride = max(1, n >> 18)
+        Xs = np.asarray(X[::stride][: 1 << 18])
+        spec = BN.make_bins(Xs, is_cat, b_val)
+        codes = BN.quantize(X, spec)
+
+        mono = np.zeros(spec.c_pad, np.int32)
+        mc = p.get("monotone_constraints") or {}
+        for cname, v in mc.items():
+            if cname in di.predictors:
+                mono[di.predictors.index(cname)] = int(np.sign(v))
+        grower = BN.BinnedGrower(
+            spec, max_depth=int(p["max_depth"]),
+            min_rows=float(p["min_rows"]),
+            min_split_improvement=float(p["min_split_improvement"]),
+            monotone=mono if mc else None)
+
+        ntrees = int(p["ntrees"])
+        lr = float(p["learn_rate"])
+        seed = int(p.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 42)
+        wsum = float(np.asarray(jnp.sum(w)))
+        ybar = float(np.asarray(jnp.sum(w * y))) / max(wsum, 1e-30)
+        if dist == "bernoulli":
+            p0 = min(max(ybar, 1e-10), 1 - 1e-10)
+            f0 = math.log(p0 / (1 - p0))
+        elif dist in ("poisson", "gamma", "tweedie"):
+            f0 = math.log(max(ybar, 1e-10))
+        else:
+            f0 = ybar
+        self._f0 = f0
+
+        n_pad = grower.layout(n)
+        y1 = BN.pad_rows(y, n_pad)
+        w1 = BN.pad_rows(w, n_pad)
+        F = jnp.where(jnp.arange(n_pad) < n, f0, 0.0).astype(jnp.float32)
+        interval = max(1, int(p.get("score_tree_interval") or 5))
+        mtries = self._per_level_mtries(C)
+        sample_rate = float(p["sample_rate"])
+        chunks = []
+        done = 0
+        while done < ntrees:
+            k = min(interval, ntrees - done)
+            trainer = BN.gbm_chunk_trainer(
+                grower, n, dist=dist, eta=lr, sample_rate=sample_rate,
+                mtries=mtries, k_trees=k)
+            key, kc = jax.random.split(key)
+            F, trees = trainer(codes, y1, w1, F, kc)
+            chunks.append(trees)
+            done += k
+            self._record_history(done, F[:n], y, w, dist)
+            job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
+            if self._should_stop():
+                break
+
+        colT = jnp.concatenate([c[0] for c in chunks])     # (T, nodes)
+        binT = jnp.concatenate([c[1] for c in chunks])
+        nalT = jnp.concatenate([c[2] for c in chunks])
+        wordsT = jnp.concatenate([c[3] for c in chunks])
+        valT = jnp.concatenate([c[4] for c in chunks])
+        gainsT = jnp.concatenate([c[5] for c in chunks]).sum(0)
+        coverT = jnp.concatenate([c[6] for c in chunks])
+        # float thresholds: upper edge of the left side (x <= thr goes left)
+        edges_j = jnp.asarray(spec.edges)                  # (C, b_val-1)
+        safe_col = jnp.clip(colT, 0, C - 1)
+        safe_bin = jnp.clip(binT, 0, spec.edges.shape[1] - 1)
+        thrT = edges_j[safe_col, safe_bin]
+        any_cat = bool(is_cat.any())
+        self._trees = E.TreeArrays(
+            col=colT, thr=thrT, na_left=nalT, value=valT,
+            depth=grower.D, cover=coverT,
+            catbits=wordsT if any_cat else None,
+            col_is_cat=(np.pad(is_cat, (0, spec.c_pad - C))
+                        if any_cat else None))
+        self._varimp_from_gains(np.asarray(gainsT[:C], np.float64))
+        self._output.model_summary = {
+            "number_of_trees": int(self._trees.ntrees),
+            "max_depth": grower.D, "distribution": dist, "learn_rate": lr,
+            "init_f": f0, "engine": "binned_pallas",
+            "nbins_effective": b_val,
         }
 
     def _fit_multinomial(self, X, y, w, job):

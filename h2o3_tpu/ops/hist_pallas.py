@@ -1,28 +1,43 @@
-"""Pallas TPU kernel: leaf-partitioned histogram accumulation — the rebuild
-of H2O's ScoreBuildHistogram2 hot loop (SURVEY §2.4 row 1).
+"""Pallas TPU kernels for the binned tree engine — the rebuild of H2O's
+ScoreBuildHistogram2 hot loop (SURVEY §2.4 row 1).
 
-Reference semantics: hex/tree/ScoreBuildHistogram2.java:20-60 accumulates
-per-(leaf, column) histograms of {w, wY, wYY} over binned rows, with private
-per-thread copies merged in reduce (DHistogram.java:59-70, :338). The
-reference avoids CAS by giving each (column, row-range) task a private copy.
+Reference semantics: hex/tree/ScoreBuildHistogram2.java:20-60 — ONE fused
+pass per level that (phase 1) routes each row to its current leaf by applying
+the previous level's split decisions and (phase 2) accumulates per-
+(leaf, column) histograms of {w, wY, wYY} over binned rows
+(DHistogram.java:59-70, :338). The reference avoids CAS by giving each
+(column, row-range) task a private histogram copy merged in reduce.
 
-TPU-native design: rows are kept PARTITIONED by leaf (leaf-aligned blocks of
-R rows, maintained by the grower's stable-partition step), so a histogram is
-a sequence of per-block accumulations that all land in the SAME output tile
-while consecutive grid steps visit the same leaf — Pallas keeps the output
-block resident in VMEM across those steps (the grouped-matmul revisiting
-pattern) and flushes once per (leaf, column-tile). The per-block compute is
-a one-hot expansion of the bin codes (VPU compare against a broadcasted
-iota) contracted with the per-row stats panel on the MXU:
+TPU-native design (measured on v5e): random gathers/scatters run at only
+~50-100M elem/s on TPU, so the engine NEVER physically reorders rows
+(an explicit leaf-partition + gather design measured ~10x slower than the
+kernels it fed). Rows stay in original order; per-row state is ONE int32
+`heap` (node id in the 2^(D+1)-1 heap; a row whose node did not split keeps
+its heap id and freezes). Codes are stored COLUMN-major (C_pad, n_pad) —
+the natural layout for both kernels (rows ride the 128-wide lane dimension)
+and the only one whose column blocks satisfy Mosaic's lane-tiling rules.
 
-    hist[s, b] += stats[s, r] @ onehot[r, b]      (8, R) x (R, B) -> (8, B)
+Two kernels per level:
 
-There is no CAS, no private copies, and no reduce tree: cross-shard merging
-is a single psum over the mesh row axis done by the caller.
+  * sbh_route — phase 1. Applies the previous level's splits: the per-leaf
+    split metadata lives in small VMEM tables and every per-row lookup is a
+    one-hot matmul / compare-select (there is no vector gather on TPU).
+    The full (numeric threshold / categorical SET / NA direction) decision
+    is precompiled by the split search into a per-leaf
+    `route[leaf, code] -> goes-right` table, so the kernel is decision-
+    agnostic. Optionally fuses the margin update F += eta*val[heap] (the
+    terminal-pass variant) — ComputePredAndRes's gather folded into the
+    same stream.
 
-Stats panel rows (sublane dim, padded to 8): 0=row count, 1=weight w,
-2=w*grad, 3=w*hess — count feeds the partition bookkeeping, w feeds
-min_rows, (wg, wh) feed split gain and Newton leaf values
+  * sbh_hist — phase 2. Grid (pass, col-block, row-tile); output block
+    (CB cols, nb bins, GW*S lanes) stays VMEM-resident across the whole
+    row sweep (the grouped-matmul revisiting pattern) and accumulates
+    onehot(codes) @ A where A packs (leaf-slot x {w,wg,wh}) into exactly
+    GW*S_STATS = 128 MXU lanes. No CAS, no private copies, no reduce tree:
+    cross-shard merging is one psum over the mesh row axis by the caller.
+
+Stats panel rows (S_STATS=4): 0=w, 1=w*grad, 2=w*hess, 3=spare(0) —
+(w, wg, wh) feed split gain, min_rows and Newton leaf values
 (hex/tree/DHistogram.java _vals packing analog).
 """
 
@@ -41,106 +56,270 @@ try:  # Pallas import is deferred-safe: exotic envs may lack Mosaic
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
-# Rows per partition block == rows per kernel grid step. Must divide n_pad.
-BLOCK_ROWS = 1024
-# Stats panel sublane count (f32 tile granule).
-N_STATS = 8
-# Column tile per grid step.
+# Rows per kernel grid step. n_pad must be a multiple of this.
+BLOCK_ROWS = 4096
+# Stats panel sublane count; GW * S_STATS = 128 lanes exactly.
+S_STATS = 4
+# Leaf-window width per histogram pass (M = GW*S_STATS lanes, max 512).
+GW = 128
+# Column tile per histogram grid step.
 COL_TILE = 8
 
 
-def _hist_kernel(bl_ref, codes_ref, stats_ref, out_ref, *, n_cols, n_bins):
-    """One grid step: accumulate one (leaf, column-tile) partial histogram.
+def use_pallas() -> bool:
+    return _HAVE_PALLAS and jax.default_backend() == "tpu"
 
-    codes_ref: (BLOCK_ROWS, COL_PAD) int32 — bin codes for this row block
-    stats_ref: (N_STATS, BLOCK_ROWS) f32 — stats panel (already permuted)
-    out_ref:   (1, COL_TILE, N_STATS, n_bins) f32 — hist[leaf, ct] tile
-    bl_ref:    scalar-prefetch (NBLK,) int32 — block -> leaf id
+
+# ===========================================================================
+# Phase 1: route rows by the previous level's splits
+def _route_kernel(codesT_ref, heap_ref, tbl_ref, route_ref, valtab_ref,
+                  f_ref, heap_out_ref, f_out_ref, *, base, L, n_cols,
+                  n_bins, eta, emit_f, any_cat, na_code):
+    """One row tile: apply splits of the level whose leaves sit at heap ids
+    [base, base+L); optionally add eta*val[newheap] into F.
+
+    codesT_ref: (C_pad, R) i32    heap_ref/heap_out_ref: (1, R) i32
+    tbl_ref:    (8, Lp) f32 — row 0 = split col, row 1 = did (0/1)
+    route_ref:  (Lp, n_bins) f32 — 1.0 = code goes right
+    valtab_ref: (8, NODES_P) f32 — row 0 = leaf value table (terminal pass)
+    f_ref/f_out_ref: (1, R) f32 margins
     """
-    j = pl.program_id(1)
-    first = jnp.logical_or(j == 0, bl_ref[j] != bl_ref[jnp.maximum(j - 1, 0)])
+    R = BLOCK_ROWS
+    heap = heap_ref[0, :]                                     # (R,)
+    leaf = heap - base
+    active = (leaf >= 0) & (leaf < L)
+    leaf_c = jnp.where(active, leaf, 0)
+    # one-hot over the level's leaves — per-row table lookups are matmuls
+    Lp = tbl_ref.shape[1]
+    iota_l = lax.broadcasted_iota(jnp.int32, (R, Lp), 1)
+    active_f = active.astype(jnp.float32)
+    ohl_f = ((iota_l == leaf_c[:, None]).astype(jnp.float32)
+             * active_f[:, None])                             # (R, Lp) f32
+    ohl = ohl_f.astype(jnp.bfloat16)
+    # props lookup stays f32: bf16 cannot represent col ids > 256 or split
+    # bins > 256 exactly, which would silently misroute wide frames
+    props = lax.dot_general(ohl_f, tbl_ref[...],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (R, 8)
+    col_r = props[:, 0]
+    did_r = props[:, 1] > 0.5
+    # code of the split column: compare-select over the column sublanes
+    codes_f = codesT_ref[...].astype(jnp.float32)             # (C, R)
+    iota_c = lax.broadcasted_iota(jnp.int32, (n_cols, R), 0) \
+        .astype(jnp.float32)
+    csel = (iota_c == col_r[None, :]).astype(jnp.float32)     # (C, R)
+    code_sel = jnp.sum(codes_f * csel, axis=0)                # (R,)
+    if any_cat:
+        # goes-right bit via the full route table: route[leaf, code]
+        rowroute = lax.dot_general(
+            ohl, route_ref[...].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (R, BP)
+        iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1) \
+            .astype(jnp.float32)
+        bsel = (iota_b == code_sel[:, None]).astype(jnp.float32)
+        go = jnp.sum(rowroute * bsel, axis=1) > 0.5           # (R,)
+    else:
+        # numeric-only fast path: threshold compare + NA direction from the
+        # props table (rows 2 = split bin, 3 = na-goes-left). All-f32
+        # arithmetic — Mosaic rejects mixed i1 selects here.
+        bin_r = props[:, 2]
+        nal_f = props[:, 3]
+        isna_f = (code_sel == jnp.float32(na_code)).astype(jnp.float32)
+        gt_f = (code_sel > bin_r).astype(jnp.float32)
+        go = (isna_f * (1.0 - nal_f) + (1.0 - isna_f) * gt_f) > 0.5
+    splits = active & did_r
+    newheap = jnp.where(splits, 2 * heap + 1 + go.astype(jnp.int32), heap)
+    heap_out_ref[0, :] = newheap
+    if emit_f:
+        nodes_p = valtab_ref.shape[1]
+        iota_n = lax.broadcasted_iota(jnp.int32, (R, nodes_p), 1)
+        # f32 one-hot x f32 table: leaf values must reach F at full
+        # precision (scoring reads the same values as f32)
+        ohn = (iota_n == newheap[:, None]).astype(jnp.float32)
+        val_r = lax.dot_general(
+            ohn, valtab_ref[...],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)[:, 0]
+        f_out_ref[0, :] = f_ref[0, :] + eta * val_r
+    else:
+        f_out_ref[0, :] = f_ref[0, :]
 
-    stats = stats_ref[...]                                    # (8, R)
-    iota = lax.broadcasted_iota(jnp.int32, (BLOCK_ROWS, n_bins), 1)
 
+@functools.partial(jax.jit,
+                   static_argnames=("base", "L", "eta", "emit_f",
+                                    "any_cat", "na_code"))
+def sbh_route_pallas(codesT, heap, tbl, route_f, valtab, F, *, base, L,
+                     eta=0.0, emit_f=False, any_cat=True, na_code=255):
+    """codesT (C_pad, n_pad) i32; heap (n_pad,) i32; tbl (8, Lp) f32;
+    route_f (Lp, n_bins) f32; valtab (8, NODES_P) f32; F (n_pad,) f32.
+    Returns (newheap, newF)."""
+    c_pad, n_pad = codesT.shape
+    nblk = n_pad // BLOCK_ROWS
+    n_bins = route_f.shape[1]
+    kernel = functools.partial(_route_kernel, base=base, L=L, n_cols=c_pad,
+                               n_bins=n_bins, eta=eta, emit_f=emit_f,
+                               any_cat=any_cat, na_code=na_code)
+    newheap, newF = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((c_pad, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec(tbl.shape, lambda j: (0, 0)),
+            pl.BlockSpec(route_f.shape, lambda j: (0, 0)),
+            pl.BlockSpec(valtab.shape, lambda j: (0, 0)),
+            pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(codesT, heap.reshape(1, n_pad), tbl, route_f, valtab,
+      F.reshape(1, n_pad))
+    return newheap[0], newF[0]
+
+
+def sbh_route_xla(codesT, heap, tbl, route_f, valtab, F, *, base, L,
+                  eta=0.0, emit_f=False, any_cat=True, na_code=255):
+    """Pure-XLA fallback: same contract (CPU scatter/gather is fast)."""
+    leaf = heap - base
+    active = (leaf >= 0) & (leaf < L)
+    leaf_c = jnp.where(active, leaf, 0)
+    col_r = tbl[0, leaf_c].astype(jnp.int32)
+    did_r = (tbl[1, leaf_c] > 0.5) & active
+    code_sel = jnp.take_along_axis(
+        codesT, jnp.clip(col_r, 0, codesT.shape[0] - 1)[None, :],
+        axis=0)[0]
+    n_bins = route_f.shape[1]
+    go = route_f.reshape(-1)[leaf_c * n_bins + code_sel] > 0.5
+    splits = active & did_r
+    newheap = jnp.where(splits, 2 * heap + 1 + go.astype(jnp.int32), heap)
+    newF = F + eta * valtab[0, newheap] if emit_f else F
+    return newheap, newF
+
+
+def sbh_route(codesT, heap, tbl, route_f, valtab, F, *, base, L,
+              eta=0.0, emit_f=False, any_cat=True, na_code=255):
+    if use_pallas():
+        return sbh_route_pallas(codesT, heap, tbl, route_f, valtab, F,
+                                base=base, L=L, eta=eta, emit_f=emit_f,
+                                any_cat=any_cat, na_code=na_code)
+    return sbh_route_xla(codesT, heap, tbl, route_f, valtab, F,
+                         base=base, L=L, eta=eta, emit_f=emit_f,
+                         any_cat=any_cat, na_code=na_code)
+
+
+# ===========================================================================
+# Phase 2: leaf-window histogram accumulation
+def _hist_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
+                 n_bins, gwe, r_blk):
+    """Grid (pass, col-block, row-tile): accumulate the (CB, gwe*S, nb)
+    window block over the row sweep; gwe = min(L, GW) leaves per pass.
+
+    codesT_ref: (COL_TILE, R) i32 — this col-block's codes
+    heap_ref:   (1, R) i32        stats_ref: (S_STATS, R) f32
+    out_ref:    (1, COL_TILE, gwe*S_STATS, n_bins) f32
+    """
+    R = r_blk
+    p = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    heap = heap_ref[0, :]                                  # (R,) lanes
+    slot = heap - (base + p * gwe)
+    inw = (slot >= 0) & (slot < gwe) & (heap - base < L)
+    slot_c = jnp.where(inw, slot, 0)
+    # A ((gwe*S), R): row (slot, s); rows of the tile ride the lanes — the
+    # measured-fast dot orientation is (M, R) @ (R, nb)
+    iota_s = lax.broadcasted_iota(jnp.int32, (gwe, R), 0)
+    inw_f = inw.astype(jnp.float32)
+    ohs = ((iota_s == slot_c[None, :]).astype(jnp.float32)
+           * inw_f[None, :])                               # (gwe, R)
+    stats = stats_ref[...]                                 # (S, R) f32
+    A = (ohs[:, None, :] * stats[None, :, :]) \
+        .reshape(gwe * S_STATS, R).astype(jnp.bfloat16)    # (M, R)
+
+    acc = out_ref[...]
+    iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1)
     parts = []
     for c in range(COL_TILE):
-        code_c = codes_ref[:, c][:, None]                     # (R, 1)
-        oh = (iota == code_c).astype(jnp.float32)             # (R, B)
-        h = lax.dot_general(stats, oh, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        parts.append(h)                                       # (8, B)
-    h_tile = jnp.stack(parts)[None]                           # (1, CT, 8, B)
-
-    @pl.when(first)
-    def _init():
-        out_ref[...] = h_tile
-
-    @pl.when(jnp.logical_not(first))
-    def _acc():
-        out_ref[...] = out_ref[...] + h_tile
+        code_c = codesT_ref[c, :]                          # (R,) static c
+        oh = (iota_b == code_c[:, None]).astype(jnp.bfloat16)   # (R, nb)
+        h = lax.dot_general(A, oh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (M, nb)
+        parts.append(h)
+    out_ref[...] = acc + jnp.stack(parts)[None]            # (1, CB, M, nb)
 
 
-@functools.partial(jax.jit, static_argnames=("n_leaves", "n_bins"))
-def hist_pallas(codes_p, stats_p, block_leaf, *, n_leaves, n_bins):
-    """hist (n_leaves, C_pad, N_STATS, n_bins) f32 from partitioned codes.
-
-    codes_p: (n_pad, C_pad) int32, rows grouped by leaf in BLOCK_ROWS-aligned
-             segments (pad rows carry zero stats); C_pad multiple of COL_TILE
-    stats_p: (N_STATS, n_pad) f32 stats panel in the same row order
-    block_leaf: (n_pad // BLOCK_ROWS,) int32 — leaf owning each block,
-             non-decreasing
-    """
-    n_pad, c_pad = codes_p.shape
-    nblk = n_pad // BLOCK_ROWS
-    n_ct = c_pad // COL_TILE
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_ct, nblk),
-        in_specs=[
-            pl.BlockSpec((BLOCK_ROWS, COL_TILE),
-                         lambda ct, j, bl: (j, ct)),
-            pl.BlockSpec((N_STATS, BLOCK_ROWS),
-                         lambda ct, j, bl: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, COL_TILE, N_STATS, n_bins),
-                               lambda ct, j, bl: (bl[j], ct, 0, 0)),
-    )
-    kernel = functools.partial(_hist_kernel, n_cols=c_pad, n_bins=n_bins)
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins"))
+def sbh_hist_pallas(codesT, heap, stats, *, base, L, n_bins):
+    """codesT (C_pad, n_pad) i32; heap (n_pad,) i32; stats (S, n_pad) f32.
+    Returns (L_pad, C_pad, S_STATS, n_bins) f32 with L_pad = npass*GW:
+    hist[l] = per-(col, stat, bin) sums over rows with heap == base + l."""
+    c_pad, n_pad = codesT.shape
+    gwe = min(L, GW)
+    npass = max(1, -(-L // gwe))
+    ncb = c_pad // COL_TILE
+    # VMEM budget: A (M, R) bf16 + oh (R, nb) bf16 + out (CB, M, nb) f32
+    # hit the 16MB limit at M=512, so deep levels run narrower row tiles
+    r_blk = BLOCK_ROWS if gwe * S_STATS <= 256 else BLOCK_ROWS // 2
+    nblk = n_pad // r_blk
+    kernel = functools.partial(_hist_kernel, base=base, L=L, n_bins=n_bins,
+                               gwe=gwe, r_blk=r_blk)
     out = pl.pallas_call(
         kernel,
-        grid_spec=grid_spec,
+        grid=(npass, ncb, nblk),
+        in_specs=[
+            pl.BlockSpec((COL_TILE, r_blk), lambda p, g, j: (g, j)),
+            pl.BlockSpec((1, r_blk), lambda p, g, j: (0, j)),
+            pl.BlockSpec((S_STATS, r_blk), lambda p, g, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, COL_TILE, gwe * S_STATS, n_bins),
+            lambda p, g, j: (p * ncb + g, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(
-            (n_leaves, c_pad, N_STATS, n_bins), jnp.float32),
+            (npass * ncb, COL_TILE, gwe * S_STATS, n_bins), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
-    )(block_leaf, codes_p, stats_p)
-    return out
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+    )(codesT, heap.reshape(1, n_pad), stats)
+    # (npass*ncb, CB, gwe*S, nb) -> (L_pad, C_pad, S, nb)
+    out = out.reshape(npass, ncb, COL_TILE, gwe, S_STATS, n_bins)
+    return out.transpose(0, 3, 1, 2, 4, 5).reshape(
+        npass * gwe, c_pad, S_STATS, n_bins)
 
 
-@functools.partial(jax.jit, static_argnames=("n_leaves", "n_bins"))
-def hist_segsum(codes_p, stats_p, block_leaf, *, n_leaves, n_bins):
-    """Reference/CPU fallback: same contract via segment-sum (scatter-add is
-    fast on CPU, where the virtual-mesh tests run)."""
-    n_pad, c_pad = codes_p.shape
-    leaf_of_slot = jnp.repeat(block_leaf, BLOCK_ROWS)          # (n_pad,)
-    base = leaf_of_slot * n_bins
+def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins):
+    """Pure-XLA fallback via segment-sum (CPU tests / non-TPU backends)."""
+    c_pad, n_pad = codesT.shape
+    gwe = min(L, GW)
+    npass = max(1, -(-L // gwe))
+    L_pad = npass * gwe
+    leaf = heap - base
+    ok = (leaf >= 0) & (leaf < L)
+    lf = jnp.where(ok, leaf, L_pad)
 
     def one_col(c):
-        idx = base + codes_p[:, c]
-        return jax.ops.segment_sum(stats_p.T, idx,
-                                   num_segments=n_leaves * n_bins)
+        idx = lf * n_bins + codesT[c]
+        return jax.ops.segment_sum(stats.T, idx,
+                                   num_segments=(L_pad + 1) * n_bins)
 
-    hs = lax.map(one_col, jnp.arange(c_pad))       # (C, L*B, 8)
-    return hs.reshape(c_pad, n_leaves, n_bins, N_STATS) \
+    hs = lax.map(one_col, jnp.arange(c_pad))       # (C, (L+1)*B, S)
+    return hs.reshape(c_pad, L_pad + 1, n_bins, S_STATS)[:, :L_pad] \
              .transpose(1, 0, 3, 2)
 
 
-def build_hist(codes_p, stats_p, block_leaf, *, n_leaves, n_bins):
-    """Dispatch: Pallas on TPU, segment-sum elsewhere."""
-    if _HAVE_PALLAS and jax.default_backend() == "tpu":
-        return hist_pallas(codes_p, stats_p, block_leaf,
-                           n_leaves=n_leaves, n_bins=n_bins)
-    return hist_segsum(codes_p, stats_p, block_leaf,
-                       n_leaves=n_leaves, n_bins=n_bins)
+def sbh_hist(codesT, heap, stats, *, base, L, n_bins):
+    if use_pallas():
+        return sbh_hist_pallas(codesT, heap, stats, base=base, L=L,
+                               n_bins=n_bins)
+    return sbh_hist_xla(codesT, heap, stats, base=base, L=L, n_bins=n_bins)

@@ -117,6 +117,14 @@ class _Parser:
             return 0.0
         if tok in ("NA", "NaN", "nan"):
             return float("nan")
+        if tok.startswith("#"):          # classic grammar number prefix
+            try:
+                return float(tok[1:])
+            except ValueError:
+                pass
+        if tok.startswith("%") and len(tok) > 1 and \
+                re.fullmatch(r"[A-Za-z_][A-Za-z0-9_.\-]*", tok[1:]):
+            return tok[1:]       # classic %id prefix ('%/%' stays an op)
         try:
             return float(tok)
         except ValueError:
@@ -1082,3 +1090,7 @@ def _apply(a, e):
                     else float(_col_np(r)[0]))
         DKV.remove(rowf.key)
     return _new_frame(["apply"], [np.asarray(outs)])
+
+
+# ---- tranche 2 of the primitive table (prims_ext registers into PRIMS) ----
+from h2o3_tpu.rapids import prims_ext  # noqa: E402,F401  (registration import)

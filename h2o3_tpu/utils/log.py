@@ -44,3 +44,28 @@ def err(msg, *a):
 
 def debug(msg, *a):
     get_logger().debug(msg, *a)
+
+
+# ---- in-memory ring of recent records (GET /3/Logs analog) ---------------
+from collections import deque as _deque
+
+_RING: "_deque[str]" = _deque(maxlen=2000)
+
+
+class _RingHandler(logging.Handler):
+    def emit(self, record):
+        try:
+            _RING.append(self.format(record))
+        except Exception:
+            pass
+
+
+_rh = _RingHandler()
+_rh.setFormatter(logging.Formatter(
+    "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+get_logger().addHandler(_rh)
+
+
+def recent(n: int = 200) -> list:
+    """Last n log lines (water/util/GetLogsFromNode analog)."""
+    return list(_RING)[-n:]

@@ -83,6 +83,9 @@ struct Tree {
   const uint8_t* nal;
   const float* val;
   const float* cover;
+  const uint32_t* catbits;   // (nodes x cat_words) go-RIGHT bitsets, or null
+  const uint8_t* col_is_cat; // (ncols,) flags, or null
+  int cat_words;
   int nodes;
 };
 
@@ -104,7 +107,20 @@ void tree_shap_recurse(const Tree& t, const double* x, double* phi,
   }
   double xv = x[c];
   bool isna = xv != xv;
-  bool right = isna ? !t.nal[node] : xv > t.thr[node];
+  bool right;
+  if (isna) {
+    right = !t.nal[node];
+  } else if (t.col_is_cat && t.col_is_cat[c] && t.catbits) {
+    // categorical SET split (water/util/IcedBitSet.java): bit set -> right
+    int code = (int)xv;
+    int maxb = t.cat_words * 32;
+    if (code < 0) code = 0;
+    if (code >= maxb) code = maxb - 1;
+    right = (t.catbits[(int64_t)node * t.cat_words + (code >> 5)]
+             >> (code & 31)) & 1u;
+  } else {
+    right = xv > t.thr[node];
+  }
   int hot = right ? 2 * node + 2 : 2 * node + 1;
   int cold = right ? 2 * node + 1 : 2 * node + 2;
   double rnode = t.cover[node];
@@ -134,17 +150,41 @@ void tree_shap_recurse(const Tree& t, const double* x, double* phi,
 
 extern "C" {
 
+void treeshap_ensemble_cat(int ntrees, int nodes, int max_depth, int ncols,
+                           int64_t nrows, const int32_t* col,
+                           const float* thr, const uint8_t* nal,
+                           const float* val, const float* cover,
+                           const uint32_t* catbits,
+                           const uint8_t* col_is_cat, int cat_words,
+                           const double* X, double* phi);
+
 // phi must be zero-initialized (nrows × (ncols+1)), doubles.
 // Bias column gets Σ_t E[tree_t] = Σ_t Σ_leaf cover·val / cover_root.
 void treeshap_ensemble(int ntrees, int nodes, int max_depth, int ncols,
                        int64_t nrows, const int32_t* col, const float* thr,
                        const uint8_t* nal, const float* val,
                        const float* cover, const double* X, double* phi) {
+  treeshap_ensemble_cat(ntrees, nodes, max_depth, ncols, nrows, col, thr,
+                        nal, val, cover, nullptr, nullptr, 0, X, phi);
+}
+
+// Categorical-aware variant: catbits (ntrees x nodes x cat_words) uint32
+// go-RIGHT masks for SET-split nodes; col_is_cat (ncols,) u8 flags.
+// Pass nulls/0 for numeric-only ensembles.
+void treeshap_ensemble_cat(int ntrees, int nodes, int max_depth, int ncols,
+                           int64_t nrows, const int32_t* col,
+                           const float* thr, const uint8_t* nal,
+                           const float* val, const float* cover,
+                           const uint32_t* catbits,
+                           const uint8_t* col_is_cat, int cat_words,
+                           const double* X, double* phi) {
   (void)max_depth;
   for (int t = 0; t < ntrees; ++t) {
     Tree tr{col + (int64_t)t * nodes, thr + (int64_t)t * nodes,
             nal + (int64_t)t * nodes, val + (int64_t)t * nodes,
-            cover + (int64_t)t * nodes, nodes};
+            cover + (int64_t)t * nodes,
+            catbits ? catbits + (int64_t)t * nodes * cat_words : nullptr,
+            col_is_cat, cat_words, nodes};
     // expected value of this tree under the training distribution
     double ev = 0.0;
     {

@@ -102,3 +102,42 @@ def test_jobs_and_models_listing(server):
 def test_builders_listing(server):
     b = _get(server, "/3/ModelBuilders")
     assert "gbm" in b["model_builders"] and "glm" in b["model_builders"]
+
+
+def test_model_metrics_and_new_routes(server):
+    """Tranche-2 routes: /3/ModelMetrics, /99/Grids, /3/Logs, /3/Timeline,
+    /3/Metadata/endpoints (SchemaServer analog) + metrics in Predictions."""
+    rng = np.random.default_rng(0)
+    n = 400
+    fr = Frame.from_dict({
+        "x0": rng.normal(0, 1, n),
+        "x1": rng.normal(0, 1, n),
+        "y": (rng.random(n) < 0.5).astype(np.float64),
+    }, key="mm_fr")
+    try:
+        r = _post(server, "/3/ModelBuilders/gbm",
+                  training_frame="mm_fr", response_column="y",
+                  ntrees=3, max_depth=3, model_id="mm_gbm",
+                  distribution="gaussian")
+        _wait_job(server, r["job"]["key"])
+        # metrics computed in the scoring pass (Model.java BigScore)
+        p = _post(server, "/3/Predictions/models/mm_gbm/frames/mm_fr")
+        assert p["model_metrics"], p
+        assert "RMSE" in p["model_metrics"][0]
+        mm = _get(server, "/3/ModelMetrics/models/mm_gbm")
+        assert mm["model_metrics"]
+        mm2 = _post(server, "/3/ModelMetrics/models/mm_gbm/frames/mm_fr")
+        assert mm2["model_metrics"][0]["model"]["name"] == "mm_gbm"
+        # observability routes
+        logs = _get(server, "/3/Logs/download")
+        assert isinstance(logs["log"], str)
+        tl = _get(server, "/3/Timeline")
+        assert "events" in tl
+        meta = _get(server, "/3/Metadata/endpoints")
+        assert meta["num_routes"] >= 25
+        pats = [x["url_pattern"] for x in meta["routes"]]
+        assert any("Rapids" in x for x in pats)
+        grids = _get(server, "/99/Grids")
+        assert "grids" in grids
+    finally:
+        h2o3_tpu.remove("mm_fr")
