@@ -168,6 +168,27 @@ class Vec:
         return Vec(data, codec, dmask, n, vtype, dom)
 
     @staticmethod
+    def from_device_floats(col_j, vtype=T_NUM, domain=None) -> "Vec":
+        """Device-resident construction — the hand-off point for device
+        mungers (sort/merge/group_by): no host round trip. Stores with the
+        f32 codec (re-running the codec chooser would need host stats)."""
+        c = _mesh.cloud()
+        n = int(col_j.shape[0])
+        pad = c.padded_rows(n)
+
+        @jax.jit
+        def pack(col_j):
+            full = jnp.full(pad, jnp.nan, jnp.float32) \
+                .at[:n].set(col_j.astype(jnp.float32))
+            mask = jnp.isnan(full)
+            return jnp.where(mask, 0.0, full), mask.astype(jnp.uint8)
+
+        sh = c.rows_sharding(1)
+        packed, dmask = jax.jit(pack, out_shardings=(sh, sh))(col_j)
+        dom = np.asarray(domain, dtype=object) if domain is not None else None
+        return Vec(packed, Codec("f32"), dmask, n, vtype, dom)
+
+    @staticmethod
     def _from_strings(col: np.ndarray, force_type=None, domain=None) -> "Vec":
         """Strings parse to categorical by default (CsvParser enum detection);
         T_STR keeps raw host strings."""

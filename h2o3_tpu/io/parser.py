@@ -344,18 +344,30 @@ def import_file(path: str, destination_frame: Optional[str] = None,
     """h2o.import_file analog: setup-guess then parse in one call.
     Columnar formats (parquet/ORC/feather/avro) dispatch to the Arrow-backed
     providers (io/columnar.py); text formats go through ParseSetup."""
-    if not os.path.exists(path):
-        raise FileNotFoundError(path)
-    from h2o3_tpu.io import columnar
-    colparser = columnar.sniff(path)
-    if colparser is not None:
-        return colparser(path, destination_frame)
-    setup = parse_setup(path)
-    if header is not None:
-        setup.header = header
-    if sep is not None:
-        setup.separator = sep
-    return parse(path, setup, destination_frame, col_types)
+    from h2o3_tpu.io import uri as _uri
+    staged = None
+    if _uri.is_remote(path):
+        # eager remote read (PersistManager + PersistEagerHTTP / persist-gcs)
+        path = staged = _uri.fetch_to_local(path)
+    try:
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        from h2o3_tpu.io import columnar
+        colparser = columnar.sniff(path)
+        if colparser is not None:
+            return colparser(path, destination_frame)
+        setup = parse_setup(path)
+        if header is not None:
+            setup.header = header
+        if sep is not None:
+            setup.separator = sep
+        return parse(path, setup, destination_frame, col_types)
+    finally:
+        if staged is not None:
+            try:
+                os.unlink(staged)
+            except OSError:
+                pass
 
 
 def upload_frame(data, destination_frame: Optional[str] = None) -> Frame:

@@ -26,20 +26,34 @@ from h2o3_tpu.core.frame import Codec, Frame, Vec
 from h2o3_tpu.core.kvstore import DKV
 
 
-def _check_scheme(path: str):
-    for scheme in ("s3://", "hdfs://", "gs://"):
-        if path.startswith(scheme):
-            raise NotImplementedError(
-                f"{scheme} persist backend requires cloud connector "
-                "credentials/deps not present in this image; mount the "
-                "bucket (gcsfuse/s3fs) and use a file path")
-    return path
+def _stage_for_write(path: str) -> tuple:
+    """Local staging target for a (possibly remote) export URI."""
+    from h2o3_tpu.io import uri as _uri
+    if _uri.is_remote(path):
+        import tempfile
+        fd, tmp = tempfile.mkstemp(suffix=".hex")
+        os.close(fd)
+        return tmp, path
+    return path, None
+
+
+def _finish_write(local: str, remote):
+    if remote is not None:
+        from h2o3_tpu.io import uri as _uri
+        _uri.push_from_local(local, remote)
+
+
+def _stage_for_read(path: str) -> str:
+    from h2o3_tpu.io import uri as _uri
+    return _uri.fetch_to_local(path)
 
 
 # ===========================================================================
 def export_frame(frame: Frame, path: str) -> str:
-    """FramePersist.saveTo: snapshot a frame (packed columns, exact)."""
-    _check_scheme(path)
+    """FramePersist.saveTo: snapshot a frame (packed columns, exact).
+    URI schemes dispatch per PersistManager (file/gs/s3/memory)."""
+    local, remote = _stage_for_write(path)
+    path, _orig = local, path
     header = {"key": frame.key, "names": frame.names, "nrows": frame.nrows,
               "cols": []}
     arrays = {}
@@ -64,11 +78,25 @@ def export_frame(frame: Frame, path: str) -> str:
         buf = _io.BytesIO()
         np.savez(buf, **arrays)
         zf.writestr("columns.npz", buf.getvalue())
-    return path
+    _finish_write(local, remote)
+    return _orig
 
 
 def import_frame(path: str, key=None) -> Frame:
-    _check_scheme(path)
+    from h2o3_tpu.io import uri as _uri
+    staged = _uri.is_remote(path)
+    path = _stage_for_read(path)
+    try:
+        return _import_frame_local(path, key)
+    finally:
+        if staged:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def _import_frame_local(path: str, key=None) -> Frame:
     import io as _io
     with zipfile.ZipFile(path) as zf:
         header = json.loads(zf.read("header.json"))

@@ -4,15 +4,19 @@ Reference: GridSearch.java:69 (driver; `_parallelism` :73), cartesian and
 RandomDiscrete hyperspace walkers, grid keyed in DKV, failure tolerance (a
 failed model doesn't kill the grid), checkpointable.
 
-TPU-native: models build sequentially on the controller (each build saturates
-the chips); the walker logic is a faithful port. Failed builds are recorded
-and skipped like the reference.
+TPU-native: `parallelism` (GridSearch.java:73) builds N models concurrently
+from controller threads — XLA async dispatch interleaves their device
+programs (and compile time overlaps host-side), which is the model-parallel
+axis the reference exposes; the walker logic is a faithful port. Failed
+builds are recorded and skipped like the reference.
 """
 
 from __future__ import annotations
 
 import itertools
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -21,7 +25,7 @@ from h2o3_tpu.core.kvstore import DKV
 
 class H2OGridSearch:
     def __init__(self, model, hyper_params: dict, grid_id=None,
-                 search_criteria=None):
+                 search_criteria=None, parallelism: int = 1):
         # `model` may be an estimator class or an instance carrying defaults
         if isinstance(model, type):
             self._cls = model
@@ -35,6 +39,8 @@ class H2OGridSearch:
         self.search_criteria = dict(search_criteria or {"strategy": "Cartesian"})
         self.models: list = []
         self.failures: list = []
+        self.parallelism = max(1, int(parallelism))
+        self._lock = threading.Lock()
         DKV.put(self.grid_id, self)
 
     # ------------------------------------------------------------------
@@ -56,9 +62,10 @@ class H2OGridSearch:
               validation_frame=None, **kw):
         max_secs = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
         t0 = time.time()
-        for i, combo in enumerate(self._combos()):
+
+        def build(i, combo):
             if max_secs and time.time() - t0 > max_secs:
-                break
+                return                     # budget elapsed while queued
             params = dict(self._base_params)
             params.update(kw)
             params.update(combo)
@@ -67,9 +74,29 @@ class H2OGridSearch:
                 m = self._cls(**params)
                 m.train(x=x, y=y, training_frame=training_frame,
                         validation_frame=validation_frame)
-                self.models.append(m)
+                with self._lock:
+                    self.models.append(m)
             except Exception as ex:  # noqa: BLE001 — grid tolerates failures
-                self.failures.append({"params": combo, "error": repr(ex)})
+                with self._lock:
+                    self.failures.append({"params": combo,
+                                          "error": repr(ex)})
+
+        combos = self._combos()
+        if self.parallelism <= 1:
+            for i, combo in enumerate(combos):
+                if max_secs and time.time() - t0 > max_secs:
+                    break
+                build(i, combo)
+            return self
+        # model-parallel axis (GridSearch._parallelism): concurrent builds
+        with ThreadPoolExecutor(max_workers=self.parallelism) as pool:
+            futs = []
+            for i, combo in enumerate(combos):
+                if max_secs and time.time() - t0 > max_secs:
+                    break
+                futs.append(pool.submit(build, i, combo))
+            for f in futs:
+                f.result()
         return self
 
     # ------------------------------------------------------------------

@@ -776,11 +776,16 @@ def _sort(a, e):
     if not isinstance(by, list):
         by = [by]
     cols = [int(b) if isinstance(b, float) else f.col_idx(b) for b in by]
+    ascending = [bool(asc[k]) if isinstance(asc, list) and k < len(asc)
+                 else True for k in range(len(cols))]
+    if all(f.vecs[ci].type != T_STR for ci in cols):
+        # device radix path (water/rapids/RadixOrder.java analog)
+        from h2o3_tpu.ops import device_sort as DS
+        return DS.sort_frame(f, cols, ascending)
     keys = []
     for k, ci in enumerate(reversed(cols)):
         colv = f.vecs[ci].to_numpy()
-        ascending = bool(asc[len(cols) - 1 - k]) if isinstance(asc, list) else True
-        keys.append(colv if ascending else -colv)
+        keys.append(colv if ascending[len(cols) - 1 - k] else -colv)
     order = np.lexsort(keys)
     return _take_rows(f, order)
 
@@ -800,6 +805,15 @@ def _merge(a, e):
         by_r = [rf.col_idx(c) for c in common]
     by_l = [int(x) for x in (by_l if isinstance(by_l, list) else [by_l])]
     by_r = [int(x) for x in (by_r if isinstance(by_r, list) else [by_r])]
+    keys_numeric = all(lf.vecs[i].type != T_STR for i in by_l) and \
+        all(rf.vecs[i].type != T_STR for i in by_r)
+    if keys_numeric and not all_r:
+        # device sort-merge join (water/rapids/Merge.java analog);
+        # right/outer joins + degenerate shapes use the host fallback
+        from h2o3_tpu.ops import device_sort as DS
+        out = DS.merge_frames(lf, rf, by_l, by_r, all_l=all_l)
+        if out is not None:
+            return out
     ldf = lf.as_data_frame()
     rdf = rf.as_data_frame()
     lkeys = [lf.names[i] for i in by_l]
@@ -825,6 +839,16 @@ def _groupby(a, e):
         na = _eval(a[i + 2], e) if i + 2 < len(a) else "rm"
         aggs.append((fn_name, col, na))
         i += 3
+    device_ok = all(f.vecs[j].type != T_STR for j in by) and \
+        all(fn in ("sum", "mean", "min", "max", "var", "sd", "nrow",
+                   "count") and f.vecs[cj].type != T_STR
+            for fn, cj, _na in aggs)
+    if device_ok and by:
+        from h2o3_tpu.ops import device_sort as DS
+        got = DS.group_by_device(f, by, [(fn, cj) for fn, cj, _ in aggs])
+        if got is not None:
+            names2, cols2, doms2 = got
+            return _new_frame(names2, cols2, domains=doms2)
     key_cols = [f.vecs[j].to_numpy() for j in by]
     key_tup = list(zip(*key_cols)) if key_cols else []
     uniq = sorted(set(key_tup))
