@@ -323,3 +323,74 @@ def sbh_hist(codesT, heap, stats, *, base, L, n_bins):
         return sbh_hist_pallas(codesT, heap, stats, base=base, L=L,
                                n_bins=n_bins)
     return sbh_hist_xla(codesT, heap, stats, base=base, L=L, n_bins=n_bins)
+
+
+# ===========================================================================
+# int8 histogram variant: one-hot (exact in i8) x per-stat-quantized stats
+# on the v5e's 2x-rate int8 MXU path, int32 accumulation (exact: 127 * 11M
+# rows < 2^31), dequantized by the caller. Same grid/window structure as
+# the bf16 kernel.
+def _hist_kernel_i8(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
+                    n_bins, gwe, r_blk):
+    R = r_blk
+    p = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    heap = heap_ref[0, :]
+    slot = heap - (base + p * gwe)
+    inw = (slot >= 0) & (slot < gwe) & (heap - base < L)
+    slot_c = jnp.where(inw, slot, 0)
+    iota_s = lax.broadcasted_iota(jnp.int32, (gwe, R), 0)
+    sel = (iota_s == slot_c[None, :]) & inw[None, :]          # (gwe, R)
+    stats = stats_ref[...]                                    # (S, R) i32
+    A = (jnp.where(sel[:, None, :], stats[None, :, :], 0)
+         .reshape(gwe * S_STATS, R)).astype(jnp.int8)
+
+    acc = out_ref[...]
+    iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1)
+    parts = []
+    for c in range(COL_TILE):
+        code_c = codesT_ref[c, :]
+        oh = (iota_b == code_c[:, None]).astype(jnp.int8)
+        h = lax.dot_general(A, oh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+        parts.append(h)
+    out_ref[...] = acc + jnp.stack(parts)[None]
+
+
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins"))
+def sbh_hist_pallas_i8(codesT, heap, stats_i8, *, base, L, n_bins):
+    """stats_i8 (S, n_pad) int32 holding values in [-127, 127] (i32 input
+    dtype: Mosaic's (1, R) int8 blocks don't meet the 32-sublane granule;
+    the kernel casts to i8 in VMEM). Returns int32 histogram."""
+    c_pad, n_pad = codesT.shape
+    gwe = min(L, GW)
+    npass = max(1, -(-L // gwe))
+    ncb = c_pad // COL_TILE
+    r_blk = BLOCK_ROWS if gwe * S_STATS <= 256 else BLOCK_ROWS // 2
+    nblk = n_pad // r_blk
+    kernel = functools.partial(_hist_kernel_i8, base=base, L=L,
+                               n_bins=n_bins, gwe=gwe, r_blk=r_blk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(npass, ncb, nblk),
+        in_specs=[
+            pl.BlockSpec((COL_TILE, r_blk), lambda p, g, j: (g, j)),
+            pl.BlockSpec((1, r_blk), lambda p, g, j: (0, j)),
+            pl.BlockSpec((S_STATS, r_blk), lambda p, g, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, COL_TILE, gwe * S_STATS, n_bins),
+            lambda p, g, j: (p * ncb + g, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (npass * ncb, COL_TILE, gwe * S_STATS, n_bins), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+    )(codesT, heap.reshape(1, n_pad), stats_i8)
+    out = out.reshape(npass, ncb, COL_TILE, gwe, S_STATS, n_bins)
+    return out.transpose(0, 3, 1, 2, 4, 5).reshape(
+        npass * gwe, c_pad, S_STATS, n_bins)
