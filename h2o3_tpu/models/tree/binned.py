@@ -323,6 +323,8 @@ class BinnedGrower:
         any_cat = bool(spec.is_cat.any())
         zerovt = jnp.zeros((8, 128), jnp.float32)
         prev = None                    # routing tables of level d-1
+        hist_prev = None               # full histogram of level d-1
+        did_prev = None                # split mask of level d-1
         for d in range(D):
             L = 1 << d
             base = L - 1
@@ -332,8 +334,23 @@ class BinnedGrower:
                                        F, base=(L >> 1) - 1, L=L >> 1,
                                        any_cat=any_cat,
                                        na_code=spec.b_val)
-            hist = HP.sbh_hist(codes, heap, stats, base=base, L=L,
-                               n_bins=BP)[:L, :C]
+            if d == 0:
+                hist = HP.sbh_hist(codes, heap, stats, base=base, L=L,
+                                   n_bins=BP)[:L, :C]
+            else:
+                # sibling subtraction: histogram LEFT children only (half
+                # the leaf window -> half the MXU dot), derive right =
+                # parent - left. Routing moves every row of a split leaf,
+                # so parent = left + right exactly; unsplit parents are
+                # masked to zero (their child slots are dead).
+                left = HP.sbh_hist(codes, heap, stats, base=base, L=L,
+                                   n_bins=BP, half=True)[: L >> 1, :C]
+                par = jnp.where(did_prev[:, None, None, None],
+                                hist_prev, 0.0)
+                right = par - left
+                hist = jnp.stack([left, right], axis=1) \
+                    .reshape(L, *left.shape[1:])
+            hist_prev = hist
 
             if mtries and mtries < c_real:
                 r = jax.random.uniform(jax.random.fold_in(key, d),
@@ -351,6 +368,7 @@ class BinnedGrower:
                 lam=self.lam, use_hess=self.use_hess, any_cat=any_cat)
 
             did = s["did"]
+            did_prev = did
             ids = jnp.arange(L)
             tgt = base + ids
             colA = colA.at[tgt].set(jnp.where(did, s["col"], -1))

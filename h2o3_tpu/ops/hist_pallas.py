@@ -219,9 +219,15 @@ def sbh_route(codesT, heap, tbl, route_f, valtab, F, *, base, L,
 # ===========================================================================
 # Phase 2: leaf-window histogram accumulation
 def _hist_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
-                 n_bins, gwe, r_blk):
+                 n_bins, gwe, r_blk, half):
     """Grid (pass, col-block, row-tile): accumulate the (CB, gwe*S, nb)
-    window block over the row sweep; gwe = min(L, GW) leaves per pass.
+    window block over the row sweep; gwe = min(L_eff, GW) leaves per pass.
+
+    With half=True only EVEN leaf indices (left children) are accumulated —
+    window slot = leaf >> 1 — and the caller derives right children by
+    sibling subtraction (parent histogram minus left child; the same trick
+    xgboost/lightgbm use — valid because routing moves EVERY row of a split
+    leaf to a child, so parent = left + right exactly).
 
     codesT_ref: (COL_TILE, R) i32 — this col-block's codes
     heap_ref:   (1, R) i32        stats_ref: (S_STATS, R) f32
@@ -236,8 +242,14 @@ def _hist_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     heap = heap_ref[0, :]                                  # (R,) lanes
-    slot = heap - (base + p * gwe)
-    inw = (slot >= 0) & (slot < gwe) & (heap - base < L)
+    leaf = heap - base
+    if half:
+        slot = (leaf >> 1) - p * gwe
+        inw = (leaf >= 0) & (leaf < L) & ((leaf & 1) == 0)
+    else:
+        slot = leaf - p * gwe
+        inw = (leaf >= 0) & (leaf < L)
+    inw = inw & (slot >= 0) & (slot < gwe)
     slot_c = jnp.where(inw, slot, 0)
     # A ((gwe*S), R): row (slot, s); rows of the tile ride the lanes — the
     # measured-fast dot orientation is (M, R) @ (R, nb)
@@ -250,32 +262,38 @@ def _hist_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
         .reshape(gwe * S_STATS, R).astype(jnp.bfloat16)    # (M, R)
 
     acc = out_ref[...]
-    iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1)
+    # one-hot built TRANSPOSED (nb, R): bins on sublanes, rows on lanes.
+    # Measured 1.9x faster than the (R, nb) orientation — the compare
+    # broadcast is a major-dim insert (free) instead of a minor-dim
+    # relayout, and the dot contracts the rhs on dim 1 directly.
+    iota_b = lax.broadcasted_iota(jnp.int32, (n_bins, R), 0)
     parts = []
     for c in range(COL_TILE):
         code_c = codesT_ref[c, :]                          # (R,) static c
-        oh = (iota_b == code_c[:, None]).astype(jnp.bfloat16)   # (R, nb)
-        h = lax.dot_general(A, oh, (((1,), (0,)), ((), ())),
+        ohT = (iota_b == code_c[None, :]).astype(jnp.bfloat16)  # (nb, R)
+        h = lax.dot_general(A, ohT, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (M, nb)
         parts.append(h)
     out_ref[...] = acc + jnp.stack(parts)[None]            # (1, CB, M, nb)
 
 
-@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins"))
-def sbh_hist_pallas(codesT, heap, stats, *, base, L, n_bins):
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
+def sbh_hist_pallas(codesT, heap, stats, *, base, L, n_bins, half=False):
     """codesT (C_pad, n_pad) i32; heap (n_pad,) i32; stats (S, n_pad) f32.
-    Returns (L_pad, C_pad, S_STATS, n_bins) f32 with L_pad = npass*GW:
-    hist[l] = per-(col, stat, bin) sums over rows with heap == base + l."""
+    Returns (L_pad, C_pad, S_STATS, n_bins) f32 with L_pad = npass*gwe:
+    hist[l] = per-(col, stat, bin) sums over rows with heap == base + l
+    (half=True: over rows with heap == base + 2l — left children only)."""
     c_pad, n_pad = codesT.shape
-    gwe = min(L, GW)
-    npass = max(1, -(-L // gwe))
+    l_eff = (L + 1) // 2 if half else L
+    gwe = min(l_eff, GW)
+    npass = max(1, -(-l_eff // gwe))
     ncb = c_pad // COL_TILE
     # VMEM budget: A (M, R) bf16 + oh (R, nb) bf16 + out (CB, M, nb) f32
     # hit the 16MB limit at M=512, so deep levels run narrower row tiles
     r_blk = BLOCK_ROWS if gwe * S_STATS <= 256 else BLOCK_ROWS // 2
     nblk = n_pad // r_blk
     kernel = functools.partial(_hist_kernel, base=base, L=L, n_bins=n_bins,
-                               gwe=gwe, r_blk=r_blk)
+                               gwe=gwe, r_blk=r_blk, half=half)
     out = pl.pallas_call(
         kernel,
         grid=(npass, ncb, nblk),
@@ -298,14 +316,18 @@ def sbh_hist_pallas(codesT, heap, stats, *, base, L, n_bins):
         npass * gwe, c_pad, S_STATS, n_bins)
 
 
-def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins):
+def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins, half=False):
     """Pure-XLA fallback via segment-sum (CPU tests / non-TPU backends)."""
     c_pad, n_pad = codesT.shape
-    gwe = min(L, GW)
-    npass = max(1, -(-L // gwe))
+    l_eff = (L + 1) // 2 if half else L
+    gwe = min(l_eff, GW)
+    npass = max(1, -(-l_eff // gwe))
     L_pad = npass * gwe
     leaf = heap - base
     ok = (leaf >= 0) & (leaf < L)
+    if half:
+        ok = ok & ((leaf & 1) == 0)
+        leaf = leaf >> 1
     lf = jnp.where(ok, leaf, L_pad)
 
     def one_col(c):
@@ -318,11 +340,12 @@ def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins):
              .transpose(1, 0, 3, 2)
 
 
-def sbh_hist(codesT, heap, stats, *, base, L, n_bins):
+def sbh_hist(codesT, heap, stats, *, base, L, n_bins, half=False):
     if use_pallas():
         return sbh_hist_pallas(codesT, heap, stats, base=base, L=L,
-                               n_bins=n_bins)
-    return sbh_hist_xla(codesT, heap, stats, base=base, L=L, n_bins=n_bins)
+                               n_bins=n_bins, half=half)
+    return sbh_hist_xla(codesT, heap, stats, base=base, L=L, n_bins=n_bins,
+                        half=half)
 
 
 # ===========================================================================

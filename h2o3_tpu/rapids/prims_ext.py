@@ -970,3 +970,272 @@ def _comma(a, e):
     for x in a:
         out = _eval(x, e)
     return out
+
+
+# ===========================================================================
+# Tranche 3 — final parity prims (ast/prims coverage to the full registry)
+
+PRIMS["%%"] = PRIMS["%"]          # AstMod alias (operators/AstMod.java)
+PRIMS[","] = PRIMS["comma"]       # AstComma (operators/AstComma.java)
+
+
+@prim("none")
+def _noop(a, e):
+    """AstNoOp (math/AstNoOp.java): identity unary op."""
+    return _eval(a[0], e) if a else 0.0
+
+
+@prim("assign")
+def _assign_global(a, e):
+    """AstAssign (assign/AstAssign.java): global key <- frame (copy; the
+    reference shares Vecs — here frames are immutable columns, so a
+    shallow re-key is the same semantics)."""
+    key = a[0] if isinstance(a[0], str) else str(_eval(a[0], e))
+    src = _eval(a[1], e)
+    f = _new_frame(list(src.names),
+                   [src.vecs[j].to_numpy()[: src.nrows]
+                    for j in range(src.ncols)],
+                   types=[v.type for v in src.vecs],
+                   domains={j: src.vecs[j].levels()
+                            for j in range(src.ncols)
+                            if src.vecs[j].type == T_CAT})
+    DKV.remove(f.key)
+    f.key = key
+    DKV.put(key, f)
+    e.session.register(key)
+    return f
+
+
+@prim("x")
+def _mmult(a, e):
+    """AstMMult (matrix/AstMMult.java): (x fr1 fr2) matrix product on MXU."""
+    f1 = _eval(a[0], e)
+    f2 = _eval(a[1], e)
+    A = f1.matrix(_numeric_cols(f1))[: f1.nrows]
+    B = f2.matrix(_numeric_cols(f2))[: f2.nrows]
+    out = np.asarray(jax.jit(jnp.matmul)(A, B), np.float64)
+    return _new_frame([f"C{j+1}" for j in range(out.shape[1])],
+                      [out[:, j] for j in range(out.shape[1])])
+
+
+@prim("scale_inplace")
+def _scale_inplace(a, e):
+    """AstScale.AstScaleInPlace: scale writing back into the source key."""
+    f = _eval(a[0], e)
+    out = PRIMS["scale"](a, e)
+    DKV.remove(f.key)
+    out_key, out.key = out.key, f.key
+    DKV.remove(out_key)
+    DKV.put(f.key, out)
+    return out
+
+
+@prim("setproperty")
+def _setproperty(a, e):
+    """AstSetProperty (misc/AstSetProperty.java): set a runtime property
+    (the reference sets Java system properties with the ai.h2o. prefix)."""
+    from h2o3_tpu.utils import config as _cfg
+    prop = _eval(a[0], e)
+    value = _eval(a[1], e)
+    _cfg.set_property(str(prop), value)
+    return str(value)
+
+
+@prim("model.reset.threshold")
+def _reset_threshold(a, e):
+    """AstModelResetThreshold: set a binomial model's decision threshold;
+    returns the OLD threshold."""
+    m = _eval(a[0], e)
+    thr = float(_eval(a[1], e))
+    old = getattr(m, "_default_threshold", 0.5)
+    m._default_threshold = thr
+    DKV.put(m.key, m)
+    return float(old)
+
+
+@prim("segment_models_as_frame")
+def _segment_models_as_frame(a, e):
+    """AstSegmentModelsAsFrame: one row per segment: segment cols +
+    model key + status + error."""
+    sm = _eval(a[0], e)
+    rows = sm.as_list()
+    seg_names = sorted({k for r in rows for k in r["segment"]})
+    cols, names = [], []
+    for sn in seg_names:
+        names.append(sn)
+        cols.append(np.asarray([r["segment"].get(sn) for r in rows],
+                               object))
+    for field in ("model", "status"):
+        names.append(field if field != "model" else "model_id")
+        cols.append(np.asarray([r.get(field) or "" for r in rows], object))
+    names.append("errors")
+    cols.append(np.asarray([r.get("error") or "" for r in rows], object))
+    types = [T_NUM if np.asarray(c).dtype.kind in "fi" else T_STR
+             for c in cols]
+    cols = [c if t == T_NUM else np.asarray([str(x) for x in c], object)
+            for c, t in zip(cols, types)]
+    return _new_frame(names, cols, types=types)
+
+
+@prim("PermutationVarImp")
+def _perm_varimp(a, e):
+    """AstPermutationVarImp (models/AstPermutationVarImp.java)."""
+    from h2o3_tpu.explain import permutation_varimp
+    m = _eval(a[0], e)
+    fr = _eval(a[1], e)
+    metric = str(_eval(a[2], e)) if len(a) > 2 else "AUTO"
+    # args 3 (n_samples) is subsampling — full frame used here
+    n_repeats = int(_eval(a[4], e)) if len(a) > 4 else 1
+    seed = int(_eval(a[6], e)) if len(a) > 6 else 42
+    rows = permutation_varimp(m, fr, metric=metric,
+                              n_repeats=max(1, n_repeats), seed=seed)
+    return _new_frame(
+        ["Variable", "Relative Importance", "Scaled Importance",
+         "Percentage"],
+        [np.asarray([r["variable"] for r in rows], object),
+         np.asarray([r["relative_importance"] for r in rows]),
+         np.asarray([r["scaled_importance"] for r in rows]),
+         np.asarray([r["percentage"] for r in rows])],
+        types=[T_STR, T_NUM, T_NUM, T_NUM])
+
+
+@prim("grouped_permute")
+def _grouped_permute(a, e):
+    """AstGroupedPermute (mungers/AstGroupedPermute.java): per group-by
+    value, cross product of the 'D' rows x 'C' rows of permuteBy (a 2-level
+    categorical), amounts summed per distinct permCol id. Output:
+    group cols + In, Out, InAmnt, OutAmnt."""
+    fr = _eval(a[0], e)
+    perm_col = int(_eval(a[1], e))
+    gb = _eval(a[2], e)
+    gb_cols = [int(g) for g in (gb if isinstance(gb, list) else [gb])]
+    permute_by = int(_eval(a[3], e))
+    keep_col = int(_eval(a[4], e))
+    n = fr.nrows
+    gid = fr.vecs[gb_cols[0]].to_numpy()[:n]
+    rid = fr.vecs[perm_col].to_numpy()[:n]
+    typ_codes = fr.vecs[permute_by].to_numpy()[:n]
+    dom = fr.vecs[permute_by].levels() or []
+    is_d = np.asarray([dom[int(t)] == "D" if t == t and dom else int(t) == 0
+                       for t in typ_codes])
+    amt = fr.vecs[keep_col].to_numpy()[:n]
+    groups: dict = {}
+    for i in range(n):
+        g = groups.setdefault(gid[i], [{}, {}])
+        side = 0 if is_d[i] else 1
+        g[side][rid[i]] = g[side].get(rid[i], 0.0) + float(amt[i])
+    out = [[] for _ in range(len(gb_cols) + 4)]
+    for g, (dd, cc) in sorted(groups.items()):
+        for rd, ad in sorted(dd.items()):
+            for rc, ac in sorted(cc.items()):
+                out[0].append(g)
+                out[-4].append(rd)
+                out[-3].append(rc)
+                out[-2].append(ad)
+                out[-1].append(ac)
+    names = [fr.names[g] for g in gb_cols] + \
+        ["In", "Out", "InAmnt", "OutAmnt"]
+    doms = {0: fr.vecs[gb_cols[0]].levels(),
+            len(gb_cols): fr.vecs[perm_col].levels(),
+            len(gb_cols) + 1: fr.vecs[perm_col].levels()}
+    doms = {k: v for k, v in doms.items() if v}
+    return _new_frame(names, [np.asarray(c, np.float64) for c in out],
+                      domains=doms)
+
+
+@prim("isax")
+def _isax(a, e):
+    """AstIsax (timeseries/AstIsax.java): iSAX 2.0 — rows are time series;
+    PAA into numWords segments then symbolize against N(0,1) breakpoints
+    up to maxCardinality. Output: iSax_index string + numWords PAA cols."""
+    fr = _eval(a[0], e)
+    num_words = int(_eval(a[1], e))
+    max_card = int(_eval(a[2], e))
+    if num_words <= 0 or max_card <= 0:
+        raise ValueError("numWords and maxCardinality must be > 0")
+    A = fr.matrix(_numeric_cols(fr))[: fr.nrows]
+
+    @jax.jit
+    def paa(A):
+        nTS, T = A.shape
+        # z-normalize each series then piecewise-aggregate into words
+        mu = jnp.nanmean(A, axis=1, keepdims=True)
+        sd = jnp.nanstd(A, axis=1, keepdims=True)
+        Z = (A - mu) / jnp.where(sd > 0, sd, 1.0)
+        k = -(-T // num_words)
+        pad = jnp.pad(Z, ((0, 0), (0, k * num_words - T)),
+                      constant_values=jnp.nan)
+        seg = pad.reshape(nTS, num_words, k)
+        return jnp.nanmean(seg, axis=2)
+
+    W = np.asarray(paa(A), np.float64)
+    # Gaussian breakpoints at cardinality max_card
+    from h2o3_tpu.utils.stats import norm_ppf
+    card = max(2, min(int(max_card), 64))
+    bps = np.asarray([norm_ppf((i + 1) / card) for i in range(card - 1)])
+    sym = np.stack([np.searchsorted(bps, W[:, j]) for j in
+                    range(num_words)], axis=1)
+    idx = np.asarray(["^".join(str(int(s)) for s in row) for row in sym],
+                     object)
+    names = ["iSax_index"] + [f"c{j}" for j in range(num_words)]
+    cols = [idx] + [sym[:, j].astype(np.float64)
+                    for j in range(num_words)]
+    return _new_frame(names, cols, types=[T_STR] + [T_NUM] * num_words)
+
+
+@prim("tf-idf")
+def _tf_idf(a, e):
+    """AstTfIdf (advmath/AstTfIdf.java): (tf-idf frame doc_id_idx text_idx
+    preprocess case_sensitive) -> DocID, Word, TF, IDF, TF-IDF."""
+    fr = _eval(a[0], e)
+    doc_idx = int(_eval(a[1], e))
+    txt_idx = int(_eval(a[2], e))
+    preprocess = bool(_eval(a[3], e)) if len(a) > 3 else True
+    case_sensitive = bool(_eval(a[4], e)) if len(a) > 4 else False
+    n = fr.nrows
+    docs = fr.vecs[doc_idx].to_numpy()[:n]
+    tv = fr.vecs[txt_idx]
+    if tv.type == T_STR:
+        txt = tv.to_numpy()[:n]
+    elif tv.type == T_CAT:
+        dom = tv.levels()
+        txt = [dom[int(c)] if c == c else None for c in tv.to_numpy()[:n]]
+    else:
+        raise ValueError("tf-idf text column must be string/categorical")
+    pairs = []
+    for d, t in zip(docs, txt):
+        s = str(t) if t is not None else ""
+        if not case_sensitive:
+            s = s.lower()
+        words = s.split() if preprocess else [s]
+        for w in words:
+            if w:
+                pairs.append((float(d), w))
+    if not pairs:
+        raise ValueError("Empty input frame provided.")
+    tf: dict = {}
+    for d, w in pairs:
+        tf[(d, w)] = tf.get((d, w), 0) + 1
+    n_docs = len(set(d for d, _ in pairs))
+    dfreq: dict = {}
+    for (d, w) in tf:
+        dfreq[w] = dfreq.get(w, 0) + 1
+    rows = sorted(tf.items(), key=lambda kv: (kv[0][1], kv[0][0]))
+    doc_c = np.asarray([d for (d, w), _ in rows])
+    word_c = np.asarray([w for (d, w), _ in rows], object)
+    tf_c = np.asarray([c for _, c in rows], np.float64)
+    idf_c = np.asarray([math.log((n_docs + 1.0) / (dfreq[w] + 1.0))
+                        for (_, w), _ in rows], np.float64)
+    return _new_frame(["DocID", "Word", "TF", "IDF", "TF-IDF"],
+                      [doc_c, word_c, tf_c, idf_c, tf_c * idf_c],
+                      types=[T_NUM, T_STR, T_NUM, T_NUM, T_NUM])
+
+
+@prim("run_tool")
+def _run_tool(a, e):
+    """AstRunTool (internal/AstRunTool.java): dispatch to a registered
+    maintenance tool by name."""
+    from h2o3_tpu.utils.tools import run_tool as _rt
+    name = str(_eval(a[0], e))
+    args = [_eval(x, e) for x in a[1:]]
+    return _rt(name, args)
