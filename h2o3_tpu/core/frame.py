@@ -360,7 +360,10 @@ class Frame:
     def types(self) -> dict:
         return {n: v.type for n, v in zip(self.names, self.vecs)}
 
-    def vec(self, name: str) -> Vec:
+    def vec(self, name) -> Vec:
+        """Column by name or positional index (h2o-py frames accept both)."""
+        if isinstance(name, (int, np.integer)):
+            return self.vecs[int(name)]
         return self.vecs[self.names.index(name)]
 
     def col_idx(self, name: str) -> int:

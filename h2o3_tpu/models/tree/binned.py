@@ -85,6 +85,20 @@ def make_bins(X, is_cat, nbins: int, sample: int = 1 << 18) -> BinSpec:
                    b_val=b_val, n_bins=nb, c_pad=cp)
 
 
+def row_granule() -> int:
+    """Per-shard row-count granularity: the Pallas kernels sweep rows in
+    BLOCK_ROWS tiles; the XLA fallbacks (CPU tests) have no tiling constraint
+    so a smaller granule keeps tiny sharded test frames cheap."""
+    return R if HP.use_pallas() else 512
+
+
+def padded_rows(n: int, shards: int = 1) -> int:
+    """Slots for n data rows + 1 dummy, padded so every shard's local block
+    is a granule multiple (the rows axis splits evenly over the mesh)."""
+    blk = row_granule() * max(1, shards)
+    return -(-(n + 1) // blk) * blk
+
+
 @functools.partial(jax.jit, static_argnames=("b_val", "c_pad", "n_pad"))
 def _quantize(X, edges, *, b_val, c_pad, n_pad):
     """codes[r,c] = #edges < x (0..b_val-1), NA -> b_val. Rows are padded to
@@ -102,9 +116,10 @@ def _quantize(X, edges, *, b_val, c_pad, n_pad):
     return lax.dynamic_update_slice(out, codes, (0, 0))
 
 
-def quantize(X, spec: BinSpec):
+def quantize(X, spec: BinSpec, n_pad: int | None = None):
     n = X.shape[0]
-    n_pad = -(-(n + 1) // R) * R
+    if n_pad is None:
+        n_pad = padded_rows(n)
     return _quantize(X, jnp.asarray(spec.edges),
                      b_val=spec.b_val, c_pad=spec.c_pad, n_pad=n_pad)
 
@@ -268,7 +283,13 @@ class BinnedGrower:
     def __init__(self, spec: BinSpec, *, max_depth: int, min_rows: float,
                  min_split_improvement: float, reg_lambda: float = 0.0,
                  reg_alpha: float = 0.0, use_hess_denom: bool = False,
-                 monotone: np.ndarray | None = None):
+                 monotone: np.ndarray | None = None,
+                 axis_name: str | None = None):
+        # axis_name: mesh axis the row dimension is sharded over. grow() then
+        # runs shard-local and merges per-level histograms with ONE psum —
+        # the reduce-tree of ScoreBuildHistogram.java:98 / MRTask.java:907
+        # riding ICI. Split search stays replicated (identical on all shards).
+        self.axis_name = axis_name
         self.spec = spec
         self.D = int(max_depth)
         self.L = 2 ** self.D
@@ -285,10 +306,10 @@ class BinnedGrower:
             np.pad(spec.is_cat, (0, spec.c_pad - spec.is_cat.size)))
 
     # ---- static layout ---------------------------------------------------
-    def layout(self, n: int):
-        """Slots for n data rows + 1 dummy, padded to the kernel block."""
-        n_pad = -(-(n + 1) // R) * R
-        return n_pad
+    def layout(self, n: int, shards: int = 1):
+        """Slots for n data rows + 1 dummy, padded to the kernel block
+        (per-shard when the rows axis is sharded over `shards` devices)."""
+        return padded_rows(n, shards)
 
     def grow(self, codes, stats, F, *, eta, clip_val, key, mtries: int = 0):
         """Grow ONE tree and apply its margin update — all device-resident.
@@ -337,6 +358,10 @@ class BinnedGrower:
             if d == 0:
                 hist = HP.sbh_hist(codes, heap, stats, base=base, L=L,
                                    n_bins=BP)[:L, :C]
+                if self.axis_name:
+                    # the ScoreBuildHistogram reduce: merge shard-local
+                    # histograms in one collective per level
+                    hist = lax.psum(hist, self.axis_name)
             else:
                 # sibling subtraction: histogram LEFT children only (half
                 # the leaf window -> half the MXU dot), derive right =
@@ -345,6 +370,9 @@ class BinnedGrower:
                 # masked to zero (their child slots are dead).
                 left = HP.sbh_hist(codes, heap, stats, base=base, L=L,
                                    n_bins=BP, half=True)[: L >> 1, :C]
+                if self.axis_name:
+                    # psum BEFORE subtraction: hist_prev is already global
+                    left = lax.psum(left, self.axis_name)
                 par = jnp.where(did_prev[:, None, None, None],
                                 hist_prev, 0.0)
                 right = par - left
@@ -467,19 +495,30 @@ def pack_route(route, n_bins, b_val=None):
 
 def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
                       sample_rate: float, mtries: int, k_trees: int,
-                      clip_val: float = 19.0):
+                      clip_val: float = 19.0, mesh=None):
     """Build (and cache) the jitted K-tree training program.
 
     Contract: codes (C_pad, n_pad) i32 from `quantize` (n real rows, the
     rest dummies); y1/w1/F are (n_pad,) f32 with zeros beyond row n.
     Returns (new F, stacked tree arrays) per call.
+
+    With `mesh` given (and grower.axis_name set) the program is shard_mapped
+    over the rows axis: codes/y1/w1/F are row-sharded, each shard grows the
+    tree on its local rows, and grow()'s per-level psum merges histograms —
+    the MRTask reduce tree (MRTask.java:907-921) as ONE ICI collective per
+    level. Split search and the tree arrays are replicated by construction
+    (identical on every shard given the global histograms).
     """
     # cache on the grower INSTANCE: a global id()-keyed cache can hand a
     # recycled id a stale closure over another grower's bin edges
     cache = getattr(grower, "_trainer_cache", None)
     if cache is None:
         cache = grower._trainer_cache = {}
-    key_ = (n, dist, eta, sample_rate, mtries, k_trees, clip_val)
+    axis = grower.axis_name if mesh is not None else None
+    if mesh is not None and grower.axis_name is None:
+        raise ValueError("mesh given but grower has no axis_name")
+    key_ = (n, dist, eta, sample_rate, mtries, k_trees, clip_val,
+            axis, id(mesh) if mesh is not None else 0)
     fn = cache.get(key_)
     if fn is not None:
         return fn
@@ -487,11 +526,14 @@ def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
     gaussian = dist == "gaussian"
     cv = 0.0 if gaussian else clip_val
 
-    @jax.jit
-    def run(codes, y1, w1, F, key):
+    def run_body(codes, y1, w1, F, key):
         def per_tree(carry, k):
             F, key = carry
             key, ks, kt = jax.random.split(key, 3)
+            if axis:
+                # decorrelate row sampling across shards; the mtries key kt
+                # stays common so every shard draws the SAME column masks
+                ks = jax.random.fold_in(ks, lax.axis_index(axis))
             g, h = _grad_hess_binned(dist, F, y1)
             if sample_rate < 1.0:
                 u = jax.random.uniform(ks, w1.shape)
@@ -511,6 +553,16 @@ def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
 
         (F, _), trees = lax.scan(per_tree, (F, key), jnp.arange(k_trees))
         return F, trees
+
+    if axis:
+        from jax.sharding import PartitionSpec as P
+        run = jax.jit(jax.shard_map(
+            run_body, mesh=mesh,
+            in_specs=(P(None, axis), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(axis), P()),
+            check_vma=False))
+    else:
+        run = jax.jit(run_body)
 
     cache[key_] = run
     return run

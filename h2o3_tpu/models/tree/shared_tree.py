@@ -265,7 +265,13 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         stride = max(1, n >> 18)
         Xs = np.asarray(X[::stride][: 1 << 18])
         spec = BN.make_bins(Xs, is_cat, b_val)
-        codes = BN.quantize(X, spec)
+
+        # mesh wiring: shard the rows axis over the cloud's data axis so the
+        # histogram merge is grow()'s per-level psum (the v5p-32 path)
+        from h2o3_tpu.parallel import mesh as MESH
+        cl = MESH.cloud()
+        shards = cl.n_rows_shards
+        multi = shards > 1
 
         mono = np.zeros(spec.c_pad, np.int32)
         mc = p.get("monotone_constraints") or {}
@@ -276,7 +282,8 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             spec, max_depth=int(p["max_depth"]),
             min_rows=float(p["min_rows"]),
             min_split_improvement=float(p["min_split_improvement"]),
-            monotone=mono if mc else None)
+            monotone=mono if mc else None,
+            axis_name=MESH.ROWS if multi else None)
 
         ntrees = int(p["ntrees"])
         lr = float(p["learn_rate"])
@@ -293,10 +300,17 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             f0 = ybar
         self._f0 = f0
 
-        n_pad = grower.layout(n)
+        n_pad = grower.layout(n, shards=shards if multi else 1)
+        codes = BN.quantize(X, spec, n_pad=n_pad)
         y1 = BN.pad_rows(y, n_pad)
         w1 = BN.pad_rows(w, n_pad)
         F = jnp.where(jnp.arange(n_pad) < n, f0, 0.0).astype(jnp.float32)
+        if multi:
+            from jax.sharding import PartitionSpec as P
+            codes = jax.device_put(codes, cl.sharding(P(None, MESH.ROWS)))
+            y1 = jax.device_put(y1, cl.rows_sharding(1))
+            w1 = jax.device_put(w1, cl.rows_sharding(1))
+            F = jax.device_put(F, cl.rows_sharding(1))
         interval = max(1, int(p.get("score_tree_interval") or 5))
         mtries = self._per_level_mtries(C)
         sample_rate = float(p["sample_rate"])
@@ -306,7 +320,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             k = min(interval, ntrees - done)
             trainer = BN.gbm_chunk_trainer(
                 grower, n, dist=dist, eta=lr, sample_rate=sample_rate,
-                mtries=mtries, k_trees=k)
+                mtries=mtries, k_trees=k, mesh=cl.mesh if multi else None)
             key, kc = jax.random.split(key)
             F, trees = trainer(codes, y1, w1, F, kc)
             chunks.append(trees)

@@ -1020,13 +1020,24 @@ def _mmult(a, e):
 
 @prim("scale_inplace")
 def _scale_inplace(a, e):
-    """AstScale.AstScaleInPlace: scale writing back into the source key."""
+    """AstScale.AstScaleInPlace: scale writing back into the source key.
+
+    The target key is the symbol the frame was looked up by (the DKV id in
+    the Rapids expression), not the frame's own auto-generated key — they
+    differ when a frame is registered under more than one id."""
     f = _eval(a[0], e)
+    # target key = the DKV id the frame was looked up by (may differ from
+    # f.key when the frame is registered under an alias); a lambda-local
+    # binding is NOT a DKV id — fall back to the frame's own key then
+    key = a[0] if isinstance(a[0], str) and DKV.get(a[0]) is f else f.key
     out = PRIMS["scale"](a, e)
-    DKV.remove(f.key)
-    out_key, out.key = out.key, f.key
-    DKV.remove(out_key)
-    DKV.put(f.key, out)
+    DKV.remove(out.key)
+    out.key = key
+    DKV.put(key, out)
+    if f.key != key and DKV.get(f.key) is f:
+        # every live id of the frame must see the scaled data (in-place
+        # contract): repoint the original registration too
+        DKV.put(f.key, out)
     return out
 
 
