@@ -23,6 +23,51 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+# ---------------------------------------------------------------------------
+# Smoke / slow tiers. The reference keeps a curated smoke list
+# (tests/pyunitSmokeTestList) so CI can gate on a fast subset; here the
+# inverse list marks every test measured >=10s on the 8-device CPU mesh as
+# `slow`. Gate rule: `pytest -m "not slow"` must stay green and under 5 min.
+SLOW_TESTS = {
+    # module-level: every test in these modules is slow
+    "test_explain", "test_infogram", "test_meta_learning",
+    # individual tests (module, test-name)
+    "test_rulefit_extracts_rules", "test_generic_model_roundtrip",
+    "test_gbm_mojo_parity", "test_binary_save_load",
+    "test_parallel_grid_search",
+    "test_roundtrip_binomial_with_categoricals", "test_roundtrip_regression",
+    "test_local_accuracy_gbm", "test_local_accuracy_xgboost_regression",
+    "test_gbm_checkpoint_restart",
+    "test_xgboost_aliases_and_regularization",
+    "test_xgboost_regression_and_multiclass", "test_xgboost_binary",
+    "test_xgboost_mojo_roundtrip",
+    "test_binned_matches_adaptive_quality",
+    "test_monotone_constraints_enforced",
+    "test_categorical_set_splits_beat_label_encoding",
+    "test_drf_binomial", "test_gbm_na_handling", "test_gbm_regression",
+    "test_validation_frame_and_weights", "test_gbm_bernoulli",
+    "test_cross_validation", "test_isolation_forest",
+    "test_gbm_multinomial",
+    "test_custom_metric_attached", "test_model_build_and_predict",
+    "test_gbm_pojo_parity", "test_extended_isolation_forest",
+    "test_psum_in_program", "test_sharded_matches_single_device",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        name = item.name.split("[")[0]
+        if mod in SLOW_TESTS or name in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: >=10s on the 8-device CPU mesh; excluded from the "
+        "smoke tier (`pytest -m 'not slow'`)")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def cloud8():
     """stall_till_cloudsize(8) analog: form the 8-shard cloud once."""

@@ -93,10 +93,20 @@ def test_rapids_endpoint(server):
 
 
 def test_jobs_and_models_listing(server):
+    # self-sufficient: build a tiny model rather than relying on a prior
+    # test's artifact (the smoke tier may deselect that test)
+    rng = np.random.default_rng(3)
+    X = rng.normal(0, 1, (120, 2))
+    cols = {"x0": X[:, 0], "x1": X[:, 1], "y": X.sum(1)}
+    Frame.from_dict(cols, key="rest_list_train")
+    r = _post(server, "/3/ModelBuilders/glm", training_frame="rest_list_train",
+              response_column="y", model_id="rest_list_glm")
+    j = _wait_job(server, r["job"]["key"])
+    assert j["status"] == "DONE", j
     js = _get(server, "/3/Jobs")
-    assert isinstance(js["jobs"], list)
+    assert isinstance(js["jobs"], list) and len(js["jobs"]) >= 1
     ms = _get(server, "/3/Models")
-    assert any(m["model_id"] == "rest_gbm" for m in ms["models"])
+    assert any(m["model_id"] == "rest_list_glm" for m in ms["models"])
 
 
 def test_builders_listing(server):
