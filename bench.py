@@ -47,6 +47,15 @@ def main():
 
     X, y = gen(kx, kn, ky)
 
+    # ---- kernel parity gate (pre-step): a misrouting Pallas kernel must
+    # not ship behind a good throughput number
+    import sys
+    from h2o3_tpu.ops.parity import kernel_parity_check
+    from h2o3_tpu.ops import hist_pallas as HP
+    if HP.use_pallas():
+        kernel_parity_check(seed=0)
+        print("kernel parity: OK", file=sys.stderr)
+
     # bin spec from a host-side sample (29MB readback), codes on device
     Xs = np.asarray(X[: 1 << 18])
     spec = BN.make_bins(Xs, np.zeros(C, bool), NBINS)
@@ -80,12 +89,31 @@ def main():
 
     ntrees = CHUNK * NCHUNK
     throughput = N * ntrees / dt
+
+    # ---- AUC gate: the 50 trained trees must actually have learned.
+    # Rank-sum (Mann-Whitney) AUC on device; a broken histogram/route
+    # kernel collapses this to ~0.5 regardless of throughput.
+    @jax.jit
+    def auc_dev(F, y):
+        Fr = F[:N]
+        order = jnp.argsort(Fr)
+        ranks = jnp.zeros(N, jnp.float64).at[order].set(
+            jnp.arange(1, N + 1, dtype=jnp.float64))
+        pos = y.astype(jnp.float64)
+        npos = pos.sum()
+        nneg = N - npos
+        return (ranks @ pos - npos * (npos + 1) / 2) / (npos * nneg)
+
+    auc = float(auc_dev(F, y))
+    assert auc > 0.72, f"AUC gate failed: {auc:.4f} — kernels mis-trained"
+
     baseline = 157e6  # H100 gpu_hist row*trees/s reference point (header)
     print(json.dumps({
         "metric": "gbm_hist_row_trees_per_sec",
         "value": round(throughput),
         "unit": "row*trees/s",
         "vs_baseline": round(throughput / baseline, 4),
+        "train_auc": round(auc, 4),
     }))
 
 

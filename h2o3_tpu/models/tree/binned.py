@@ -284,12 +284,18 @@ class BinnedGrower:
                  min_split_improvement: float, reg_lambda: float = 0.0,
                  reg_alpha: float = 0.0, use_hess_denom: bool = False,
                  monotone: np.ndarray | None = None,
-                 axis_name: str | None = None):
+                 axis_name: str | None = None,
+                 int8_stats: bool | None = None):
         # axis_name: mesh axis the row dimension is sharded over. grow() then
         # runs shard-local and merges per-level histograms with ONE psum —
         # the reduce-tree of ScoreBuildHistogram.java:98 / MRTask.java:907
         # riding ICI. Split search stays replicated (identical on all shards).
         self.axis_name = axis_name
+        # int8_stats: quantize (w, wg, wh) to int8 per tree and accumulate
+        # histograms on the 2x-rate int8 MXU path with exact i32 sums
+        # (PERF_NOTES item 2; quantum |g|max/127 — same error class as the
+        # bf16 inputs of the f32 kernel). Auto: on wherever Pallas runs.
+        self.int8 = HP.use_pallas() if int8_stats is None else bool(int8_stats)
         self.spec = spec
         self.D = int(max_depth)
         self.L = 2 ** self.D
@@ -311,7 +317,8 @@ class BinnedGrower:
         (per-shard when the rows axis is sharded over `shards` devices)."""
         return padded_rows(n, shards)
 
-    def grow(self, codes, stats, F, *, eta, clip_val, key, mtries: int = 0):
+    def grow(self, codes, stats, F, *, eta, clip_val, key, mtries: int = 0,
+             tree_mask=None):
         """Grow ONE tree and apply its margin update — all device-resident.
 
         codes: (C_pad, n_pad) i32 bin codes, COLUMN-major (dummy rows
@@ -343,8 +350,26 @@ class BinnedGrower:
         hi = jnp.full(1, big)
         any_cat = bool(spec.is_cat.any())
         zerovt = jnp.zeros((8, 128), jnp.float32)
+        if self.int8:
+            # per-tree, per-stat-row symmetric quantization: stats are fixed
+            # for the whole tree, so ONE quantization pass serves every level
+            absmax = jnp.max(jnp.abs(stats), axis=1, keepdims=True)  # (S,1)
+            if self.axis_name:
+                # the quantum must be GLOBAL or shards' i32 sums would mix
+                # incompatible scales inside the psum
+                absmax = lax.pmax(absmax, self.axis_name)
+            scale = 127.0 / jnp.maximum(absmax, 1e-30)
+            stats_in = jnp.clip(jnp.round(stats * scale),
+                                -127, 127).astype(jnp.int32)
+            inv = jnp.maximum(absmax, 1e-30)[:, 0] / 127.0           # (S,)
+            hist_fn = HP.sbh_hist_i8
+        else:
+            stats_in = stats
+            hist_fn = HP.sbh_hist
         prev = None                    # routing tables of level d-1
-        hist_prev = None               # full histogram of level d-1
+        hist_prev = None               # full histogram of level d-1 (native
+        #                                dtype: i32 when int8 — sibling
+        #                                subtraction stays exact)
         did_prev = None                # split mask of level d-1
         for d in range(D):
             L = 1 << d
@@ -356,29 +381,31 @@ class BinnedGrower:
                                        any_cat=any_cat,
                                        na_code=spec.b_val)
             if d == 0:
-                hist = HP.sbh_hist(codes, heap, stats, base=base, L=L,
-                                   n_bins=BP)[:L, :C]
+                hacc = hist_fn(codes, heap, stats_in, base=base, L=L,
+                               n_bins=BP)[:L, :C]
                 if self.axis_name:
                     # the ScoreBuildHistogram reduce: merge shard-local
                     # histograms in one collective per level
-                    hist = lax.psum(hist, self.axis_name)
+                    hacc = lax.psum(hacc, self.axis_name)
             else:
                 # sibling subtraction: histogram LEFT children only (half
                 # the leaf window -> half the MXU dot), derive right =
                 # parent - left. Routing moves every row of a split leaf,
                 # so parent = left + right exactly; unsplit parents are
                 # masked to zero (their child slots are dead).
-                left = HP.sbh_hist(codes, heap, stats, base=base, L=L,
-                                   n_bins=BP, half=True)[: L >> 1, :C]
+                left = hist_fn(codes, heap, stats_in, base=base, L=L,
+                               n_bins=BP, half=True)[: L >> 1, :C]
                 if self.axis_name:
                     # psum BEFORE subtraction: hist_prev is already global
                     left = lax.psum(left, self.axis_name)
                 par = jnp.where(did_prev[:, None, None, None],
-                                hist_prev, 0.0)
+                                hist_prev, jnp.zeros_like(hist_prev))
                 right = par - left
-                hist = jnp.stack([left, right], axis=1) \
+                hacc = jnp.stack([left, right], axis=1) \
                     .reshape(L, *left.shape[1:])
-            hist_prev = hist
+            hist_prev = hacc
+            hist = hacc.astype(jnp.float32) * inv[None, None, :, None] \
+                if self.int8 else hacc
 
             if mtries and mtries < c_real:
                 r = jax.random.uniform(jax.random.fold_in(key, d),
@@ -389,6 +416,10 @@ class BinnedGrower:
             else:
                 cmask = jnp.broadcast_to(
                     (jnp.arange(C) < c_real)[None], (L, C))
+            if tree_mask is not None:
+                # col_sample_rate_per_tree: a whole-tree column subset drawn
+                # by the caller (SharedTree _rand per-tree cols analog)
+                cmask = cmask & tree_mask[None, :]
 
             s = find_splits_binned(
                 hist, self.is_cat_dev, self.mono, cmask, lo, hi,
@@ -493,9 +524,49 @@ def pack_route(route, n_bins, b_val=None):
         -1, dtype=jnp.uint32)
 
 
+def _memo_trainer(grower: BinnedGrower, cache_key, build_run, mesh,
+                  in_specs, out_specs):
+    """Shared trainer finalization: memoize the jitted program on the
+    grower INSTANCE (a global id()-keyed cache can hand a recycled id a
+    stale closure over another grower's bin edges), shard_map over the
+    rows axis when a mesh is given. One definition so an in/out-spec or
+    check_vma change cannot silently diverge across the three trainers."""
+    cache = getattr(grower, "_trainer_cache", None)
+    if cache is None:
+        cache = grower._trainer_cache = {}
+    fn = cache.get(cache_key)
+    if fn is not None:
+        return fn
+    run = build_run()
+    if mesh is not None:
+        if grower.axis_name is None:
+            raise ValueError("mesh given but grower has no axis_name")
+        fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False))
+    else:
+        fn = jax.jit(run)
+    cache[cache_key] = fn
+    return fn
+
+
+def _tree_col_mask(grower: BinnedGrower, key, col_rate_tree: float):
+    """Per-tree column subset (col_sample_rate_per_tree): common key across
+    shards so every shard draws the SAME mask. Returns None when disabled."""
+    if col_rate_tree >= 1.0:
+        return None
+    c_real = int(grower.spec.is_cat.size)
+    C = grower.spec.c_pad
+    k = max(1, int(round(col_rate_tree * c_real)))
+    r = jax.random.uniform(key, (C,))
+    r = jnp.where(jnp.arange(C) < c_real, r, 2.0)
+    kth = jnp.sort(r)[k - 1]
+    return r <= kth
+
+
 def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
                       sample_rate: float, mtries: int, k_trees: int,
-                      clip_val: float = 19.0, mesh=None):
+                      clip_val: float = 19.0, col_rate_tree: float = 1.0,
+                      mesh=None):
     """Build (and cache) the jitted K-tree training program.
 
     Contract: codes (C_pad, n_pad) i32 from `quantize` (n real rows, the
@@ -509,60 +580,176 @@ def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
     level. Split search and the tree arrays are replicated by construction
     (identical on every shard given the global histograms).
     """
-    # cache on the grower INSTANCE: a global id()-keyed cache can hand a
-    # recycled id a stale closure over another grower's bin edges
-    cache = getattr(grower, "_trainer_cache", None)
-    if cache is None:
-        cache = grower._trainer_cache = {}
+    from jax.sharding import PartitionSpec as P
     axis = grower.axis_name if mesh is not None else None
-    if mesh is not None and grower.axis_name is None:
-        raise ValueError("mesh given but grower has no axis_name")
     key_ = (n, dist, eta, sample_rate, mtries, k_trees, clip_val,
-            axis, id(mesh) if mesh is not None else 0)
-    fn = cache.get(key_)
-    if fn is not None:
-        return fn
+            col_rate_tree, axis, id(mesh) if mesh is not None else 0)
 
     gaussian = dist == "gaussian"
     cv = 0.0 if gaussian else clip_val
 
-    def run_body(codes, y1, w1, F, key):
-        def per_tree(carry, k):
-            F, key = carry
-            key, ks, kt = jax.random.split(key, 3)
-            if axis:
-                # decorrelate row sampling across shards; the mtries key kt
-                # stays common so every shard draws the SAME column masks
-                ks = jax.random.fold_in(ks, lax.axis_index(axis))
-            g, h = _grad_hess_binned(dist, F, y1)
-            if sample_rate < 1.0:
+    # NOTE: keep the inner function literally named `run` — the persistent
+    # XLA compile cache keys include the jitted function name, and the big
+    # K-tree program costs minutes to recompile through the relay
+    def build():
+        def run(codes, y1, w1, F, key):
+            def per_tree(carry, k):
+                F, key = carry
+                key, ks, kt = jax.random.split(key, 3)
+                if axis:
+                    # decorrelate row sampling across shards; the mtries key
+                    # kt stays common so every shard draws the SAME col masks
+                    ks = jax.random.fold_in(ks, lax.axis_index(axis))
+                g, h = _grad_hess_binned(dist, F, y1)
+                if sample_rate < 1.0:
+                    u = jax.random.uniform(ks, w1.shape)
+                    wt = w1 * (u < sample_rate)
+                else:
+                    wt = w1
+                stats = jnp.stack(
+                    [wt, wt * g, wt * h, jnp.zeros_like(wt)], axis=0)
+                tmask = _tree_col_mask(grower, jax.random.fold_in(kt, 7),
+                                       col_rate_tree)
+                out = grower.grow(codes, stats, F, eta=eta, clip_val=cv,
+                                  key=kt, mtries=mtries, tree_mask=tmask)
+                F = out["F"]
+                tree = (out["col"], out["bin"], out["nal"],
+                        pack_route(out["route"], grower.spec.n_bins,
+                                   grower.spec.b_val),
+                        out["val"], out["gains"], out["cover"])
+                return (F, key), tree
+
+            (F, _), trees = lax.scan(per_tree, (F, key),
+                                     jnp.arange(k_trees))
+            return F, trees
+        return run
+
+    return _memo_trainer(
+        grower, key_, build, mesh,
+        in_specs=(P(None, axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P()))
+
+
+# ===========================================================================
+# Multinomial boosting: K class trees per iteration through the binned
+# engine (SharedTree.java:548-561 builds the K trees of an iteration as one
+# fused layer; here a lax.scan over classes inside ONE jitted program —
+# codes stay device-resident, each class tree rides every binned
+# optimization incl. the histogram psum and int8 stats).
+def gbm_multi_chunk_trainer(grower: BinnedGrower, n: int, *, n_classes: int,
+                            eta: float, sample_rate: float, mtries: int,
+                            k_iters: int, clip_val: float = 19.0,
+                            col_rate_tree: float = 1.0, mesh=None):
+    """K-class K-tree-per-iteration program. F is (n_pad, K) margins;
+    y1 is (n_pad,) class ids (f32); returns (F, stacked trees with leading
+    dims (k_iters, K, ...))."""
+    from jax.sharding import PartitionSpec as P
+    axis = grower.axis_name if mesh is not None else None
+    key_ = ("multi", n, n_classes, eta, sample_rate, mtries, k_iters,
+            clip_val, col_rate_tree, axis, id(mesh) if mesh is not None else 0)
+
+    K = int(n_classes)
+    kscale = (K - 1) / K       # GammaPass multinomial leaf scale (GBM.java)
+
+    def build():
+        def run(codes, y1, w1, F, key):
+            onehot = jax.nn.one_hot(y1.astype(jnp.int32), K)   # (n_pad, K)
+
+            def per_iter(carry, it):
+                F, key = carry
+                key, ks, kt = jax.random.split(key, 3)
+                if axis:
+                    ks = jax.random.fold_in(ks, lax.axis_index(axis))
+                probs = jax.nn.softmax(F, axis=1)
+                RK = onehot - probs                            # residuals
+                if sample_rate < 1.0:
+                    u = jax.random.uniform(ks, w1.shape)
+                    wt = w1 * (u < sample_rate)
+                else:
+                    wt = w1
+                tmask = _tree_col_mask(grower, jax.random.fold_in(kt, 7),
+                                       col_rate_tree)
+
+                def per_class(_, k):
+                    res = jnp.take_along_axis(RK, k[None, None], 1)[:, 0]
+                    absr = jnp.abs(res)
+                    hess = absr * (1.0 - absr)   # |res|(1-|res|) GammaPass
+                    stats = jnp.stack([wt, wt * res * kscale, wt * hess,
+                                       jnp.zeros_like(wt)], axis=0)
+                    out = grower.grow(codes, stats, jnp.zeros_like(wt),
+                                      eta=1.0, clip_val=clip_val,
+                                      key=jax.random.fold_in(kt, k),
+                                      mtries=mtries, tree_mask=tmask)
+                    tree = (out["col"], out["bin"], out["nal"],
+                            pack_route(out["route"], grower.spec.n_bins,
+                                       grower.spec.b_val),
+                            out["val"], out["gains"], out["cover"])
+                    return None, (tree, out["F"])  # F==val[heap]: row pred
+
+                _, (trees, dF) = lax.scan(per_class, None, jnp.arange(K))
+                F = F + eta * dF.T                              # (n_pad, K)
+                return (F, key), trees
+
+            (F, _), trees = lax.scan(per_iter, (F, key),
+                                     jnp.arange(k_iters))
+            return F, trees
+        return run
+
+    return _memo_trainer(
+        grower, key_, build, mesh,
+        in_specs=(P(None, axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P()))
+
+
+# ===========================================================================
+# DRF: independent trees, leaf = in-bag response mean, OOB accumulation
+# (hex/tree/drf/DRF.java:78 doOOBScoring()=true — the reference default).
+def drf_chunk_trainer(grower: BinnedGrower, n: int, *, sample_rate: float,
+                      mtries: int, k_trees: int, col_rate_tree: float = 1.0,
+                      mesh=None):
+    """Per tree: Bernoulli(sample_rate) in-bag mask; stats (w, w*y, w) so
+    the Newton leaf value wg/wh is exactly the in-bag mean response (class
+    frequency for 0/1 targets — ScoreBuildHistogram response-mean leaves);
+    grow() with F=0, eta=1 returns per-row leaf values, accumulated into
+    (oob_sum, oob_cnt) on OOB rows only. Returns (oob_sum, oob_cnt, trees)."""
+    from jax.sharding import PartitionSpec as P
+    axis = grower.axis_name if mesh is not None else None
+    key_ = ("drf", n, sample_rate, mtries, k_trees, col_rate_tree, axis,
+            id(mesh) if mesh is not None else 0)
+
+    def build():
+        def run(codes, y1, w1, oob_sum, oob_cnt, key):
+            def per_tree(carry, t):
+                oob_sum, oob_cnt, key = carry
+                key, ks, kt = jax.random.split(key, 3)
+                if axis:
+                    ks = jax.random.fold_in(ks, lax.axis_index(axis))
                 u = jax.random.uniform(ks, w1.shape)
-                wt = w1 * (u < sample_rate)
-            else:
-                wt = w1
-            stats = jnp.stack(
-                [wt, wt * g, wt * h, jnp.zeros_like(wt)], axis=0)
-            out = grower.grow(codes, stats, F, eta=eta, clip_val=cv,
-                              key=kt, mtries=mtries)
-            F = out["F"]
-            tree = (out["col"], out["bin"], out["nal"],
-                    pack_route(out["route"], grower.spec.n_bins,
-                               grower.spec.b_val),
-                    out["val"], out["gains"], out["cover"])
-            return (F, key), tree
+                inbag = u < sample_rate
+                wt = w1 * inbag
+                stats = jnp.stack([wt, wt * y1, wt, jnp.zeros_like(wt)],
+                                  axis=0)
+                tmask = _tree_col_mask(grower, jax.random.fold_in(kt, 7),
+                                       col_rate_tree)
+                out = grower.grow(codes, stats, jnp.zeros_like(wt),
+                                  eta=1.0, clip_val=0.0,
+                                  key=kt, mtries=mtries, tree_mask=tmask)
+                pred = out["F"]                       # per-row leaf value
+                oob = (~inbag) & (w1 > 0)
+                oob_sum = oob_sum + jnp.where(oob, pred, 0.0)
+                oob_cnt = oob_cnt + oob.astype(jnp.float32)
+                tree = (out["col"], out["bin"], out["nal"],
+                        pack_route(out["route"], grower.spec.n_bins,
+                                   grower.spec.b_val),
+                        out["val"], out["gains"], out["cover"])
+                return (oob_sum, oob_cnt, key), tree
 
-        (F, _), trees = lax.scan(per_tree, (F, key), jnp.arange(k_trees))
-        return F, trees
+            (oob_sum, oob_cnt, _), trees = lax.scan(
+                per_tree, (oob_sum, oob_cnt, key), jnp.arange(k_trees))
+            return oob_sum, oob_cnt, trees
+        return run
 
-    if axis:
-        from jax.sharding import PartitionSpec as P
-        run = jax.jit(jax.shard_map(
-            run_body, mesh=mesh,
-            in_specs=(P(None, axis), P(axis), P(axis), P(axis), P()),
-            out_specs=(P(axis), P()),
-            check_vma=False))
-    else:
-        run = jax.jit(run_body)
-
-    cache[key_] = run
-    return run
+    return _memo_trainer(
+        grower, key_, build, mesh,
+        in_specs=(P(None, axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P()))

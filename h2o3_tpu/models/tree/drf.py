@@ -4,10 +4,14 @@ Reference: DRF.java (357 LoC): independent trees on sampled rows (sample_rate
 0.632 without replacement), mtries column sampling per node (−1 → √C for
 classification, C/3 for regression), leaves predict in-leaf response means
 (class frequency for classification); ensemble prediction is the average.
-OOB scoring (reference default) is replaced by on-sample metrics this round.
+OOB scoring is the reference default (DRF.java:78 doOOBScoring()=true):
+regression/binomial runs ride the binned engine's drf_chunk_trainer which
+accumulates (oob_sum, oob_cnt) per row inside the jitted K-tree program,
+and the reported training metrics come from those held-out rows.
 
-TPU-native: per-node mtries is drawn per (level, leaf) inside the fused level
-program (engine._level_step) from the tree's PRNG key — no host RNG.
+TPU-native: per-node mtries is drawn per (level, leaf) inside the fused
+level program from the tree's PRNG key — no host RNG; trees of a chunk run
+in ONE lax.scan dispatch; multinomial (K>2) stays on the adaptive engine.
 """
 
 from __future__ import annotations
@@ -29,7 +33,19 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
     _defaults.update({"sample_rate": 0.632, "max_depth": 20, "ntrees": 50,
                       "min_rows": 1.0, "binomial_double_trees": False})
 
+    def _resolve_mtries(self, C, K):
+        mtries = int(self.params.get("mtries") or -1)
+        if mtries == -1:
+            return max(1, int(math.sqrt(C))) if K > 1 else max(1, C // 3)
+        if mtries <= 0:
+            return C
+        return mtries
+
     def _fit(self, frame: Frame, job):
+        ht = str(self.params.get("histogram_type") or "AUTO").lower()
+        if (self.nclasses <= 2 and int(self.params["max_depth"]) <= 10
+                and ht in ("auto", "quantilesglobal", "binned")):
+            return self._fit_binned_drf(frame, job)
         X, y, w = self._prep(frame)
         C = X.shape[1]
         K = self.nclasses
@@ -37,13 +53,11 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
         seed = int(self.params.get("seed") or -1)
         key = jax.random.PRNGKey(seed if seed > 0 else 42)
         grower = self._grower()
-        mtries = int(self.params.get("mtries") or -1)
-        if mtries == -1:
-            mtries = max(1, int(math.sqrt(C))) if K > 1 else max(1, C // 3)
-        elif mtries <= 0:
-            mtries = C
+        mtries = self._resolve_mtries(C, K)
         sample_rate = float(self.params["sample_rate"])
         gains_tot = jnp.zeros(C, jnp.float32)
+        oob_sum = jnp.zeros(X.shape[0], jnp.float32)
+        oob_cnt = jnp.zeros(X.shape[0], jnp.float32)
         if K > 2:
             onehot = jax.nn.one_hot(y.astype(jnp.int32), K)
             trees_k = [[] for _ in range(K)]
@@ -65,20 +79,97 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
             trees = []
             for t in range(ntrees):
                 key, k1, k2 = jax.random.split(key, 3)
-                wt = self._sample_weights(w, k1, sample_rate)
+                u = jax.random.uniform(k1, w.shape)
+                inbag = u < sample_rate
+                wt = w * inbag
                 col, thr, nal, val, heap, g = grower.grow(X, wt, y, key=k2,
                                                           mtries=mtries)
                 gains_tot = gains_tot + g
+                # OOB accumulation (doOOBScoring, DRF.java:78): rows held
+                # out of this tree's bag vote with val[heap]
+                oob = (~inbag) & (w > 0)
+                oob_sum = oob_sum + jnp.where(oob, val[heap], 0.0)
+                oob_cnt = oob_cnt + oob.astype(jnp.float32)
                 trees.append((col, thr, nal, val,
                               E.node_covers(heap, wt, nodes=grower.nodes,
                                             D=grower.D)))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
             self._trees = E.stack_trees(trees, grower.D)
+            self._oob_metrics = self._metrics_from_oob(oob_sum, oob_cnt,
+                                                       y, w)
         self._varimp_from_gains(np.asarray(gains_tot, np.float64))
         self._output.model_summary = {
             "number_of_trees": ntrees, "max_depth": grower.D,
             "mtries": mtries, "sample_rate": sample_rate,
+            "oob_scored": K <= 2,
         }
+
+    # ---- binned fast path (depth <= 10): OOB inside the jitted program ---
+    def _fit_binned_drf(self, frame: Frame, job):
+        p = self.params
+        ctx = self._binned_setup(frame)
+        BN, grower = ctx["BN"], ctx["grower"]
+        y, w, y1, w1 = ctx["y"], ctx["w"], ctx["y1"], ctx["w1"]
+        n, C, n_pad = ctx["n"], ctx["C"], ctx["n_pad"]
+        K = self.nclasses
+        ntrees = int(p["ntrees"])
+        seed = int(p.get("seed") or -1)
+        key = jax.random.PRNGKey(seed if seed >= 0 else 42)
+        mtries = self._resolve_mtries(C, K)
+        sample_rate = float(p["sample_rate"])
+        col_rate_tree = float(p.get("col_sample_rate_per_tree") or 1.0)
+        oob_sum = jnp.zeros(n_pad, jnp.float32)
+        oob_cnt = jnp.zeros(n_pad, jnp.float32)
+        if ctx["multi"]:
+            oob_sum = jax.device_put(oob_sum, ctx["cl"].rows_sharding(1))
+            oob_cnt = jax.device_put(oob_cnt, ctx["cl"].rows_sharding(1))
+        interval = max(1, int(p.get("score_tree_interval") or 5))
+        chunks = []
+        done = 0
+        while done < ntrees:
+            k = min(interval, ntrees - done)
+            trainer = BN.drf_chunk_trainer(
+                grower, n, sample_rate=sample_rate, mtries=mtries,
+                k_trees=k, col_rate_tree=col_rate_tree, mesh=ctx["mesh"])
+            key, kc = jax.random.split(key)
+            oob_sum, oob_cnt, trees = trainer(ctx["codes"], y1, w1,
+                                              oob_sum, oob_cnt, kc)
+            chunks.append(trees)
+            done += k
+            job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
+
+        self._trees, gainsT = self._binned_tree_arrays(ctx, chunks)
+        self._oob_metrics = self._metrics_from_oob(
+            oob_sum[:n], oob_cnt[:n], y, w)
+        self._varimp_from_gains(np.asarray(gainsT[:C], np.float64))
+        self._output.model_summary = {
+            "number_of_trees": int(self._trees.ntrees),
+            "max_depth": grower.D, "mtries": mtries,
+            "sample_rate": sample_rate, "engine": "binned_pallas",
+            "oob_scored": True,
+        }
+
+    def _metrics_from_oob(self, oob_sum, oob_cnt, y, w):
+        """Metrics over rows that were OOB for >= 1 tree, weighted as in
+        training; the reference reports these as the model's training
+        metrics when doOOBScoring() (ScoreBuildHistogram OOB rows)."""
+        from h2o3_tpu.models import metrics as M
+        has = oob_cnt > 0
+        pred = oob_sum / jnp.maximum(oob_cnt, 1.0)
+        wm = w * has
+        if self._is_classifier:
+            # clip away exact 0/1 votes so logloss stays finite (rows OOB
+            # for few trees produce degenerate vote fractions)
+            p = jnp.clip(pred, 1e-7, 1.0 - 1e-7)
+            return M.binomial_metrics(y, p, wm,
+                                      domain=self._dinfo.response_domain)
+        return M.regression_metrics(y, pred, wm)
+
+    def _score_train_valid(self, frame, valid):
+        super()._score_train_valid(frame, valid)
+        if getattr(self, "_oob_metrics", None) is not None:
+            # doOOBScoring()=true: the reported training metrics are OOB
+            self._output.training_metrics = self._oob_metrics
 
     def _contrib_scale_bias(self):
         # DRF prediction is the tree average (probability space for binomial)

@@ -348,13 +348,24 @@ def sbh_hist(codesT, heap, stats, *, base, L, n_bins, half=False):
                         half=half)
 
 
+def sbh_hist_i8(codesT, heap, stats_i8, *, base, L, n_bins, half=False):
+    """int8-stats histogram: i32 in [-127,127] per stat row, i32 out (exact
+    accumulation). The XLA fallback is the same segment-sum with integer
+    dtype passthrough — bit-identical semantics for the CPU tests."""
+    if use_pallas():
+        return sbh_hist_pallas_i8(codesT, heap, stats_i8, base=base, L=L,
+                                  n_bins=n_bins, half=half)
+    return sbh_hist_xla(codesT, heap, stats_i8, base=base, L=L,
+                        n_bins=n_bins, half=half)
+
+
 # ===========================================================================
 # int8 histogram variant: one-hot (exact in i8) x per-stat-quantized stats
 # on the v5e's 2x-rate int8 MXU path, int32 accumulation (exact: 127 * 11M
 # rows < 2^31), dequantized by the caller. Same grid/window structure as
 # the bf16 kernel.
 def _hist_kernel_i8(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
-                    n_bins, gwe, r_blk):
+                    n_bins, gwe, r_blk, half=False):
     R = r_blk
     p = pl.program_id(0)
     j = pl.program_id(2)
@@ -364,8 +375,17 @@ def _hist_kernel_i8(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
         out_ref[...] = jnp.zeros_like(out_ref)
 
     heap = heap_ref[0, :]
-    slot = heap - (base + p * gwe)
-    inw = (slot >= 0) & (slot < gwe) & (heap - base < L)
+    leaf = heap - base
+    if half:
+        # left children only (even leaf index): window slot = leaf >> 1;
+        # the caller derives right = parent - left EXACTLY (i32 arithmetic
+        # makes sibling subtraction lossless, unlike bf16)
+        slot = (leaf >> 1) - p * gwe
+        inw = (leaf >= 0) & (leaf < L) & ((leaf & 1) == 0)
+    else:
+        slot = leaf - p * gwe
+        inw = (leaf >= 0) & (leaf < L)
+    inw = inw & (slot >= 0) & (slot < gwe)
     slot_c = jnp.where(inw, slot, 0)
     iota_s = lax.broadcasted_iota(jnp.int32, (gwe, R), 0)
     sel = (iota_s == slot_c[None, :]) & inw[None, :]          # (gwe, R)
@@ -385,19 +405,22 @@ def _hist_kernel_i8(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
     out_ref[...] = acc + jnp.stack(parts)[None]
 
 
-@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins"))
-def sbh_hist_pallas_i8(codesT, heap, stats_i8, *, base, L, n_bins):
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
+def sbh_hist_pallas_i8(codesT, heap, stats_i8, *, base, L, n_bins,
+                       half=False):
     """stats_i8 (S, n_pad) int32 holding values in [-127, 127] (i32 input
     dtype: Mosaic's (1, R) int8 blocks don't meet the 32-sublane granule;
     the kernel casts to i8 in VMEM). Returns int32 histogram."""
     c_pad, n_pad = codesT.shape
-    gwe = min(L, GW)
-    npass = max(1, -(-L // gwe))
+    l_eff = (L + 1) // 2 if half else L
+    gwe = min(l_eff, GW)
+    npass = max(1, -(-l_eff // gwe))
     ncb = c_pad // COL_TILE
     r_blk = BLOCK_ROWS if gwe * S_STATS <= 256 else BLOCK_ROWS // 2
     nblk = n_pad // r_blk
     kernel = functools.partial(_hist_kernel_i8, base=base, L=L,
-                               n_bins=n_bins, gwe=gwe, r_blk=r_blk)
+                               n_bins=n_bins, gwe=gwe, r_blk=r_blk,
+                               half=half)
     out = pl.pallas_call(
         kernel,
         grid=(npass, ncb, nblk),

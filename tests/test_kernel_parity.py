@@ -1,0 +1,22 @@
+"""TPU kernel parity gate — thin pytest wrapper over ops/parity.py.
+
+Run on TPU:  JAX_PLATFORMS=axon pytest tests/test_kernel_parity.py
+(bench.py also executes the same check as a pre-step; off-TPU these skip.)
+"""
+
+import pytest
+
+from h2o3_tpu.ops import hist_pallas as HP
+from h2o3_tpu.ops.parity import kernel_parity_check
+
+pytestmark = pytest.mark.skipif(
+    not HP.use_pallas(), reason="Pallas kernels only run on TPU backends")
+
+
+def test_kernel_parity():
+    devs = kernel_parity_check(seed=0)
+    assert devs  # every assert inside already ran
+
+
+def test_kernel_parity_second_seed():
+    kernel_parity_check(seed=1234)
