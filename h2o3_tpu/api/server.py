@@ -507,6 +507,12 @@ ROUTES = [
     (re.compile(r"/3/Shutdown"), "POST", _h_shutdown),
 ]
 
+# extended surface (frame munging, diagnostics, artifacts, validation —
+# RequestServer.java:76 registers ~150 routes; the long tail lives there)
+from h2o3_tpu.api import routes_ext as _ext  # noqa: E402
+
+ROUTES += _ext.build_routes()
+
 
 class H2OServer:
     """Controller-side API server (h2o.init() + jetty in one)."""

@@ -67,17 +67,24 @@ def _steps(seed: int):
 
 
 class Leaderboard:
-    """leaderboard/Leaderboard.java: models ranked by CV metric."""
+    """leaderboard/Leaderboard.java: models ranked by CV metric — or by
+    metrics on a held-out `leaderboard_frame` when one is supplied
+    (Leaderboard.java scoring on the leaderboard frame)."""
 
-    def __init__(self, sort_metric: str, decreasing: bool):
+    def __init__(self, sort_metric: str, decreasing: bool,
+                 leaderboard_frame=None):
         self.sort_metric = sort_metric
         self.decreasing = decreasing
+        self.leaderboard_frame = leaderboard_frame
         self.rows: list = []
 
     def add(self, name, model):
-        src = (model._output.cross_validation_metrics
-               or model._output.validation_metrics
-               or model._output.training_metrics)
+        if self.leaderboard_frame is not None:
+            src = model._compute_metrics(self.leaderboard_frame)
+        else:
+            src = (model._output.cross_validation_metrics
+                   or model._output.validation_metrics
+                   or model._output.training_metrics)
         row = {"model_id": model.key, "step": name}
         for k in ("auc", "logloss", "mean_per_class_error", "rmse", "mse",
                   "pr_auc", "error", "mae"):
@@ -102,9 +109,12 @@ class H2OAutoML:
                  seed: int = -1, nfolds: int = 5, sort_metric: str = "AUTO",
                  exclude_algos=None, include_algos=None, project_name=None,
                  balance_classes: bool = False,
-                 keep_cross_validation_predictions: bool = True):
+                 keep_cross_validation_predictions: bool = True,
+                 max_runtime_secs_per_model: float = 0.0,
+                 recovery_dir: str | None = None):
         self.max_models = max_models
         self.max_runtime_secs = max_runtime_secs
+        self.max_runtime_secs_per_model = max_runtime_secs_per_model
         self.seed = seed
         self.nfolds = nfolds
         self.sort_metric = sort_metric
@@ -112,6 +122,7 @@ class H2OAutoML:
         self.include_algos = ({a.lower() for a in include_algos}
                               if include_algos else None)
         self.project_name = project_name or DKV.make_key("automl")
+        self.recovery_dir = recovery_dir
         DKV.put(self.project_name, self)
         self.leaderboard_obj = None
         self.event_log: list = []
@@ -130,26 +141,52 @@ class H2OAutoML:
             metric = ("auc" if ncls == 2 else
                       "mean_per_class_error" if is_cls else "rmse")
         decreasing = metric in ("auc", "pr_auc", "accuracy", "f1")
-        lb = Leaderboard(metric.lower(), decreasing)
+        lb = Leaderboard(metric.lower(), decreasing,
+                         leaderboard_frame=leaderboard_frame)
         self.leaderboard_obj = lb
         t0 = time.time()
         built = 0
         se_candidates = []
-        for name, cls, params in _steps(self.seed):
-            algo = cls.algo
-            if self.include_algos is not None and algo not in self.include_algos:
-                continue
-            if algo in self.exclude_algos:
-                continue
-            if self.max_models and built >= self.max_models:
-                break
-            if self.max_runtime_secs and time.time() - t0 > self.max_runtime_secs:
-                self._log("time budget exhausted")
-                break
+
+        # recovery (Recovery.java:55 + -auto_recovery_dir H2O.java:411):
+        # reload finished models of a killed run, skip their steps
+        recovery = None
+        recovered = set()
+        if self.recovery_dir:
+            from h2o3_tpu.io.persist import Recovery
+            recovery = Recovery(self.recovery_dir)
+            recovery.resume()
+            recovered = set(recovery.recovered_model_keys())
+            if training_frame is not None:
+                recovery.checkpoint_frame(training_frame)
+
+        def over_budget():
+            return (self.max_runtime_secs
+                    and time.time() - t0 > self.max_runtime_secs)
+
+        def run_step(name, cls, params):
+            nonlocal built
             p = dict(params)
             p["nfolds"] = self.nfolds
             p["keep_cross_validation_predictions"] = True
             p["model_id"] = f"{self.project_name}_{name}"
+            # per-model budget (AutoML.java time allocation): the smaller of
+            # the per-model cap and the remaining global budget
+            caps = [c for c in (self.max_runtime_secs_per_model,
+                                (self.max_runtime_secs
+                                 - (time.time() - t0)
+                                 if self.max_runtime_secs else 0.0))
+                    if c and c > 0]
+            if caps:
+                p["max_runtime_secs"] = max(1.0, min(caps))
+            if p["model_id"] in recovered:
+                m = DKV.get(p["model_id"])
+                if m is not None:
+                    self._log(f"recovered {name}")
+                    lb.add(name, m)
+                    se_candidates.append(m)
+                    built += 1
+                    return m
             try:
                 self._log(f"building {name}")
                 m = cls(**p)
@@ -158,32 +195,150 @@ class H2OAutoML:
                 lb.add(name, m)
                 se_candidates.append(m)
                 built += 1
+                if recovery is not None:
+                    recovery.checkpoint_model(m)
+                return m
             except Exception as ex:  # noqa: BLE001 — a failed step is logged
                 self._log(f"step {name} failed: {ex!r}")
+                return None
+
+        for name, cls, params in _steps(self.seed):
+            algo = cls.algo
+            if self.include_algos is not None and algo not in self.include_algos:
+                continue
+            if algo in self.exclude_algos:
+                continue
+            if self.max_models and built >= self.max_models:
+                break
+            if over_budget():
+                self._log("time budget exhausted")
+                break
+            run_step(name, cls, params)
+
+        # ---- grid steps (the two default grids of AutoML.java planWork:
+        # GBM + DeepLearning random-discrete grids) ------------------------
+        gbm_allowed = ("gbm" not in self.exclude_algos
+                       and (self.include_algos is None
+                            or "gbm" in self.include_algos))
+        if (not over_budget() and gbm_allowed
+                and (self.max_models == 0 or built < self.max_models)):
+            self._run_grid_steps(lb, se_candidates, x, y, training_frame,
+                                 validation_frame, t0, recovery)
+            built = len(se_candidates)
+
+        # ---- exploitation phase (ModelingStep.DynamicStep "exploitation
+        # ratio": fine-tune the current best GBM with more, slower trees) --
+        if not over_budget() and lb.leader is not None:
+            self._run_exploitation(lb, se_candidates, x, y, training_frame,
+                                   validation_frame, recovery)
         # Stacked ensembles (best-of-family + all) when ≥2 base models
         if len(se_candidates) >= 2 and "stackedensemble" not in self.exclude_algos:
-            try:
-                from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
-                best_of_family = {}
-                for (row, m) in lb.rows:
+            from h2o3_tpu.models.ensemble import H2OStackedEnsembleEstimator
+            # best-of-family over CV-capable candidates only (the ensemble
+            # needs every base model's fold predictions)
+            best_of_family = {}
+            cand = {m.key for m in se_candidates}
+            for (row, m) in lb.rows:
+                if m.key in cand:
                     best_of_family.setdefault(m.algo, m)
-                for se_name, base in (
-                        ("StackedEnsemble_BestOfFamily",
-                         list(best_of_family.values())),
-                        ("StackedEnsemble_AllModels", se_candidates)):
-                    if len(base) < 2:
-                        continue
+            for se_name, base in (
+                    ("StackedEnsemble_BestOfFamily",
+                     list(best_of_family.values())),
+                    ("StackedEnsemble_AllModels", se_candidates)):
+                if len(base) < 2:
+                    continue
+                try:   # one failed ensemble must not kill the other
                     self._log(f"building {se_name}")
                     se = H2OStackedEnsembleEstimator(
                         base_models=base,
                         model_id=f"{self.project_name}_{se_name}")
                     se.train(y=y, training_frame=training_frame)
                     lb.add(se_name, se)
-            except Exception as ex:  # noqa: BLE001
-                self._log(f"stacking failed: {ex!r}")
+                except Exception as ex:  # noqa: BLE001
+                    self._log(f"{se_name} failed: {ex!r}")
         self.leader = lb.leader
         self._log(f"done: {built} base models; leader={lb.leader.key if lb.leader else None}")
         return self
+
+    # ------------------------------------------------------------------
+    def _run_grid_steps(self, lb, se_candidates, x, y, training_frame,
+                        validation_frame, t0, recovery):
+        """The AutoML plan's grid steps: a RandomDiscrete GBM grid under
+        the remaining time/model budget (AutoML.java planWork grids)."""
+        from h2o3_tpu.models.grid import H2OGridSearch
+        from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator
+        budget_left = (self.max_runtime_secs - (time.time() - t0)
+                       if self.max_runtime_secs else 0)
+        if self.max_runtime_secs and budget_left < 5.0:
+            return          # a sub-5s leftover cannot fit a model build
+        room = (self.max_models - len(se_candidates)
+                if self.max_models else 3)
+        if room <= 0:
+            return
+        try:
+            self._log("building GBM_grid_1")
+            grid = H2OGridSearch(
+                H2OGradientBoostingEstimator,
+                hyper_params={"max_depth": [4, 7, 10],
+                              "learn_rate": [0.05, 0.1],
+                              "sample_rate": [0.6, 0.9]},
+                grid_id=f"{self.project_name}_GBM_grid_1",
+                search_criteria={"strategy": "RandomDiscrete",
+                                 "max_models": min(room, 3),
+                                 "max_runtime_secs": budget_left,
+                                 "seed": self.seed},
+                recovery_dir=self.recovery_dir)
+            grid.train(x=x, y=y, training_frame=training_frame,
+                       validation_frame=validation_frame,
+                       nfolds=self.nfolds,
+                       keep_cross_validation_predictions=True,
+                       ntrees=40, seed=self.seed if self.seed > 0 else 1)
+            for i, m in enumerate(grid.models):
+                lb.add(f"GBM_grid_1_model_{i}", m)
+                se_candidates.append(m)
+                if recovery is not None:
+                    recovery.checkpoint_model(m)
+        except Exception as ex:  # noqa: BLE001
+            self._log(f"grid step failed: {ex!r}")
+
+    def _run_exploitation(self, lb, se_candidates, x, y, training_frame,
+                          validation_frame, recovery):
+        """Exploitation: continue the best tree model with more trees via
+        checkpoint restart (the learn-rate-annealing exploitation step of
+        the reference plan).
+
+        The continued model trains WITHOUT CV (a checkpoint restart cannot
+        re-fold), so it only enters the leaderboard when ranking happens on
+        a common held-out frame (leaderboard_frame / validation) — training
+        metrics would compare optimistically against the others' CV
+        metrics. It never joins se_candidates (no cv predictions)."""
+        leader = lb.leader
+        if getattr(leader, "algo", None) not in ("gbm", "xgboost"):
+            return
+        holdout = (lb.leaderboard_frame is not None
+                   or validation_frame is not None)
+        if not holdout:
+            self._log("exploitation skipped: no held-out frame to rank "
+                      "a non-CV continuation fairly")
+            return
+        try:
+            self._log("exploitation: continuing leader")
+            cls = leader.__class__
+            p = {k: v for k, v in leader.params.items() if v is not None}
+            p["ntrees"] = int(p.get("ntrees") or 50) + 25
+            p["checkpoint"] = leader.key
+            p["model_id"] = f"{self.project_name}_{leader.algo}_exploit"
+            p["nfolds"] = 0
+            p.pop("keep_cross_validation_predictions", None)
+            p.pop("keep_cross_validation_fold_assignment", None)
+            m = cls(**p)
+            m.train(x=x, y=y, training_frame=training_frame,
+                    validation_frame=validation_frame)
+            lb.add("exploitation", m)
+            if recovery is not None:
+                recovery.checkpoint_model(m)
+        except Exception as ex:  # noqa: BLE001
+            self._log(f"exploitation failed: {ex!r}")
 
     @property
     def leaderboard(self):

@@ -1,0 +1,3 @@
+"""Client-bindings codegen — h2o-bindings analog (gen_python.py et al.)."""
+
+from h2o3_tpu.bindings.gen import gen_python  # noqa: F401

@@ -40,6 +40,11 @@ class Job:
         self.status = CREATED
         self.progress = 0.0
         self.progress_msg = ""
+        # max_runtime_secs: absolute deadline; builders poll
+        # `budget_exhausted` at their update() cadence and stop gracefully,
+        # keeping the partial model (SharedTree stop_requested semantics)
+        self.deadline: Optional[float] = None
+        self.budget_exhausted = False
         self.exception: Optional[BaseException] = None
         self.traceback: Optional[str] = None
         self.start_time = 0.0
@@ -94,6 +99,8 @@ class Job:
         self.progress = float(progress)
         if msg:
             self.progress_msg = msg
+        if self.deadline is not None and time.time() > self.deadline:
+            self.budget_exhausted = True
         if self._stop_requested.is_set():
             raise JobCancelled()
 

@@ -188,6 +188,8 @@ class H2ODeepLearningEstimator(ModelBase):
                 history.append({"samples": (s + 1) * mb,
                                 "epochs": (s + 1) * mb / n,
                                 "training_loss": float(l)})
+                if job.budget_exhausted:
+                    break
                 job.update(0.1 + 0.8 * (s + 1) / nsteps,
                            f"epoch {(s+1)*mb/n:.2f}")
         self._params_net = params

@@ -2,7 +2,10 @@
 
 Reference: GridSearch.java:69 (driver; `_parallelism` :73), cartesian and
 RandomDiscrete hyperspace walkers, grid keyed in DKV, failure tolerance (a
-failed model doesn't kill the grid), checkpointable.
+failed model doesn't kill the grid), and recovery: with `recovery_dir` set
+every finished model is auto-checkpointed (hex/faulttolerance/Recovery.java:55
++ GridSearch recovery) and a restarted controller resumes the grid where it
+died instead of rebuilding finished models.
 
 TPU-native: `parallelism` (GridSearch.java:73) builds N models concurrently
 from controller threads — XLA async dispatch interleaves their device
@@ -25,7 +28,8 @@ from h2o3_tpu.core.kvstore import DKV
 
 class H2OGridSearch:
     def __init__(self, model, hyper_params: dict, grid_id=None,
-                 search_criteria=None, parallelism: int = 1):
+                 search_criteria=None, parallelism: int = 1,
+                 recovery_dir: str | None = None):
         # `model` may be an estimator class or an instance carrying defaults
         if isinstance(model, type):
             self._cls = model
@@ -40,6 +44,7 @@ class H2OGridSearch:
         self.models: list = []
         self.failures: list = []
         self.parallelism = max(1, int(parallelism))
+        self.recovery_dir = recovery_dir
         self._lock = threading.Lock()
         DKV.put(self.grid_id, self)
 
@@ -51,6 +56,11 @@ class H2OGridSearch:
         combos = [dict(zip(keys, c)) for c in itertools.product(*values)]
         if strat == "RandomDiscrete":
             seed = int(self.search_criteria.get("seed", -1))
+            if seed <= 0 and self.recovery_dir:
+                # recovery skips combos BY INDEX: the shuffle must reproduce
+                # across a restart, so derive a stable seed from the grid id
+                import zlib
+                seed = zlib.crc32(self.grid_id.encode()) or 1
             rng = np.random.default_rng(seed if seed > 0 else None)
             rng.shuffle(combos)
             mx = self.search_criteria.get("max_models")
@@ -63,19 +73,48 @@ class H2OGridSearch:
         max_secs = float(self.search_criteria.get("max_runtime_secs", 0) or 0)
         t0 = time.time()
 
+        # recovery (Recovery.java:55): persist inputs up-front, reload any
+        # models a previous (killed) run already finished, skip their combos
+        recovery = None
+        recovered = set()
+        if self.recovery_dir:
+            from h2o3_tpu.io.persist import Recovery
+            recovery = Recovery(self.recovery_dir)
+            recovery.resume()
+            # only THIS grid's models: the recovery dir may be shared with
+            # a surrounding AutoML run (its base models live there too)
+            prefix = f"{self.grid_id}_model_"
+            recovered = {k for k in recovery.recovered_model_keys()
+                         if k.startswith(prefix)}
+            for key in recovered:
+                prev = DKV.get(key)
+                if prev is not None and prev.key not in \
+                        {m.key for m in self.models}:
+                    with self._lock:
+                        self.models.append(prev)
+            if training_frame is not None:
+                recovery.checkpoint_frame(training_frame)
+            if validation_frame is not None:
+                recovery.checkpoint_frame(validation_frame)
+
         def build(i, combo):
             if max_secs and time.time() - t0 > max_secs:
                 return                     # budget elapsed while queued
+            model_id = f"{self.grid_id}_model_{i}"
+            if model_id in recovered:
+                return                     # finished before the restart
             params = dict(self._base_params)
             params.update(kw)
             params.update(combo)
-            params["model_id"] = f"{self.grid_id}_model_{i}"
+            params["model_id"] = model_id
             try:
                 m = self._cls(**params)
                 m.train(x=x, y=y, training_frame=training_frame,
                         validation_frame=validation_frame)
                 with self._lock:
                     self.models.append(m)
+                if recovery is not None:
+                    recovery.checkpoint_model(m)
             except Exception as ex:  # noqa: BLE001 — grid tolerates failures
                 with self._lock:
                     self.failures.append({"params": combo,

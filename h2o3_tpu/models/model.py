@@ -282,6 +282,9 @@ class ModelBase:
                                    response_domain=self._dinfo.response_domain)
         job = Job(description=f"{self.algo} on {frame.key}", dest=self.key)
         t0 = time.time()
+        mrs = float(self.params.get("max_runtime_secs") or 0.0)
+        if mrs > 0:
+            job.deadline = t0 + mrs
 
         def work(job: Job):
             if int(self.params["nfolds"] or 0) > 1 or self.params.get("fold_column"):

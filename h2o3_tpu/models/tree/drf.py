@@ -74,6 +74,8 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                                                      nodes=grower.nodes,
                                                      D=grower.D)))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+                if job.budget_exhausted:
+                    break
             self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
         else:
             trees = []
@@ -94,6 +96,8 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                               E.node_covers(heap, wt, nodes=grower.nodes,
                                             D=grower.D)))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+                if job.budget_exhausted:
+                    break
             self._trees = E.stack_trees(trees, grower.D)
             self._oob_metrics = self._metrics_from_oob(oob_sum, oob_cnt,
                                                        y, w)
@@ -137,6 +141,8 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
             chunks.append(trees)
             done += k
             job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
+            if job.budget_exhausted:
+                break
 
         self._trees, gainsT = self._binned_tree_arrays(ctx, chunks)
         self._oob_metrics = self._metrics_from_oob(

@@ -420,7 +420,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             done += k
             self._record_history(done, F[:n], y, w, dist)
             job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
-            if self._should_stop():
+            if self._should_stop() or job.budget_exhausted:
                 break
 
         self._trees, gainsT = self._binned_tree_arrays(ctx, chunks,
@@ -497,7 +497,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             done += k
             self._record_history_multi(done, F[:n], y, w)
             job.update(0.1 + 0.8 * done / ntrees, f"iter {done}")
-            if self._should_stop():
+            if self._should_stop() or job.budget_exhausted:
                 break
 
         # chunks hold (iters, K, ...) arrays; split into per-class ensembles

@@ -31,7 +31,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 ROWS = "rows"
 MODEL = "model"
 
-_lock = threading.Lock()
+# RLock: cloud() calls init() while already holding the lock (first-use
+# formation path) — a plain Lock deadlocks every standalone server start
+_lock = threading.RLock()
 _CLOUD: "Cloud | None" = None
 
 

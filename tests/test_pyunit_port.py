@@ -1,0 +1,243 @@
+"""Acceptance slice modeled on the reference's pyunit suites
+(h2o-py/tests/testdir_munging + testdir_algos behaviors, re-authored from
+scratch against this framework's client surface — SURVEY §4 item 4, the
+"ported pyunit" parity ladder). Each test mirrors the BEHAVIOR a reference
+pyunit checks, through h2o3_tpu.client (the h2o-py analog).
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import client as h2o
+from h2o3_tpu.client import H2OFrame
+import h2o3_tpu.models as models
+from h2o3_tpu.core.frame import Frame
+
+
+@pytest.fixture()
+def df():
+    rng = np.random.default_rng(7)
+    n = 400
+    return H2OFrame({
+        "a": rng.normal(0, 1, n),
+        "b": rng.normal(5, 2, n),
+        "g": np.array(["u", "v", "w"], object)[rng.integers(0, 3, n)],
+        "i": rng.integers(0, 10, n).astype(float),
+    })
+
+
+# ---- munging (testdir_munging behaviors) --------------------------------
+def test_munging_slice_and_filter(df):
+    sub = df[df["a"] > 0]
+    assert 0 < sub.nrows < df.nrows
+    assert float(sub["a"].min()) > 0
+    two = df[["a", "b"]]
+    assert two.names == ["a", "b"]
+
+
+def test_munging_arithmetic_and_assign(df):
+    df["c"] = df["a"] * 2 + df["b"]
+    got = float(df["c"].mean())
+    want = 2 * float(df["a"].mean()) + float(df["b"].mean())
+    assert abs(got - want) < 1e-5
+
+
+def test_munging_group_by(df):
+    g = df.group_by("g").mean("a").count().get_frame()
+    assert g.nrows == 3
+    assert "mean_a" in g.names or any("mean" in c for c in g.names)
+
+
+def test_munging_merge():
+    left = H2OFrame({"k": [1.0, 2.0, 3.0], "x": [10.0, 20.0, 30.0]})
+    right = H2OFrame({"k": [2.0, 3.0, 4.0], "y": [200.0, 300.0, 400.0]})
+    m = left.merge(right)
+    arr = m.as_data_frame()
+    assert set(arr["k"]) == {2.0, 3.0}
+
+
+def test_munging_cbind_rbind(df):
+    c = df[["a"]].cbind(df[["b"]])
+    assert c.ncols == 2 and c.nrows == df.nrows
+    r = df[["a"]].rbind(df[["a"]])
+    assert r.nrows == 2 * df.nrows
+
+
+def test_munging_impute():
+    a = np.array([1.0, np.nan, 3.0, np.nan, 5.0])
+    f = H2OFrame({"x": a})
+    f.impute("x", method="mean")
+    vals = f.as_data_frame()["x"].to_numpy()
+    assert not np.isnan(vals).any()
+    assert abs(vals[1] - 3.0) < 1e-6
+
+
+def test_munging_quantile(df):
+    q = df[["a"]].frame
+    from h2o3_tpu.rapids.rapids import rapids_exec
+    out = rapids_exec(f"(quantile {q.key} [0.25 0.5 0.75] \"interpolate\")")
+    med = out.vecs[1].to_numpy()[1]
+    ref = np.quantile(df.as_data_frame()["a"].to_numpy(), 0.5)
+    assert abs(med - ref) < 1e-4
+
+
+def test_munging_sort_unique_table(df):
+    s = df.sort("a")
+    arr = s.as_data_frame()["a"].to_numpy()
+    assert (np.diff(arr) >= -1e-9).all()
+    u = df[["g"]].unique()
+    assert u.nrows == 3
+    t = df[["g"]].table()
+    tt = t.as_data_frame()
+    assert tt[tt.columns[-1]].sum() == df.nrows
+
+
+def test_munging_ifelse_and_scale(df):
+    from h2o3_tpu.rapids.rapids import rapids_exec
+    fr = df.frame
+    out = rapids_exec(f"(ifelse (> (cols {fr.key} [0]) 0) 1 0)")
+    vals = out.vecs[0].to_numpy()[: fr.nrows]
+    a = df.as_data_frame()["a"].to_numpy()
+    np.testing.assert_array_equal(vals, (a > 0).astype(float))
+    sc = df[["a", "b"]].scale()
+    m = float(sc["b"].mean())
+    assert abs(m) < 1e-5
+
+
+def test_munging_asfactor_levels(df):
+    f = df[["i"]].asfactor()
+    lv = f.levels()
+    assert len(lv[0] if isinstance(lv[0], list) else lv) == 10
+
+
+def test_munging_na_handling():
+    f = H2OFrame({"x": [1.0, np.nan, 3.0], "y": [np.nan, 2.0, 3.0]})
+    na = f.isna()
+    assert float(na.sum()) == 2.0
+
+
+def test_munging_split_frame(df):
+    tr, te = df.split_frame(ratios=[0.8], seed=42)
+    assert tr.nrows + te.nrows == df.nrows
+    assert abs(tr.nrows - 0.8 * df.nrows) < 0.1 * df.nrows
+
+
+# ---- algos (testdir_algos behaviors) ------------------------------------
+def _classif_frame(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(0, 1, (n, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["no", "yes"], object)[y]
+    return Frame.from_dict(cols), X, y
+
+
+def test_algo_gbm_train_valid_metrics():
+    f, X, y = _classif_frame()
+    tr_idx = np.arange(0, 400)
+    va_idx = np.arange(400, 500)
+    cols = {nm: f.vec(nm).to_numpy()[:500] for nm in f.names if nm != "y"}
+    lab = np.array(["no", "yes"], object)[y]
+    ftr = Frame.from_dict({**{k: v[tr_idx] for k, v in cols.items()},
+                           "y": lab[tr_idx]})
+    fva = Frame.from_dict({**{k: v[va_idx] for k, v in cols.items()},
+                           "y": lab[va_idx]})
+    m = models.H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1)
+    m.train(y="y", training_frame=ftr, validation_frame=fva)
+    assert m._output.training_metrics.auc > 0.85
+    assert m._output.validation_metrics.auc > 0.75
+
+
+def test_algo_gbm_varimp_finds_signal():
+    f, _, _ = _classif_frame()
+    m = models.H2OGradientBoostingEstimator(ntrees=10, max_depth=3, seed=1)
+    m.train(y="y", training_frame=f)
+    vi = m.varimp()
+    assert vi[0]["variable"] in ("x0", "x1")
+    assert vi[0]["percentage"] > 0.3
+
+
+def test_algo_glm_coefficient_signs():
+    rng = np.random.default_rng(3)
+    n = 600
+    X = rng.normal(0, 1, (n, 3))
+    yv = 2.0 * X[:, 0] - 1.0 * X[:, 1] + rng.normal(0, 0.1, n)
+    f = Frame.from_dict({"x0": X[:, 0], "x1": X[:, 1], "x2": X[:, 2],
+                         "y": yv})
+    m = models.H2OGeneralizedLinearEstimator(family="gaussian", lambda_=0.0)
+    m.train(y="y", training_frame=f)
+    coef = m.coef()
+    assert coef["x0"] > 1.5 and coef["x1"] < -0.5
+    assert abs(coef["x2"]) < 0.2
+
+
+def test_algo_glm_regularization_shrinks():
+    rng = np.random.default_rng(4)
+    n = 300
+    X = rng.normal(0, 1, (n, 5))
+    yv = X[:, 0] + rng.normal(0, 0.5, n)
+    f = Frame.from_dict({**{f"x{j}": X[:, j] for j in range(5)}, "y": yv})
+    free = models.H2OGeneralizedLinearEstimator(family="gaussian",
+                                                lambda_=0.0)
+    free.train(y="y", training_frame=f)
+    reg = models.H2OGeneralizedLinearEstimator(family="gaussian",
+                                               lambda_=10.0, alpha=0.0)
+    reg.train(y="y", training_frame=f)
+    l2_free = sum(v * v for k, v in free.coef().items() if k != "Intercept")
+    l2_reg = sum(v * v for k, v in reg.coef().items() if k != "Intercept")
+    assert l2_reg < l2_free
+
+
+def test_algo_kmeans_recovers_clusters():
+    rng = np.random.default_rng(5)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], float)
+    X = np.concatenate([rng.normal(0, 0.5, (100, 2)) + c for c in centers])
+    f = Frame.from_dict({"x": X[:, 0], "y": X[:, 1]})
+    m = models.H2OKMeansEstimator(k=3, seed=1, standardize=False)
+    m.train(training_frame=f)
+    got = np.sort(np.asarray(m.centers()), axis=0)
+    want = np.sort(centers, axis=0)
+    assert np.abs(got - want).max() < 1.0
+
+
+def test_algo_pca_variance_concentrates():
+    rng = np.random.default_rng(6)
+    n = 300
+    t = rng.normal(0, 3, n)
+    X = np.stack([t + rng.normal(0, 0.1, n),
+                  -t + rng.normal(0, 0.1, n),
+                  rng.normal(0, 0.1, n)], axis=1)
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(3)})
+    m = models.H2OPrincipalComponentAnalysisEstimator(k=3)
+    m.train(training_frame=f)
+    pct = m._output.model_summary["proportion_of_variance"]
+    assert pct[0] > 0.9
+
+
+def test_algo_quantile_model():
+    rng = np.random.default_rng(8)
+    yv = rng.exponential(2.0, 2000)
+    f = Frame.from_dict({"y": yv})
+    from h2o3_tpu.models.quantile import frame_quantiles
+    probs, out = frame_quantiles(f, probs=[0.1, 0.5, 0.9])
+    got = np.asarray(out["y"]).ravel()
+    ref = np.quantile(yv, [0.1, 0.5, 0.9])
+    np.testing.assert_allclose(got, ref, rtol=0.1)
+
+
+def test_algo_isolation_forest_ranks_outliers():
+    rng = np.random.default_rng(9)
+    X = rng.normal(0, 1, (400, 3))
+    X[:8] += 10.0
+    f = Frame.from_dict({f"x{j}": X[:, j] for j in range(3)})
+    m = models.H2OIsolationForestEstimator(ntrees=40, max_depth=8, seed=2)
+    m.train(training_frame=f)
+    s = m.predict(f).vec("predict").to_numpy()[:400]
+    assert s[:8].mean() > np.quantile(s, 0.9)
+
+
+def test_algo_naive_bayes_classifies():
+    f, _, _ = _classif_frame(seed=11)
+    m = models.H2ONaiveBayesEstimator()
+    m.train(y="y", training_frame=f)
+    assert m._output.training_metrics.auc > 0.8

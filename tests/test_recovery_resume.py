@@ -1,0 +1,60 @@
+"""Recovery wiring: a killed grid resumes where it died.
+
+Reference: hex/faulttolerance/Recovery.java:55 + GridSearch recovery —
+every finished model is auto-checkpointed to recovery_dir; a restarted
+controller reloads them and only builds the remaining combos.
+"""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.models.grid import H2OGridSearch
+from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator as GBM
+
+
+@pytest.fixture()
+def train_frame():
+    rng = np.random.default_rng(0)
+    n = 300
+    X = rng.normal(0, 1, (n, 3))
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = X[:, 0] + 0.5 * X[:, 1] + rng.normal(0, 0.1, n)
+    f = Frame.from_dict(cols, key="recov_train")
+    yield f
+    DKV.remove("recov_train")
+
+
+def test_grid_killed_and_resumed(train_frame, tmp_path, monkeypatch):
+    hyper = {"max_depth": [2, 3], "learn_rate": [0.1, 0.2]}
+    calls = {"n": 0}
+    orig_train = GBM.train
+
+    def flaky_train(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise KeyboardInterrupt("controller killed")  # not a tolerated
+        return orig_train(self, *a, **k)                  # model failure
+
+    monkeypatch.setattr(GBM, "train", flaky_train)
+
+    g1 = H2OGridSearch(GBM, hyper, grid_id="recov_grid",
+                       recovery_dir=str(tmp_path))
+    with pytest.raises(KeyboardInterrupt):
+        g1.train(y="y", training_frame=train_frame, ntrees=3, seed=1)
+    assert len(g1.models) == 2          # combos 0 and 1 finished pre-kill
+
+    # simulate a fresh controller: the in-memory registry is gone
+    for key in list(DKV.keys()):
+        if key.startswith("recov_grid"):
+            DKV.remove(key)
+
+    g2 = H2OGridSearch(GBM, hyper, grid_id="recov_grid",
+                       recovery_dir=str(tmp_path))
+    g2.train(y="y", training_frame=train_frame, ntrees=3, seed=1)
+    assert len(g2.models) == 4          # 2 recovered + 2 freshly built
+    # the two finished combos were NOT retrained: only combos 2 and 3 ran
+    assert calls["n"] == 5
+    ids = sorted(m.key for m in g2.models)
+    assert ids == [f"recov_grid_model_{i}" for i in range(4)]
