@@ -157,10 +157,14 @@ def _h_typeahead(h):
 
 # ===========================================================================
 # sessions (v4)
+_SID_COUNTER = [0]
+
+
 def _h_sessions_post(h):
     from h2o3_tpu.rapids import Session
     from h2o3_tpu.api import server as _srv
-    sid = f"_sid{len(_srv._sessions) + 1}_{int(time.time())}"
+    _SID_COUNTER[0] += 1          # monotonic: a deleted session's id is
+    sid = f"_sid{_SID_COUNTER[0]}_{int(time.time())}"   # never reissued
     _srv._sessions[sid] = Session(sid)
     h._send({"__meta": {"schema_type": "SessionIdV4"}, "session_key": sid})
 

@@ -313,7 +313,9 @@ class H2OAutoML:
         metrics would compare optimistically against the others' CV
         metrics. It never joins se_candidates (no cv predictions)."""
         leader = lb.leader
-        if getattr(leader, "algo", None) not in ("gbm", "xgboost"):
+        # gbm only: the xgboost estimator rejects `checkpoint` so its
+        # continuation would fail on every run
+        if getattr(leader, "algo", None) != "gbm":
             return
         holdout = (lb.leaderboard_frame is not None
                    or validation_frame is not None)

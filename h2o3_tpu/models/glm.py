@@ -45,7 +45,7 @@ GAUSSIAN, BINOMIAL, QUASIBINOMIAL, POISSON, GAMMA, TWEEDIE, NEGBINOMIAL, \
 _CANONICAL_LINK = {GAUSSIAN: "identity", BINOMIAL: "logit",
                    QUASIBINOMIAL: "logit", POISSON: "log", GAMMA: "inverse",
                    TWEEDIE: "tweedie", NEGBINOMIAL: "log",
-                   MULTINOMIAL: "multinomial"}
+                   MULTINOMIAL: "multinomial", ORDINAL: "ologit"}
 
 
 def _linkinv(link, eta, tweedie_link_power=1.0):
@@ -118,11 +118,16 @@ def _soft(x, t):
     return math.copysign(max(abs(x) - t, 0.0), x)
 
 
-def _cod_solve(G, q, lam, alpha, p_pen, beta0, tol=1e-8, max_sweeps=1000):
+def _cod_solve(G, q, lam, alpha, p_pen, beta0, tol=1e-8, max_sweeps=1000,
+               lo=None, hi=None):
     """Cyclic coordinate descent on the Gram (GLM.java:1870 COD solver).
 
     Minimizes ½βᵀGβ − qᵀβ + λα‖β_pen‖₁ + ½λ(1−α)‖β_pen‖² — host-side, p small.
-    Column p_pen.. (intercept) unpenalized.
+    Column p_pen.. (intercept) unpenalized. With lo/hi given, each
+    coordinate update is clipped into its box — projected coordinate
+    descent, the beta_constraints solver (GLM.java betaConstraints +
+    ADMM.L1Solver bounds; coordinate-wise projection is exact for
+    separable boxes).
     """
     p = len(q)
     beta = beta0.copy()
@@ -136,11 +141,155 @@ def _cod_solve(G, q, lam, alpha, p_pen, beta0, tol=1e-8, max_sweeps=1000):
             if denom <= 0:
                 continue
             nb = _soft(gj, l1) / denom if j < p_pen else gj / denom
+            if lo is not None:
+                nb = min(max(nb, lo[j]), hi[j])
             delta = max(delta, abs(nb - beta[j]))
             beta[j] = nb
         if delta < tol:
             break
     return beta
+
+
+# ---------------------------------------------------------------------------
+# L-BFGS (hex/optimization/L_BFGS.java): limited-memory quasi-Newton on the
+# penalized negative log-likelihood. The gradient is ONE device pass over X
+# (value_and_grad of a fused jitted NLL); the two-loop recursion runs on the
+# controller over (m=10)-deep histories of p-sized vectors. The reference
+# uses L-BFGS for wide problems and multinomial (GLM.java:1787 defaults);
+# like the reference, only the L2 part of the penalty is handled (alpha's
+# L1 requires the COD/IRLS path).
+def _lbfgs(value_grad, x0, max_iter=200, m=10, tol=1e-7):
+    x = np.asarray(x0, np.float64)
+    f, g = value_grad(x)
+    hs, hy, rho = [], [], []
+    for _ in range(max_iter):
+        # two-loop recursion
+        qv = g.copy()
+        al = []
+        for s, yv, r in zip(reversed(hs), reversed(hy), reversed(rho)):
+            a = r * s.dot(qv)
+            al.append(a)
+            qv -= a * yv
+        gamma = (hs[-1].dot(hy[-1]) / max(hy[-1].dot(hy[-1]), 1e-12)
+                 if hs else 1.0)
+        qv *= gamma
+        for (s, yv, r), a in zip(zip(hs, hy, rho), reversed(al)):
+            b = r * yv.dot(qv)
+            qv += (a - b) * s
+        d = -qv
+        gtd = g.dot(d)
+        if gtd > -1e-14:        # not a descent direction: restart steepest
+            d = -g
+            gtd = -g.dot(g)
+        # backtracking Armijo line search
+        t = 1.0
+        for _ls in range(30):
+            fn, gn = value_grad(x + t * d)
+            if math.isfinite(fn) and fn <= f + 1e-4 * t * gtd:
+                break
+            t *= 0.5
+        else:
+            break
+        xn = x + t * d
+        s = xn - x
+        yv = gn - g
+        if abs(f - fn) < tol * max(1.0, abs(f)):
+            x, f, g = xn, fn, gn
+            break
+        sy = s.dot(yv)
+        if sy > 1e-10:
+            hs.append(s)
+            hy.append(yv)
+            rho.append(1.0 / sy)
+            if len(hs) > m:
+                hs.pop(0)
+                hy.pop(0)
+                rho.pop(0)
+        x, f, g = xn, fn, gn
+        if np.max(np.abs(g)) < tol:
+            break
+    return x, f
+
+
+def _nll_value_grad(fam, Xi, y, w, *, K=1, l2=0.0, p_pen=0,
+                    theta=1.0):
+    """Jitted penalized NLL value+grad over flat params (one device pass).
+    Multinomial params are (K*p1,); others (p1,). Likelihoods are the
+    canonical/log-link forms — _resolve_solver only routes those (fam,
+    link) pairs here; every other link stays on IRLS."""
+    p1 = Xi.shape[1]
+    yi = y.astype(jnp.int32)
+
+    @jax.jit
+    def vg(flat):
+        flat = flat.astype(jnp.float32)
+        if fam == MULTINOMIAL:
+            B = flat.reshape(K, p1)
+            logits = Xi @ B.T
+            lse = jax.nn.logsumexp(logits, axis=1)
+            py = jnp.take_along_axis(logits, yi[:, None], 1)[:, 0]
+            nll = (w * (lse - py)).sum()
+            pen = 0.5 * l2 * (B[:, :p_pen] ** 2).sum()
+        else:
+            eta = Xi @ flat
+            if fam in (BINOMIAL, QUASIBINOMIAL):
+                nll = (w * (jax.nn.softplus(eta) - y * eta)).sum()
+            elif fam == POISSON:
+                nll = (w * (jnp.exp(eta) - y * eta)).sum()
+            elif fam == GAMMA:
+                mu = jnp.exp(eta)
+                nll = (w * (y / jnp.clip(mu, 1e-8) + eta)).sum()
+            elif fam == NEGBINOMIAL:
+                mu = jnp.exp(eta)
+                nll = (w * ((y + 1.0 / theta)
+                            * jnp.log1p(theta * mu) - y * eta)).sum()
+            else:                       # gaussian / tweedie quad approx
+                nll = 0.5 * (w * (y - eta) ** 2).sum()
+            pen = 0.5 * l2 * (flat[:p_pen] ** 2).sum()
+        return nll + pen
+
+    gv = jax.jit(jax.value_and_grad(vg))
+
+    def value_grad(x):
+        f, g = gv(jnp.asarray(x, jnp.float32))
+        return float(f), np.asarray(g, np.float64)
+
+    return value_grad
+
+
+def _ordinal_value_grad(Xi, yi_np, w, K, l2=0.0, p_pen=0):
+    """Cumulative-logit (proportional odds) NLL: P(y<=k) = sigmoid(t_k - eta)
+    with ordered thresholds t_0 < ... < t_{K-2} parameterized as
+    t_0, t_0+exp(d_1), ... so ordering holds by construction
+    (GLM.java ordinal family — here an exact MLE via L-BFGS, TPU-jitted)."""
+    p = Xi.shape[1] - 1                  # ordinal model has NO free
+    Xb = Xi[:, :p]                       # intercept: thresholds play t_k
+    yi = jnp.asarray(yi_np.astype(np.int32))
+
+    @jax.jit
+    def vg(flat):
+        flat = flat.astype(jnp.float32)
+        beta = flat[:p]
+        t0 = flat[p]
+        steps = jnp.exp(jnp.clip(flat[p + 1:], -30, 30))
+        thr = t0 + jnp.concatenate([jnp.zeros(1), jnp.cumsum(steps)])
+        eta = Xb @ beta                                  # (n,)
+        cum = jax.nn.sigmoid(thr[None, :] - eta[:, None])   # (n, K-1)
+        cum_full = jnp.concatenate(
+            [jnp.zeros((cum.shape[0], 1)), cum,
+             jnp.ones((cum.shape[0], 1))], axis=1)       # (n, K+1)
+        pk = jnp.clip(jnp.diff(cum_full, axis=1), 1e-12, 1.0)
+        py = jnp.take_along_axis(pk, yi[:, None], 1)[:, 0]
+        nll = -(w * jnp.log(py)).sum()
+        return nll + 0.5 * l2 * (beta[:p_pen] ** 2).sum()
+
+    gv = jax.jit(jax.value_and_grad(vg))
+
+    def value_grad(x):
+        f, g = gv(jnp.asarray(x, jnp.float32))
+        return float(f), np.asarray(g, np.float64)
+
+    return value_grad
 
 
 @dataclass
@@ -162,6 +311,9 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         "theta": 1e-10, "compute_p_values": False, "remove_collinear_columns": False,
         "missing_values_handling": "MeanImputation", "non_negative": False,
         "standardize": True, "prior": -1.0, "max_active_predictors": -1,
+        # beta_constraints: list of {names, lower_bounds, upper_bounds}
+        # rows or a dict {col: (lo, hi)} (GLM.java betaConstraints)
+        "beta_constraints": None,
     }
 
     # ------------------------------------------------------------------
@@ -180,11 +332,191 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         yz = jnp.where(jnp.isnan(y), 0.0, y)
         ones = jnp.ones((X.shape[0], 1), X.dtype)
         Xi = jnp.concatenate([X, ones], axis=1)    # intercept column last
-        if fam == MULTINOMIAL or (fam == "AUTO_MULTI"):
+        solver = self._resolve_solver(fam, Xi.shape[1])
+        self._solver = solver
+        if fam == ORDINAL:
+            self._fit_ordinal(Xi, yz, w, job)
+        elif solver == "L_BFGS":
+            self._fit_lbfgs(Xi, yz, w, job)
+        elif fam == MULTINOMIAL:
             self._fit_multinomial(Xi, yz, w, job)
         else:
             self._fit_irls(Xi, yz, w, job)
         self._build_output(frame)
+
+    def _resolve_solver(self, fam, p1) -> str:
+        """GLM.java:1787 defaultSolver: IRLSM for narrow problems, L_BFGS
+        for wide ones and multinomial with many predictors; explicit
+        `solver` wins. L-BFGS carries only the L2 penalty (like the
+        reference) — L1 requests stay on the COD/IRLS path."""
+        alpha = self.params.get("alpha")
+        alpha = 0.5 if alpha is None else (
+            alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        lam = self.params.get("lambda_") or 0.0
+        if isinstance(lam, (list, tuple)):
+            lam = lam[0] or 0.0
+        has_l1 = (alpha > 0 and (lam or 0) > 0) or \
+            self.params.get("lambda_search")
+        constrained = (has_l1
+                       or self.params.get("beta_constraints") is not None
+                       or self.params.get("non_negative"))
+        # the jitted L-BFGS NLLs cover the canonical/log-link likelihoods;
+        # other links stay on IRLS (which handles any _irls_weights link)
+        lbfgs_link_ok = fam in (MULTINOMIAL,) or (fam, self._link) in {
+            (GAUSSIAN, "identity"), (BINOMIAL, "logit"),
+            (QUASIBINOMIAL, "logit"), (POISSON, "log"), (GAMMA, "log"),
+            (NEGBINOMIAL, "log")}
+        s = str(self.params.get("solver") or "AUTO").upper()
+        if s in ("L_BFGS", "LBFGS"):
+            if constrained:
+                raise ValueError(
+                    "solver=L_BFGS carries only the L2 penalty: it cannot "
+                    "honor L1 (alpha>0 with lambda), beta_constraints or "
+                    "non_negative — use IRLSM/COORDINATE_DESCENT "
+                    "(GLM.java L_BFGS solver restriction)")
+            if fam != ORDINAL and not lbfgs_link_ok:
+                raise ValueError(
+                    f"solver=L_BFGS does not support family={fam} with "
+                    f"link={self._link}; use IRLSM")
+            return "L_BFGS"
+        if s in ("IRLSM", "COORDINATE_DESCENT", "COORDINATE_DESCENT_NAIVE"):
+            return "IRLSM"
+        if fam == ORDINAL:
+            return "L_BFGS"
+        if constrained or not lbfgs_link_ok:
+            return "IRLSM"              # L1/bounds need coordinate descent
+        K = self.nclasses if fam == MULTINOMIAL else 1
+        return "L_BFGS" if p1 * K > 500 else "IRLSM"
+
+    def _beta_bounds(self, p1, p_pen):
+        """Resolve beta_constraints into (lo, hi) arrays or (None, None)."""
+        bc = self.params.get("beta_constraints")
+        nn = self.params.get("non_negative")
+        if bc is None and not nn:
+            return None, None
+        lo = np.full(p1, -np.inf)
+        hi = np.full(p1, np.inf)
+        if nn:
+            lo[:p_pen] = 0.0
+        names = self._dinfo.feature_names
+        if isinstance(bc, Frame):
+            rows = {bc.vec("names").to_numpy()[i]: i
+                    for i in range(bc.nrows)}
+            lob = (bc.vec("lower_bounds").to_numpy()
+                   if "lower_bounds" in bc.names else None)
+            hib = (bc.vec("upper_bounds").to_numpy()
+                   if "upper_bounds" in bc.names else None)
+            for nm, i in rows.items():
+                if nm in names:
+                    j = names.index(nm)
+                    if lob is not None and lob[i] == lob[i]:
+                        lo[j] = lob[i]
+                    if hib is not None and hib[i] == hib[i]:
+                        hi[j] = hib[i]
+        elif isinstance(bc, dict):
+            for nm, (lo_v, hi_v) in bc.items():
+                if nm in names:
+                    j = names.index(nm)
+                    lo[j], hi[j] = lo_v, hi_v
+        elif bc is not None:
+            for row in bc:              # list of dicts (h2o-py style)
+                nm = row.get("names")
+                if nm in names:
+                    j = names.index(nm)
+                    lo[j] = row.get("lower_bounds", -np.inf)
+                    hi[j] = row.get("upper_bounds", np.inf)
+        return lo, hi
+
+    # ------------------------------------------------------------------
+    def _fit_lbfgs(self, Xi, y, w, job):
+        """hex/optimization/L_BFGS.java path: exact penalized MLE by
+        limited-memory quasi-Newton; gradients are one fused device pass."""
+        fam, link = self._family, self._link
+        p1 = Xi.shape[1]
+        p_pen = p1 - 1 if self.params.get("intercept", True) else p1
+        wn = np.asarray(w, np.float64)
+        lam = self.params.get("lambda_") or 0.0
+        if isinstance(lam, (list, tuple)):
+            lam = lam[0] or 0.0
+        alpha = self.params.get("alpha")
+        alpha = 0.5 if alpha is None else (
+            alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
+        l2 = float(lam) * (1 - alpha) * wn.sum()
+        max_it = int(self.params["max_iterations"]) * 4
+        if fam == MULTINOMIAL:
+            K = self.nclasses
+            vg = _nll_value_grad(fam, Xi, y, w, K=K, l2=l2,
+                                 p_pen=p_pen)
+            x0 = np.zeros(K * p1)
+            yi = np.asarray(y, np.float64).astype(int)
+            for c in range(K):
+                pc = (wn * (yi == c)).sum() / max(wn.sum(), 1e-12)
+                x0[c * p1 + p1 - 1] = math.log(max(pc, 1e-6))
+            x, f = _lbfgs(vg, x0, max_iter=max_it)
+            beta = x.reshape(K, p1)
+            self._state = _GLMState(beta=beta, link="multinomial",
+                                    family=MULTINOMIAL)
+        else:
+            vg = _nll_value_grad(fam, Xi, y, w, l2=l2, p_pen=p_pen,
+                                 theta=float(self.params["theta"] or 1.0))
+            x0 = np.zeros(p1)
+            ybar = float((wn * np.asarray(y, np.float64)).sum()
+                         / max(wn.sum(), 1e-12))
+            if fam in (BINOMIAL, QUASIBINOMIAL):
+                yb = min(max(ybar, 1e-6), 1 - 1e-6)
+                x0[-1] = math.log(yb / (1 - yb))
+            elif link == "log":
+                x0[-1] = math.log(max(ybar, 1e-8))
+            else:
+                x0[-1] = ybar
+            x, f = _lbfgs(vg, x0, max_iter=max_it)
+            self._state = _GLMState(beta=x, link=link, family=fam)
+            # Fisher information at the optimum for p-values
+            eta = _eta_pass(Xi, jnp.asarray(x, jnp.float32))
+            wi, _ = _irls_weights(fam, link, eta, y, w,
+                                  self.params["tweedie_variance_power"]
+                                  or 1.5, self.params["theta"])
+            G, _ = _gram_pass(Xi, wi, jnp.zeros_like(eta))
+            self._Gram = np.asarray(G, np.float64)
+            self._wsum = float(wn.sum())
+        job.update(0.7, "L-BFGS converged")
+
+    # ------------------------------------------------------------------
+    def _fit_ordinal(self, Xi, y, w, job):
+        """Proportional-odds cumulative-logit model (ordinal family)."""
+        K = self.nclasses
+        assert K >= 2, "ordinal family needs an ordered factor response"
+        p1 = Xi.shape[1]
+        p = p1 - 1
+        wn = np.asarray(w, np.float64)
+        yi = np.asarray(y, np.float64).astype(int)
+        lam = self.params.get("lambda_") or 0.0
+        if isinstance(lam, (list, tuple)):
+            lam = lam[0] or 0.0
+        l2 = float(lam) * wn.sum()
+        vg = _ordinal_value_grad(Xi, yi, w, K, l2=l2, p_pen=p)
+        # init: thresholds at the empirical cumulative logits
+        x0 = np.zeros(p + K - 1)
+        cum = 0.0
+        prev_t = None
+        for k in range(K - 1):
+            cum += (wn * (yi == k)).sum() / max(wn.sum(), 1e-12)
+            cumc = min(max(cum, 1e-6), 1 - 1e-6)
+            tk = math.log(cumc / (1 - cumc))
+            if k == 0:
+                x0[p] = tk
+            else:
+                x0[p + k] = math.log(max(tk - prev_t, 1e-3))
+            prev_t = tk
+        x, f = _lbfgs(vg, x0, max_iter=int(self.params["max_iterations"]) * 4)
+        self._ord_beta = x[:p]
+        t0 = x[p]
+        self._ord_thr = t0 + np.concatenate(
+            [[0.0], np.cumsum(np.exp(x[p + 1:]))])
+        # store beta in the common shape (intercept slot carries t_0)
+        beta = np.concatenate([x[:p], [t0]])
+        self._state = _GLMState(beta=beta, link="ologit", family=ORDINAL)
+        job.update(0.7, "ordinal converged")
 
     def _resolve_family(self) -> str:
         fam = self.params.get("family", "AUTO")
@@ -236,6 +568,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         G, q = _gram_pass(Xi, wi, z)
         Gn, qn = np.asarray(G, np.float64), np.asarray(q, np.float64)
         alpha, lams = self._alpha_lambda(Gn, qn - Gn @ beta, p_pen)
+        lo, hi = self._beta_bounds(p1, p_pen)
         max_it = int(self.params["max_iterations"])
         beps = float(self.params["beta_epsilon"])
         path = []
@@ -248,16 +581,16 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                 G, q = _gram_pass(Xi, wi, z)
                 Gn = np.asarray(G, np.float64)
                 qn = np.asarray(q, np.float64)
-                if alpha > 0 and lam > 0:
-                    # objective is (1/N)·deviance + λ·pen ⇒ scale λ by Σw
-                    nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha, p_pen, beta)
+                if (alpha > 0 and lam > 0) or lo is not None:
+                    # objective is (1/N)·deviance + λ·pen ⇒ scale λ by Σw;
+                    # bounds force the projected-COD solver too
+                    nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha, p_pen,
+                                    beta, lo=lo, hi=hi)
                 else:
                     A = Gn + lam * wn.sum() * (1 - alpha) * np.eye(p1)
                     if p_pen < p1:
                         A[p1 - 1, p1 - 1] = Gn[p1 - 1, p1 - 1]
                     nb = np.linalg.solve(A + 1e-10 * np.eye(p1), qn)
-                if self.params.get("non_negative"):
-                    nb[:p_pen] = np.maximum(nb[:p_pen], 0.0)
                 dmax = float(np.max(np.abs(nb - beta)))
                 beta = nb
                 if fam == GAUSSIAN and link == "identity":
@@ -347,6 +680,15 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         st = self._state
         ones = jnp.ones((X.shape[0], 1), X.dtype)
         Xi = jnp.concatenate([jnp.where(jnp.isnan(X), 0.0, X), ones], axis=1)
+        if st.family == ORDINAL:
+            b = jnp.asarray(self._ord_beta, jnp.float32)
+            thr = jnp.asarray(self._ord_thr, jnp.float32)
+            eta = Xi[:, :-1] @ b
+            cum = jax.nn.sigmoid(thr[None, :] - eta[:, None])
+            cum_full = jnp.concatenate(
+                [jnp.zeros((cum.shape[0], 1)), cum,
+                 jnp.ones((cum.shape[0], 1))], axis=1)
+            return jnp.clip(jnp.diff(cum_full, axis=1), 0.0, 1.0)
         if st.family == MULTINOMIAL:
             B = jnp.asarray(st.beta, jnp.float32)
             return jax.jit(lambda Xi: jax.nn.softmax(Xi @ B.T, axis=1))(Xi)
@@ -368,8 +710,10 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         else:
             coefs = dict(zip(names, st.beta.tolist()))
         self._coefficients_std = coefs
-        # de-standardize for user-facing coefficients (H2O reports both)
-        if di.standardize and st.family != MULTINOMIAL:
+        # de-standardize for user-facing coefficients (H2O reports both);
+        # ordinal keeps standardized coefs (its "Intercept" is threshold t0
+        # whose de-standardization has the opposite sign convention)
+        if di.standardize and st.family not in (MULTINOMIAL, ORDINAL):
             raw = {}
             icept = st.beta[-1]
             ncat = sum(di.cardinalities.get(c, 0) for c in di.cat_cols)
@@ -393,7 +737,9 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                 1 for v in (st.beta.flatten() if st.family == MULTINOMIAL
                             else st.beta[:-1]) if abs(v) > 1e-10)),
         }
-        if self.params.get("compute_p_values") and st.family != MULTINOMIAL:
+        if self.params.get("compute_p_values") \
+                and st.family not in (MULTINOMIAL, ORDINAL) \
+                and getattr(self, "_Gram", None) is not None:
             self._compute_p_values()
 
     def _compute_p_values(self):

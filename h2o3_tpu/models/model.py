@@ -442,6 +442,12 @@ class ModelBase:
                                    if k not in ("nfolds", "model_id",
                                                 "fold_column")})
             mb.params["nfolds"] = 0
+            # the budget is shared by ALL folds + the final build — give
+            # each fold what remains of the parent deadline, not a fresh
+            # full allowance (ModelBuilder CV time allocation)
+            if job.deadline is not None:
+                mb.params["max_runtime_secs"] = max(
+                    1.0, job.deadline - time.time())
             mb.train(x=x, y=y, training_frame=tr)
             cv_models.append(mb)
             pf = mb.predict(te)
