@@ -27,6 +27,7 @@ TPU-native design:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Optional, Sequence
 
@@ -295,6 +296,74 @@ def _rollup_kernel(data, codec, mask):
     return jax.jit(f)(data, mask)
 
 
+@functools.partial(jax.jit, static_argnames=("pad", "n"))
+def _sparse_densify(rows, vals, *, pad, n):
+    """One cached program per (pad, n): a fresh closure here would
+    recompile per call and per column."""
+    base = jnp.where(jnp.arange(pad) < n, 0.0, jnp.nan)
+    return base.at[rows].set(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+class SparseVec(Vec):
+    """Sparse numeric column — the CXIChunk/CXFChunk analog
+    (water/fvec/CXIChunk.java: compressed sparse chunks storing only
+    nonzero (offset, value) pairs; the overwhelming majority of values are
+    an implicit zero).
+
+    Device representation: sorted nonzero row indices (i32) + values (f32).
+    NAs are stored as explicit NaN values at their rows. `as_f32()`
+    densifies on demand (small frames / fallback consumers); wide-sparse
+    compute paths (GLM sparse rows, hex/DataInfo.java:23) consume
+    (nz_rows, nz_vals) directly via Frame.sparse_coo and never densify.
+    """
+
+    def __init__(self, nz_rows, nz_vals, nrows: int, type: str = T_NUM):
+        c = _mesh.cloud()
+        self.nz_rows = jnp.asarray(nz_rows, jnp.int32)
+        self.nz_vals = jnp.asarray(nz_vals, jnp.float32)
+        self._pad = c.padded_rows(nrows)
+        super().__init__(None, Codec("const", const_val=0.0), None,
+                         nrows, type)
+
+    # ---- Vec surface -----------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(self.nz_rows.shape[0])
+
+    @property
+    def padded_len(self) -> int:
+        return self._pad
+
+    def as_f32(self) -> jax.Array:
+        return _sparse_densify(self.nz_rows, self.nz_vals,
+                               pad=self._pad, n=self.nrows)
+
+    def _compute_rollups(self) -> Rollups:
+        v = np.asarray(self.nz_vals)
+        ok = v[~np.isnan(v)]
+        n = self.nrows
+        nas = int(np.isnan(v).sum())
+        implicit_zeros = n - len(v)          # rows absent from nz storage
+        zeros = implicit_zeros + int((ok == 0).sum())
+        cnt = max(n - nas, 1)
+        mean = ok.sum() / cnt
+        var = (ok * ok).sum() / cnt - mean * mean
+        var *= cnt / max(cnt - 1, 1)         # sample sigma like RollupStats
+        if len(ok) == 0:
+            mn = mx = 0.0
+        elif implicit_zeros > 0:             # implicit zeros exist only
+            mn = float(min(ok.min(), 0.0))   # when some row is absent
+            mx = float(max(ok.max(), 0.0))
+        else:
+            mn, mx = float(ok.min()), float(ok.max())
+        return Rollups(
+            min=mn, max=mx,
+            mean=float(mean), sigma=float(math.sqrt(max(var, 0.0))),
+            nas=nas, zeros=int(zeros),
+            is_int=bool(len(ok) == 0 or np.all(ok == np.floor(ok))))
+
+
 # ---------------------------------------------------------------------------
 class Frame:
     """A named, ordered set of equal-length Vecs (Frame.java:64)."""
@@ -414,9 +483,13 @@ class Frame:
         if hit is not None:
             return hit
         vs = [self.vec(c) for c in cols]
-        datas = [v.data for v in vs]
-        masks = [v.mask for v in vs]
-        codecs = tuple(v.codec for v in vs)
+        # sparse columns densify through as_f32 (already decoded f32 with
+        # NaN padding) — _decode_f32 cannot read their data=None layout
+        datas = [v.as_f32() if isinstance(v, SparseVec) else v.data
+                 for v in vs]
+        masks = [None if isinstance(v, SparseVec) else v.mask for v in vs]
+        codecs = tuple(Codec("f32") if isinstance(v, SparseVec) else v.codec
+                       for v in vs)
 
         def build(datas, masks):
             cols_f32 = [_decode_f32(d, c, m)
@@ -427,6 +500,27 @@ class Frame:
         m = jax.jit(build, out_shardings=out_sh)(datas, masks)
         self._matrix_cache[ck] = m
         return m
+
+    def is_sparse(self, cols=None) -> bool:
+        cols = cols if cols is not None else self.names
+        return all(isinstance(self.vec(c), SparseVec) for c in cols)
+
+    def sparse_coo(self, cols=None):
+        """Global COO of sparse columns: (row_idx, col_idx, vals, (n, C))
+        device arrays — the hand-off to sparse-rows compute (the
+        hex/DataInfo.java:23 sparse iterator analog). NaN values mean NA;
+        consumers decide their NA policy (GLM's sparse mode zero-imputes,
+        matching its implicit zeros; mean-centering would densify)."""
+        cols = list(cols if cols is not None else self.names)
+        rows_l, cols_l, vals_l = [], [], []
+        for j, c in enumerate(cols):
+            v = self.vec(c)
+            assert isinstance(v, SparseVec), f"{c} is not sparse"
+            rows_l.append(v.nz_rows)
+            cols_l.append(jnp.full(v.nnz, j, jnp.int32))
+            vals_l.append(v.nz_vals)
+        return (jnp.concatenate(rows_l), jnp.concatenate(cols_l),
+                jnp.concatenate(vals_l), (self.nrows, len(cols)))
 
     # ---- host round-trip -------------------------------------------------
     def to_numpy(self, cols=None) -> np.ndarray:

@@ -309,8 +309,11 @@ class ModelBase:
         else:
             x = [frame.names[i] if isinstance(i, int) else i for i in x]
         if self.params.get("ignore_const_cols"):
+            # SparseVec reuses the "const" codec for its implicit zeros:
+            # it is constant only when it has NO nonzeros at all
             x = [c for c in x
                  if frame.vec(c).type == "str"
+                 or getattr(frame.vec(c), "nnz", 0) > 0
                  or not (frame.vec(c).codec.kind == "const"
                          and frame.vec(c).na_cnt() == 0)]
         return x

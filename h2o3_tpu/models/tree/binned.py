@@ -294,8 +294,10 @@ class BinnedGrower:
         # int8_stats: quantize (w, wg, wh) to int8 per tree and accumulate
         # histograms on the 2x-rate int8 MXU path with exact i32 sums
         # (PERF_NOTES item 2; quantum |g|max/127 — same error class as the
-        # bf16 inputs of the f32 kernel). Auto: on wherever Pallas runs.
-        self.int8 = HP.use_pallas() if int8_stats is None else bool(int8_stats)
+        # bf16 inputs of the f32 kernel). Auto: on where the i8 kernel
+        # proves itself with a probe compile (never brick a TPU gen).
+        self.int8 = HP.i8_supported() if int8_stats is None \
+            else bool(int8_stats)
         self.spec = spec
         self.D = int(max_depth)
         self.L = 2 ** self.D

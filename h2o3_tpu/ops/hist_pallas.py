@@ -70,6 +70,30 @@ def use_pallas() -> bool:
     return _HAVE_PALLAS and jax.default_backend() == "tpu"
 
 
+_I8_OK: bool | None = None
+
+
+def i8_supported() -> bool:
+    """True when the int8 histogram kernel compiles + runs on this chip.
+    Auto-enabling int8 stats must not brick training (or the bench) on a
+    TPU generation whose Mosaic rejects the int8 tiling — probe once with
+    a tiny shape and cache the answer."""
+    global _I8_OK
+    if _I8_OK is None:
+        if not use_pallas():
+            _I8_OK = False
+        else:
+            try:
+                c = jnp.zeros((COL_TILE, BLOCK_ROWS), jnp.int32)
+                h = jnp.zeros(BLOCK_ROWS, jnp.int32)
+                s = jnp.ones((S_STATS, BLOCK_ROWS), jnp.int32)
+                out = sbh_hist_pallas_i8(c, h, s, base=0, L=1, n_bins=128)
+                _I8_OK = int(jnp.sum(out[0, 0, 0])) == BLOCK_ROWS
+            except Exception:  # pragma: no cover - chip-specific
+                _I8_OK = False
+    return _I8_OK
+
+
 # ===========================================================================
 # Phase 1: route rows by the previous level's splits
 def _route_kernel(codesT_ref, heap_ref, tbl_ref, route_ref, valtab_ref,
