@@ -58,13 +58,19 @@ def export_frame(frame: Frame, path: str) -> str:
               "cols": []}
     arrays = {}
     for j, (n, v) in enumerate(zip(frame.names, frame.vecs)):
+        from h2o3_tpu.core.frame import SparseVec
+        is_sparse = isinstance(v, SparseVec)
         c = {"type": v.type, "codec": v.codec.kind, "bias": v.codec.bias,
              "const": None if v.codec.const_val != v.codec.const_val
              else v.codec.const_val,
              "domain": v.levels(), "has_mask": v.mask is not None,
-             "is_str": v.type == "str"}
+             "is_str": v.type == "str", "is_sparse": is_sparse}
         header["cols"].append(c)
-        if v.type == "str":
+        if is_sparse:
+            # CXI-style persist: only the nonzero (row, value) pairs
+            arrays[f"zr{j}"] = np.asarray(v.nz_rows)
+            arrays[f"zv{j}"] = np.asarray(v.nz_vals)
+        elif v.type == "str":
             arrays[f"s{j}"] = np.array([x if x is not None else ""
                                         for x in v.host_data])
             arrays[f"sm{j}"] = np.array([x is None for x in v.host_data])
@@ -104,6 +110,11 @@ def _import_frame_local(path: str, key=None) -> Frame:
         vecs = []
         from h2o3_tpu.parallel import mrtask as mr
         for j, c in enumerate(header["cols"]):
+            if c.get("is_sparse"):
+                from h2o3_tpu.core.frame import SparseVec
+                vecs.append(SparseVec(npz[f"zr{j}"], npz[f"zv{j}"],
+                                      header["nrows"], type=c["type"]))
+                continue
             if c["is_str"]:
                 s = npz[f"s{j}"].astype(object)
                 m = npz[f"sm{j}"]

@@ -440,6 +440,11 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         return lo, hi
 
     def _sparse_path_ok(self) -> bool:
+        # the sparse NLLs are the canonical-link likelihoods only
+        if (self._family, self._link) not in {
+                (GAUSSIAN, "identity"), (BINOMIAL, "logit"),
+                (QUASIBINOMIAL, "logit"), (POISSON, "log")}:
+            return False
         alpha = self.params.get("alpha")
         alpha = 0.5 if alpha is None else (
             alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
@@ -466,6 +471,10 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         sparse mode (mean-centering would densify)."""
         di = self._dinfo
         fam, link = self._family, self._link
+        # the sparse fit is in RAW feature space — dense scoring through
+        # di.matrix must not standardize or every prediction is computed
+        # against coordinates the coefficients never saw
+        di.standardize = False
         ri, ci, vals, (n, C) = frame.sparse_coo(di.predictors)
         # NA -> 0: sparse-mode zero imputation (consistent with the
         # implicit zeros; mean imputation would break sparsity)

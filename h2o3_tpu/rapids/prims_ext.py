@@ -20,6 +20,7 @@ host-side, matching the frame design note.
 
 from __future__ import annotations
 
+import functools
 import math
 import re
 from datetime import datetime, timezone
@@ -259,47 +260,114 @@ def _perfect_auc(a, e):
 
 # ===========================================================================
 # mungers (prims/mungers)
+# ---- module-level jitted munger kernels (a fresh closure per call would
+# recompile per invocation — same rule as frame._sparse_densify) ----------
+@functools.partial(jax.jit, static_argnames=("nb",))
+def _cut_kernel(col, br, *, nb):
+    codes = jnp.searchsorted(br, col, side="left") - 1
+    bad = (codes < 0) | (codes >= nb) | jnp.isnan(col)
+    return jnp.where(bad, jnp.nan, codes.astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("fwd", "maxlen"))
+def _fillna_kernel(M, *, fwd, maxlen):
+    Mi = M if fwd else M[::-1]
+
+    def step(carry, row):
+        last, run = carry
+        isna = jnp.isnan(row)
+        can = (~jnp.isnan(last)) & (run < maxlen)
+        out = jnp.where(isna & can, last, row)
+        new_last = jnp.where(isna, last, row)
+        new_run = jnp.where(isna, jnp.where(can, run + 1, run),
+                            jnp.zeros_like(run))
+        return (new_last, new_run), out
+
+    init = (jnp.full(M.shape[1], jnp.nan), jnp.zeros(M.shape[1], jnp.int32))
+    _, out = jax.lax.scan(step, init, Mi)
+    return out if fwd else out[::-1]
+
+
+@functools.partial(jax.jit, static_argnames=("nv",))
+def _melt_tile(col, *, nv):
+    return jnp.tile(col, nv)
+
+
+@jax.jit
+def _uniq_sorted_count(x):
+    s = jnp.sort(x)
+    newg = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+    return s, newg.sum()
+
+
+@functools.partial(jax.jit, static_argnames=("ui", "uc"))
+def _pivot_fill(uniq_i, iv, inv_c, vv, *, ui, uc):
+    inv_i = jnp.searchsorted(uniq_i, iv).astype(jnp.int32)
+    out = jnp.full(ui * uc, jnp.nan, jnp.float32)
+    return out.at[inv_i * uc + inv_c].set(vv, mode="drop").reshape(ui, uc)
+
+
+@jax.jit
+def _rank_kernel(G, S):
+    n = G.shape[0]
+    keys = tuple(S[:, k] for k in range(S.shape[1] - 1, -1, -1)) + \
+        tuple(G[:, k] for k in range(G.shape[1] - 1, -1, -1))
+    order = jnp.lexsort(keys)
+    Gs = G[order]
+    newg = jnp.concatenate(
+        [jnp.ones(1, bool), jnp.any(Gs[1:] != Gs[:-1], axis=1)])
+    pos = jnp.arange(n)
+    start = jnp.where(newg, pos, 0)
+    start = jax.lax.associative_scan(jnp.maximum, start)
+    rank_sorted = (pos - start + 1).astype(jnp.float32)
+    return jnp.zeros(n, jnp.float32).at[order].set(rank_sorted)
+
+
+def _dev_frame(names, dev_cols, types=None, domains=None):
+    """Frame from device columns (no host round trip — the AstXxx MRTask
+    outputs stay in HBM)."""
+    from h2o3_tpu.core.frame import Vec as _V
+    vecs = []
+    for i, col in enumerate(dev_cols):
+        t = (types or {}).get(i)
+        d = (domains or {}).get(i)
+        vecs.append(_V.from_device_floats(
+            col, vtype=t or (T_CAT if d is not None else T_NUM),
+            domain=d))
+    return Frame(list(names), vecs)
+
+
 @prim("cut")
 def _cut(a, e):
-    """(cut fr breaks labels include.lowest right digits) — AstCut."""
+    """(cut fr breaks labels include.lowest right digits) — AstCut.
+    Device-native: one searchsorted pass; no column readback."""
     fr = _f(_eval(a[0], e))
     breaks = [float(b) for b in _eval(a[1], e)]
-    col = _col0(fr)
-    codes = np.digitize(col, breaks, right=True) - 1
+    n = fr.nrows
+    col = fr.matrix(fr.names[:1])[:n, 0]
     nb = len(breaks) - 1
-    bad = (codes < 0) | (codes >= nb) | np.isnan(col)
+    br = jnp.asarray(breaks, jnp.float32)
     lab = _eval(a[2], e) if len(a) > 2 else None
     if not isinstance(lab, list) or not lab:
         lab = [f"({breaks[i]},{breaks[i+1]}]" for i in range(nb)]
-    out = np.where(bad, np.nan, codes.astype(np.float64))
-    return _new_frame(fr.names[:1], [out], domains={0: [str(x) for x in lab]})
+    return _dev_frame(fr.names[:1], [_cut_kernel(col, br, nb=nb)],
+                      domains={0: [str(x) for x in lab]})
 
 
 @prim("h2o.fillna")
 def _fillna(a, e):
-    """(h2o.fillna fr method axis maxlen) — AstFillNA (forward/backward)."""
+    """(h2o.fillna fr method axis maxlen) — AstFillNA (forward/backward).
+    Device-native: ONE lax.scan over rows carrying (last value, run
+    length) for every column at once — 10M rows never leave HBM."""
     fr = _f(_eval(a[0], e))
     method = str(_eval(a[1], e)) if len(a) > 1 else "forward"
     maxlen = int(_eval(a[3], e)) if len(a) > 3 else 1
-    M = _mat(fr).copy()
-    n = M.shape[0]
-    for j in range(M.shape[1]):
-        col = M[:, j]
-        rng_ = range(n) if method.lower().startswith("f") \
-            else range(n - 1, -1, -1)
-        step = 1 if method.lower().startswith("f") else -1
-        run = 0
-        last = np.nan
-        for i in rng_:
-            if np.isnan(col[i]):
-                if not np.isnan(last) and run < maxlen:
-                    col[i] = last
-                    run += 1
-            else:
-                last = col[i]
-                run = 0
-    return _new_frame(_numeric_cols(fr), [M[:, j]
-                                          for j in range(M.shape[1])])
+    cols = _numeric_cols(fr)
+    n = fr.nrows
+    M = fr.matrix(cols)[:n]
+    fwd = method.lower().startswith("f")
+    out = _fillna_kernel(M, fwd=fwd, maxlen=maxlen)
+    return _dev_frame(cols, [out[:, j] for j in range(len(cols))])
 
 
 @prim("append")
@@ -569,41 +637,89 @@ def _melt(a, e):
     else:
         valv = [c for c in fr.names if c not in idv]
     n = fr.nrows
-    out_cols = {c: np.tile(fr.vec(c).to_numpy()[:n], len(valv))
-                for c in idv}
-    var = np.repeat(np.arange(len(valv), dtype=np.float64), n)
-    val = np.concatenate([fr.vec(c).to_numpy()[:n] for c in valv])
+    nv = len(valv)
+
+    # device-native wide->long: tile/repeat/concat stay in HBM; string id
+    # vars (host-resident by design) tile on host
     names = idv + [var_name, value_name]
-    arrays = [out_cols[c] for c in idv] + [var, val]
-    return _new_frame(names, arrays, domains={len(idv): valv})
+    out_cols, doms, types = [], {len(idv): valv}, {}
+    for i, c in enumerate(idv):
+        v = fr.vec(c)
+        if v.type == T_STR:
+            out_cols.append(np.tile(v.host_data[:n], nv))
+            types[i] = T_STR
+        else:
+            out_cols.append(_melt_tile(fr.matrix([c])[:n, 0], nv=nv))
+            if v.domain is not None:
+                doms[i] = list(v.domain)
+    var = jnp.repeat(jnp.arange(nv, dtype=jnp.float32), n)
+    val = jnp.concatenate([fr.matrix([c])[:n, 0] for c in valv])
+    out_cols += [var, val]
+    if any(isinstance(c, np.ndarray) for c in out_cols):
+        # mixed host/device columns: build Vecs individually
+        vecs = []
+        for i, c in enumerate(out_cols):
+            if isinstance(c, np.ndarray):
+                vecs.append(Vec.from_numpy(c, type=types.get(i)))
+            else:
+                from h2o3_tpu.core.frame import Vec as _V
+                d = doms.get(i)
+                vecs.append(_V.from_device_floats(
+                    c, vtype=T_CAT if d is not None else T_NUM, domain=d))
+        return Frame(names, vecs)
+    return _dev_frame(names, out_cols, domains=doms)
 
 
 @prim("pivot")
 def _pivot(a, e):
-    """(pivot fr index column value) — AstPivot."""
+    """(pivot fr index column value) — AstPivot. Device-native long->wide:
+    the index uniquing is a device sort + boundary flags (only the unique
+    COUNT and the small unique-values vector reach the host); the fill is
+    one device scatter."""
     fr = _f(_eval(a[0], e))
     index = str(_eval(a[1], e))
     column = str(_eval(a[2], e))
     value = str(_eval(a[3], e))
     n = fr.nrows
-    iv = fr.vec(index).to_numpy()[:n]
-    cv = fr.vec(column).to_numpy()[:n]
-    vv = fr.vec(value).to_numpy()[:n]
-    uniq_i, inv_i = np.unique(iv, return_inverse=True)
+    if fr.vec(index).type == T_STR or fr.vec(column).type == T_STR:
+        # string keys live on host by design: host fallback
+        iv = fr.vec(index).to_numpy()[:n]
+        cv = fr.vec(column).to_numpy()[:n]
+        vv = fr.vec(value).to_numpy()[:n]
+        uniq_i, inv_i = np.unique(iv, return_inverse=True)
+        uniq_c, inv_c = np.unique(cv, return_inverse=True)
+        out = np.full((uniq_i.size, uniq_c.size), np.nan)
+        out[inv_i, inv_c] = vv
+        names = [index] + [str(c) for c in uniq_c]
+        arrays = [uniq_i if iv.dtype == object
+                  else uniq_i.astype(np.float64)] + \
+            [out[:, j] for j in range(uniq_c.size)]
+        return _new_frame(names, arrays)
+    iv = fr.matrix([index])[:n, 0]
+    cv = fr.matrix([column])[:n, 0]
+    vv = fr.matrix([value])[:n, 0]
+
+    s, cnt = _uniq_sorted_count(iv)
+    ui = int(cnt)                              # scalar readback only
+    uniq_i = jnp.unique(s, size=ui)            # (ui,) device
+
     cdom = fr.vec(column).domain
     if cdom is not None and len(cdom):
-        uniq_c = np.arange(len(cdom))
+        uc = len(cdom)
         labels = list(cdom)
-        inv_c = np.nan_to_num(cv).astype(int)
+        inv_c = jnp.nan_to_num(cv).astype(jnp.int32)
     else:
-        uniq_c, inv_c = np.unique(cv, return_inverse=True)
-        labels = [str(c) for c in uniq_c]
-    out = np.full((uniq_i.size, uniq_c.size), np.nan)
-    out[inv_i, inv_c] = vv
+        sc, ccnt = _uniq_sorted_count(cv)
+        uc = int(ccnt)
+        uniq_c = jnp.unique(sc, size=uc)
+        labels = [str(float(x)) for x in np.asarray(uniq_c)]
+        inv_c = jnp.searchsorted(uniq_c, cv).astype(jnp.int32)
+
+    out = _pivot_fill(uniq_i, iv, inv_c, vv, ui=ui, uc=uc)
     names = [index] + labels
-    arrays = [uniq_i.astype(np.float64)] + \
-        [out[:, j] for j in range(uniq_c.size)]
-    return _new_frame(names, arrays)
+    return _dev_frame(names,
+                      [uniq_i.astype(jnp.float32)]
+                      + [out[:, j] for j in range(uc)])
 
 
 @prim("rank_within_groupby")
@@ -615,22 +731,16 @@ def _rank_within(a, e):
     scols = [int(i) for i in _eval(a[2], e)]
     new_col = str(_eval(a[4], e)) if len(a) > 4 else "New_Rank_column"
     n = fr.nrows
-    gkey = np.stack([_col_np(fr, j)[:n] for j in gcols], 1)
-    skey = np.stack([_col_np(fr, j)[:n] for j in scols], 1)
-    _, ginv = np.unique(gkey, axis=0, return_inverse=True)
-    order = np.lexsort(tuple(skey[:, k] for k in
-                             range(skey.shape[1] - 1, -1, -1)) + (ginv,))
-    rank = np.zeros(n, np.float64)
-    prev_g = None
-    r = 0
-    for pos in order:
-        if ginv[pos] != prev_g:
-            r = 1
-            prev_g = ginv[pos]
-        rank[pos] = r
-        r += 1
-    cols = [v.to_numpy()[:n] for v in fr.vecs]
-    return _new_frame(fr.names + [new_col], cols + [rank])
+    # device-native: ONE lexsort over (group cols, sort cols), ranks from
+    # group-boundary flags + cumulative positions, scattered back to the
+    # original row order. No per-row host loop; the untouched columns are
+    # REUSED (no copy, string columns included) — only the rank is new.
+    G = fr.matrix([fr.names[j] for j in gcols])[:n]
+    S = fr.matrix([fr.names[j] for j in scols])[:n]
+    rank = _rank_kernel(G, S)
+    from h2o3_tpu.core.frame import Vec as _V
+    return Frame(fr.names + [new_col],
+                 list(fr.vecs) + [_V.from_device_floats(rank)])
 
 
 @prim("ddply")

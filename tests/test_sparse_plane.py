@@ -101,3 +101,21 @@ def test_sparse_glm_trains_without_densify(tmp_path, monkeypatch):
     assert pf.nrows == n
     DKV.remove(f.key)
     DKV.remove(pf.key)
+
+
+def test_sparse_frame_persist_roundtrip(tmp_path):
+    """export_frame/import_frame preserve SparseVec columns (CXI persist)."""
+    from h2o3_tpu.io.persist import export_frame, import_frame
+    rows = np.array([0, 3, 6], np.int32)
+    vals = np.array([1.5, -2.5, 4.0], np.float32)
+    from h2o3_tpu.core.frame import Vec
+    f = Frame(["s", "d"], [SparseVec(rows, vals, 8),
+                           Vec.from_numpy(np.arange(8.0))])
+    p = str(tmp_path / "sp.hex")
+    export_frame(f, p)
+    g = import_frame(p, key="sp_back")
+    v = g.vec("s")
+    assert isinstance(v, SparseVec) and v.nnz == 3
+    np.testing.assert_allclose(v.to_numpy(), f.vec("s").to_numpy())
+    np.testing.assert_allclose(g.vec("d").to_numpy(), np.arange(8.0))
+    DKV.remove("sp_back")
