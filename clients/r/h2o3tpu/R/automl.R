@@ -1,0 +1,40 @@
+# Grid search + AutoML — h2o-r/h2o-package/R/{grid,automl}.R analog.
+
+h2o.grid <- function(algorithm, x = NULL, y, training_frame,
+                     hyper_params, grid_id = NULL,
+                     search_criteria = NULL, ...) {
+  p <- list(
+    training_frame = training_frame$key,
+    response_column = y,
+    hyper_parameters = jsonlite::toJSON(hyper_params, auto_unbox = TRUE),
+    grid_id = grid_id)
+  if (!is.null(search_criteria))
+    p$search_criteria <- jsonlite::toJSON(search_criteria,
+                                          auto_unbox = TRUE)
+  if (!is.null(x)) p$x <- jsonlite::toJSON(x)
+  extra <- list(...)
+  p <- c(Filter(Negate(is.null), p), extra)
+  r <- .h2o.POST(paste0("/99/Grid/", algorithm), p)
+  key <- .h2o.wait_job(r$job$key)
+  h2o.getGrid(key)
+}
+
+h2o.getGrid <- function(grid_id) {
+  g <- .h2o.GET(paste0("/99/Grids/", grid_id))
+  structure(list(grid_id = grid_id, summary = g), class = "H2OGrid")
+}
+
+h2o.automl <- function(x = NULL, y, training_frame, max_models = 10,
+                       max_runtime_secs = 0, seed = -1,
+                       project_name = NULL, nfolds = 5) {
+  p <- Filter(Negate(is.null), list(
+    training_frame = training_frame$key, response_column = y,
+    max_models = max_models, max_runtime_secs = max_runtime_secs,
+    seed = seed, project_name = project_name, nfolds = nfolds))
+  if (!is.null(x)) p$x <- jsonlite::toJSON(x)
+  r <- .h2o.POST("/99/AutoMLBuilder", p)
+  key <- .h2o.wait_job(r$job$key, timeout = max(600, max_runtime_secs * 2))
+  leader_info <- .h2o.GET(paste0("/99/AutoML/",
+                                 r$automl_id %||% key %||% project_name))
+  structure(list(project = key, info = leader_info), class = "H2OAutoML")
+}

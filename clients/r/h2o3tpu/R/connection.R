@@ -1,0 +1,82 @@
+# Connection layer — h2o-r/h2o-package/R/connect.R analog.
+# One process-global connection; every call is a plain HTTP round trip to
+# the h2o3-tpu REST server (api/server.py routes).
+
+.h2o.env <- new.env(parent = emptyenv())
+
+#' Connect to (or verify) a running h2o3-tpu server.
+#' @param ip server host. @param port server port.
+h2o.init <- function(ip = "127.0.0.1", port = 54321) {
+  url <- sprintf("http://%s:%d", ip, port)
+  assign("url", url, envir = .h2o.env)
+  cloud <- .h2o.GET("/3/Cloud")
+  message(sprintf("Connected to h2o3-tpu cloud '%s' (%d device shards)",
+                  cloud$cloud_name, cloud$cloud_size))
+  invisible(cloud)
+}
+
+.h2o.url <- function() {
+  if (!exists("url", envir = .h2o.env))
+    stop("no connection: call h2o.init() first")
+  get("url", envir = .h2o.env)
+}
+
+.h2o.GET <- function(path, params = list()) {
+  q <- .h2o.query(params)
+  target <- paste0(.h2o.url(), path, if (nzchar(q)) paste0("?", q) else "")
+  con <- url(target, open = "rb")
+  on.exit(close(con))
+  txt <- rawToChar(readBin(con, "raw", n = 64 * 1024 * 1024))
+  jsonlite::fromJSON(txt, simplifyVector = TRUE)
+}
+
+.h2o.POST <- function(path, params = list()) {
+  body <- .h2o.query(params)
+  target <- paste0(.h2o.url(), path)
+  # base R cannot POST; the curl binary ships everywhere the server runs
+  out <- system2("curl", c("-s", "-X", "POST", "--data", shQuote(body),
+                           shQuote(target)), stdout = TRUE)
+  jsonlite::fromJSON(paste(out, collapse = ""), simplifyVector = TRUE)
+}
+
+.h2o.DELETE <- function(path) {
+  out <- system2("curl", c("-s", "-X", "DELETE",
+                           shQuote(paste0(.h2o.url(), path))), stdout = TRUE)
+  invisible(jsonlite::fromJSON(paste(out, collapse = "")))
+}
+
+.h2o.query <- function(params) {
+  if (!length(params)) return("")
+  paste(vapply(names(params), function(k) {
+    v <- params[[k]]
+    if (is.logical(v)) v <- tolower(as.character(v))
+    if (length(v) > 1) v <- jsonlite::toJSON(v, auto_unbox = TRUE)
+    paste0(utils::URLencode(k, reserved = TRUE), "=",
+           utils::URLencode(as.character(v), reserved = TRUE))
+  }, character(1)), collapse = "&")
+}
+
+#' Poll a job key until it finishes (JobsHandler polling loop).
+.h2o.wait_job <- function(key, timeout = 600) {
+  t0 <- Sys.time()
+  repeat {
+    j <- .h2o.GET(paste0("/3/Jobs/", key))$jobs
+    status <- if (is.data.frame(j)) j$status[[1]] else j[[1]]$status
+    if (status %in% c("DONE", "FAILED", "CANCELLED")) {
+      if (status != "DONE") stop(sprintf("job %s %s", key, status))
+      return(if (is.data.frame(j)) j$dest[[1]] else j[[1]]$dest)
+    }
+    if (as.numeric(Sys.time() - t0) > timeout) stop("job timed out")
+    Sys.sleep(0.2)
+  }
+}
+
+h2o.clusterInfo <- function() .h2o.GET("/3/Cloud")
+
+h2o.shutdown <- function(prompt = TRUE) {
+  if (prompt && interactive() &&
+      !isTRUE(utils::askYesNo("Shut the h2o3-tpu server down?")))
+    return(invisible(FALSE))
+  try(.h2o.POST("/3/Shutdown"), silent = TRUE)
+  invisible(TRUE)
+}

@@ -1,0 +1,85 @@
+# Frames — h2o-r/h2o-package/R/frame.R analog. An H2OFrame is a key-only
+# handle; data stays server-side (FramesHandler / RapidsHandler surface).
+
+.h2o.frame <- function(key) structure(list(key = key), class = "H2OFrame")
+
+print.H2OFrame <- function(x, ...) {
+  f <- .h2o.GET(paste0("/3/Frames/", x$key))$frames
+  cat(sprintf("H2OFrame %s: %d rows x %d cols\n",
+              x$key, f$rows[[1]], f$column_count[[1]]))
+  invisible(x)
+}
+
+h2o.ls <- function() {
+  fr <- .h2o.GET("/3/Frames")$frames
+  if (is.null(fr) || !length(fr)) return(character(0))
+  vapply(fr$frame_id$name, identity, character(1))
+}
+
+h2o.rm <- function(x) {
+  key <- if (inherits(x, "H2OFrame")) x$key else as.character(x)
+  .h2o.DELETE(paste0("/3/DKV/", key))
+  invisible(TRUE)
+}
+
+h2o.importFile <- function(path, destination_frame = NULL) {
+  r <- .h2o.POST("/3/Parse", list(
+    source_frames = path,
+    destination_frame = destination_frame %||% basename(path)))
+  key <- .h2o.wait_job(r$job$key)
+  .h2o.frame(key)
+}
+
+h2o.getFrame <- function(key) {
+  .h2o.GET(paste0("/3/Frames/", key))   # 404s on a bad key
+  .h2o.frame(key)
+}
+
+h2o.createFrame <- function(rows = 10000, cols = 10, seed = -1,
+                            categorical_fraction = 0.2,
+                            missing_fraction = 0.0,
+                            destination_frame = NULL) {
+  dest <- destination_frame %||% sprintf("createframe_%d",
+                                         as.integer(Sys.time()))
+  r <- .h2o.POST("/3/CreateFrame", list(
+    rows = rows, cols = cols, seed = seed,
+    categorical_fraction = categorical_fraction,
+    missing_fraction = missing_fraction, dest = dest))
+  .h2o.wait_job(r$job$key)
+  .h2o.frame(dest)
+}
+
+h2o.splitFrame <- function(data, ratios = 0.75, seed = -1,
+                           destination_frames = NULL) {
+  dests <- destination_frames %||%
+    paste0(data$key, "_part", seq_len(length(ratios) + 1))
+  .h2o.POST("/3/SplitFrame", list(
+    dataset = data$key, ratios = jsonlite::toJSON(ratios),
+    destination_frames = jsonlite::toJSON(dests), seed = seed))
+  lapply(dests, .h2o.frame)
+}
+
+h2o.describe <- function(frame) {
+  .h2o.GET(paste0("/3/Frames/", frame$key, "/summary"))$frames
+}
+
+#' Upload an R data.frame (writes a temp CSV, parses server-side —
+#' as.h2o in the reference).
+as.h2o <- function(df, destination_frame = NULL) {
+  stopifnot(is.data.frame(df))
+  tmp <- tempfile(fileext = ".csv")
+  utils::write.csv(df, tmp, row.names = FALSE, na = "")
+  on.exit(unlink(tmp))
+  h2o.importFile(tmp, destination_frame = destination_frame)
+}
+
+#' Materialize a server frame locally through /3/DownloadDataset.
+as.data.frame.H2OFrame <- function(x, ...) {
+  target <- paste0(.h2o.url(), "/3/DownloadDataset?frame_id=",
+                   utils::URLencode(x$key, reserved = TRUE))
+  utils::read.csv(url(target), stringsAsFactors = FALSE)
+}
+
+h2o.rapids <- function(expr) .h2o.POST("/99/Rapids", list(ast = expr))
+
+`%||%` <- function(a, b) if (is.null(a)) b else a

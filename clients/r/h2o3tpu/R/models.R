@@ -1,0 +1,164 @@
+# Model builders — h2o-r/h2o-package/R/{gbm,glm,randomforest,...}.R analog.
+# Every builder POSTs /3/ModelBuilders/{algo}, polls the job, and returns a
+# key-only H2OModel handle.
+
+.h2o.model <- function(key, algo)
+  structure(list(key = key, algo = algo), class = "H2OModel")
+
+print.H2OModel <- function(x, ...) {
+  cat(sprintf("H2OModel %s (%s)\n", x$key, x$algo))
+  m <- .h2o.GET(paste0("/3/Models/", x$key))$models
+  tm <- m$training_metrics
+  if (!is.null(tm))
+    for (k in intersect(c("auc", "logloss", "rmse", "mae", "r2"),
+                        names(tm))) {
+      v <- tm[[k]]
+      if (length(v) && is.numeric(v[[1]]))
+        cat(sprintf("  training %s: %.5f\n", k, v[[1]]))
+    }
+  invisible(x)
+}
+
+.h2o.train <- function(algo, x, y, training_frame, validation_frame = NULL,
+                       params = list()) {
+  p <- params
+  p$training_frame <- training_frame$key
+  if (!is.null(validation_frame)) p$validation_frame <- validation_frame$key
+  if (!is.null(y)) p$response_column <- y
+  if (!is.null(x)) p$x <- jsonlite::toJSON(x)
+  p <- Filter(Negate(is.null), p)
+  r <- .h2o.POST(paste0("/3/ModelBuilders/", algo), p)
+  key <- .h2o.wait_job(r$job$key)
+  .h2o.model(key, algo)
+}
+
+h2o.gbm <- function(x = NULL, y, training_frame, validation_frame = NULL,
+                    ntrees = 50, max_depth = 5, min_rows = 10,
+                    learn_rate = 0.1, sample_rate = 1.0,
+                    distribution = "AUTO", nfolds = 0, seed = -1,
+                    model_id = NULL, ...) {
+  .h2o.train("gbm", x, y, training_frame, validation_frame, c(list(
+    ntrees = ntrees, max_depth = max_depth, min_rows = min_rows,
+    learn_rate = learn_rate, sample_rate = sample_rate,
+    distribution = distribution, nfolds = nfolds, seed = seed,
+    model_id = model_id), list(...)))
+}
+
+h2o.randomForest <- function(x = NULL, y, training_frame,
+                             validation_frame = NULL, ntrees = 50,
+                             max_depth = 20, mtries = -1,
+                             sample_rate = 0.632, nfolds = 0, seed = -1,
+                             model_id = NULL, ...) {
+  .h2o.train("drf", x, y, training_frame, validation_frame, c(list(
+    ntrees = ntrees, max_depth = max_depth, mtries = mtries,
+    sample_rate = sample_rate, nfolds = nfolds, seed = seed,
+    model_id = model_id), list(...)))
+}
+
+h2o.glm <- function(x = NULL, y, training_frame, validation_frame = NULL,
+                    family = "AUTO", alpha = NULL, lambda = NULL,
+                    lambda_search = FALSE, solver = "AUTO", nfolds = 0,
+                    seed = -1, model_id = NULL, ...) {
+  .h2o.train("glm", x, y, training_frame, validation_frame, c(list(
+    family = family, alpha = alpha, lambda_ = lambda,
+    lambda_search = lambda_search, solver = solver, nfolds = nfolds,
+    seed = seed, model_id = model_id), list(...)))
+}
+
+h2o.kmeans <- function(training_frame, x = NULL, k = 2,
+                       max_iterations = 10, standardize = TRUE,
+                       seed = -1, model_id = NULL, ...) {
+  .h2o.train("kmeans", x, NULL, training_frame, NULL, c(list(
+    k = k, max_iterations = max_iterations, standardize = standardize,
+    seed = seed, model_id = model_id), list(...)))
+}
+
+h2o.deeplearning <- function(x = NULL, y, training_frame,
+                             validation_frame = NULL, hidden = c(200, 200),
+                             epochs = 10, seed = -1, model_id = NULL, ...) {
+  .h2o.train("deeplearning", x, y, training_frame, validation_frame, c(list(
+    hidden = jsonlite::toJSON(hidden), epochs = epochs, seed = seed,
+    model_id = model_id), list(...)))
+}
+
+h2o.xgboost <- function(x = NULL, y, training_frame,
+                        validation_frame = NULL, ntrees = 50,
+                        max_depth = 6, eta = 0.3, booster = "gbtree",
+                        seed = -1, model_id = NULL, ...) {
+  .h2o.train("xgboost", x, y, training_frame, validation_frame, c(list(
+    ntrees = ntrees, max_depth = max_depth, eta = eta, booster = booster,
+    seed = seed, model_id = model_id), list(...)))
+}
+
+h2o.naiveBayes <- function(x = NULL, y, training_frame, model_id = NULL,
+                           ...) {
+  .h2o.train("naivebayes", x, y, training_frame, NULL,
+             c(list(model_id = model_id), list(...)))
+}
+
+h2o.isolationForest <- function(training_frame, x = NULL, ntrees = 50,
+                                max_depth = 8, seed = -1,
+                                model_id = NULL, ...) {
+  .h2o.train("isolationforest", x, NULL, training_frame, NULL, c(list(
+    ntrees = ntrees, max_depth = max_depth, seed = seed,
+    model_id = model_id), list(...)))
+}
+
+h2o.getModel <- function(key) {
+  m <- .h2o.GET(paste0("/3/Models/", key))$models
+  .h2o.model(key, if (length(m$algo)) m$algo[[1]] else "unknown")
+}
+
+h2o.predict <- function(object, newdata, destination_frame = NULL) {
+  dest <- destination_frame %||% paste0(object$key, "_pred")
+  .h2o.POST(sprintf("/3/Predictions/models/%s/frames/%s",
+                    object$key, newdata$key),
+            list(predictions_frame = dest))
+  .h2o.frame(dest)
+}
+
+h2o.performance <- function(model, newdata = NULL) {
+  if (is.null(newdata)) {
+    m <- .h2o.GET(paste0("/3/Models/", model$key))$models
+    return(m$training_metrics)
+  }
+  .h2o.POST(sprintf("/3/ModelMetrics/models/%s/frames/%s",
+                    model$key, newdata$key))
+}
+
+.h2o.metric <- function(model, name) {
+  tm <- h2o.performance(model)
+  v <- tm[[name]]
+  if (is.null(v)) NA_real_ else as.numeric(v[[1]])
+}
+
+h2o.auc <- function(model) .h2o.metric(model, "auc")
+h2o.rmse <- function(model) .h2o.metric(model, "rmse")
+h2o.logloss <- function(model) .h2o.metric(model, "logloss")
+
+h2o.varimp <- function(model) {
+  m <- .h2o.GET(paste0("/3/Models/", model$key))$models
+  m$variable_importances
+}
+
+h2o.download_mojo <- function(model, path = getwd()) {
+  dest <- file.path(path, paste0(model$key, ".zip"))
+  utils::download.file(paste0(.h2o.url(), "/3/Models/", model$key, "/mojo"),
+                       dest, mode = "wb", quiet = TRUE)
+  dest
+}
+
+h2o.download_pojo <- function(model, path = getwd()) {
+  dest <- file.path(path, paste0(model$key, ".java"))
+  utils::download.file(paste0(.h2o.url(), "/3/Models.java/", model$key),
+                       dest, mode = "wb", quiet = TRUE)
+  dest
+}
+
+h2o.partialPlot <- function(object, newdata, cols, nbins = 20) {
+  r <- .h2o.POST("/3/PartialDependence", list(
+    model_id = object$key, frame_id = newdata$key,
+    cols = jsonlite::toJSON(cols), nbins = nbins))
+  key <- .h2o.wait_job(r$job$key)
+  .h2o.GET(paste0("/3/PartialDependence/", key))$partial_dependence_data
+}

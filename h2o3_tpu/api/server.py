@@ -52,6 +52,36 @@ def _model_schema(m) -> dict:
 class _Handler(BaseHTTPRequestHandler):
     server_version = "h2o3-tpu/0.1"
 
+    # ---- security (water/H2OSecurityManager.java + webserver auth) ------
+    def _check_auth(self) -> bool:
+        """HTTP Basic auth when the server was started with credentials
+        (-hash_login/-basic_auth analog). Constant-time compare."""
+        creds = getattr(self.server, "auth_creds", None)
+        if not creds:
+            return True
+        import base64
+        import hmac
+        hdr = self.headers.get("Authorization", "")
+        if hdr.startswith("Basic "):
+            try:
+                got = base64.b64decode(hdr[6:]).decode()
+            except Exception:
+                got = ""
+            user, _, pwd = got.partition(":")
+            # compare BYTES: compare_digest raises on non-ASCII str, which
+            # would let a crafted header crash the handler pre-auth
+            ub, pb = user.encode(), pwd.encode()
+            for u, p in creds.items():
+                if hmac.compare_digest(ub, u.encode()) and \
+                        hmac.compare_digest(pb, p.encode()):
+                    return True
+        self.send_response(401)
+        self.send_header("WWW-Authenticate",
+                         'Basic realm="h2o3-tpu"')
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return False
+
     # ---- plumbing -------------------------------------------------------
     def _send(self, obj, code=200):
         body = json.dumps(obj, default=_json_default).encode()
@@ -66,6 +96,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "msg": str(msg), "http_status": code}, code)
 
     def _params(self) -> dict:
+        cached = getattr(self, "_cached_params", None)
+        if cached is not None:   # body already consumed by the broadcaster
+            return dict(cached)
         parsed = urllib.parse.urlparse(self.path)
         q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         ln = int(self.headers.get("Content-Length") or 0)
@@ -93,7 +126,17 @@ class _Handler(BaseHTTPRequestHandler):
         self._route("DELETE")
 
     def _route(self, method):
+        if not self._check_auth():
+            return
         path = urllib.parse.urlparse(self.path).path
+        # SPMD replay (deploy/multihost): mutating requests broadcast to
+        # every worker BEFORE local dispatch so all hosts issue the same
+        # device programs (a lone host in a collective would deadlock)
+        bc = getattr(self.server, "broadcaster", None)
+        if bc is not None and method in ("POST", "DELETE"):
+            params = self._params()
+            self._cached_params = params
+            bc.broadcast(method, path, params)
         try:
             for pat, m, fn in ROUTES:
                 if m != method:
@@ -515,10 +558,49 @@ ROUTES += _ext.build_routes()
 
 
 class H2OServer:
-    """Controller-side API server (h2o.init() + jetty in one)."""
+    """Controller-side API server (h2o.init() + jetty in one).
 
-    def __init__(self, port: int = 54321):
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    Security (H2OSecurityManager / h2o-security analog for a
+    single-controller runtime):
+      * auth: {user: password} dict or a "user:password"-lines file path
+        (-basic_auth / realm.properties) — enforced on every route with a
+        constant-time compare.
+      * ssl_cert/ssl_key: PEM pair → serve HTTPS (-jks/-ssl internode;
+        there is no internode traffic here — ICI transfers never leave
+        the pod — so TLS terminates at the one REST boundary).
+    Config-file equivalents: ai.h2o.api.auth_file / ssl_cert / ssl_key
+    via utils/config properties.
+    """
+
+    def __init__(self, port: int = 54321, auth=None, ssl_cert=None,
+                 ssl_key=None, host: str | None = None):
+        from h2o3_tpu.utils import config as _cfg
+        # loopback by default (local dev); deployments bind all interfaces
+        # (deploy/multihost serve + ai.h2o.api.bind_all property)
+        if host is None:
+            host = "0.0.0.0" if _cfg.get_bool("api.bind_all") \
+                else "127.0.0.1"
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        auth = auth if auth is not None else \
+            _cfg.get_property("api.auth_file", None)
+        if isinstance(auth, str):
+            creds = {}
+            with open(auth) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line and not line.startswith("#"):
+                        u, _, p = line.partition(":")
+                        creds[u] = p
+            auth = creds
+        self.httpd.auth_creds = auth or None
+        ssl_cert = ssl_cert or _cfg.get_property("api.ssl_cert", None)
+        ssl_key = ssl_key or _cfg.get_property("api.ssl_key", None)
+        if ssl_cert and ssl_key:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_cert, ssl_key)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
         self.port = self.httpd.server_address[1]
         self.thread: threading.Thread | None = None
 

@@ -1,0 +1,1 @@
+"""Deployment — h2o-k8s / h2o-helm / h2o-hadoop analog for TPU pods."""

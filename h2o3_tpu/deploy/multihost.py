@@ -1,0 +1,190 @@
+"""Multi-host bootstrap — the h2o-k8s/H2OCluster + hadoop driver analog.
+
+The reference forms a multi-node cloud via UDP gossip inside a k8s
+StatefulSet (h2o-k8s/) or a YARN application (h2o-hadoop-common/). A TPU
+pod slice is simpler and stricter: every host runs the SAME program,
+`jax.distributed.initialize` wires the hosts into one runtime (GKE/TPU-VM
+environments inject the coordinator automatically), and the global device
+mesh spans all chips; collectives ride ICI within a slice and DCN across
+slices — no gossip, no Paxos, membership is fixed by the slice topology.
+
+Call `bootstrap()` first thing on every host of a multi-host deployment
+(deploy/k8s/*.yaml does it via the container entrypoint). On a single
+host it is a no-op, so the same entrypoint serves laptops and v5p-32 pods.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def is_multihost() -> bool:
+    """True when a multi-host launch environment is detected (TPU pod
+    env vars or explicit coordinator address)."""
+    return bool(
+        os.environ.get("H2O3_COORDINATOR_ADDRESS")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        or (os.environ.get("TPU_WORKER_HOSTNAMES")
+            and int(os.environ.get("TPU_WORKER_COUNT", "1") or 1) > 1))
+
+
+def bootstrap(n_rows_shards=None, n_model_shards: int = 1):
+    """Initialize the distributed runtime (when applicable) and form the
+    global cloud over every visible chip on every host.
+
+    Env (k8s manifests set these from the StatefulSet):
+      H2O3_COORDINATOR_ADDRESS  host:port of process 0
+      H2O3_NUM_PROCESSES        world size
+      H2O3_PROCESS_ID           this host's rank
+    GKE TPU slices need none of them — jax.distributed.initialize()
+    autodetects from the TPU metadata the same way MEGASCALE jobs do.
+    """
+    import jax
+
+    if is_multihost():
+        addr = os.environ.get("H2O3_COORDINATOR_ADDRESS")
+        if addr:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(os.environ["H2O3_NUM_PROCESSES"]),
+                process_id=int(os.environ["H2O3_PROCESS_ID"]))
+        else:
+            jax.distributed.initialize()   # TPU-env autodetection
+    import h2o3_tpu
+    cloud = h2o3_tpu.init(n_rows_shards=n_rows_shards,
+                          n_model_shards=n_model_shards)
+    return cloud
+
+
+# ---------------------------------------------------------------------------
+# SPMD request replay. A multi-controller JAX runtime requires EVERY process
+# to issue the same computations in the same order — a worker that idles
+# would deadlock the first collective process 0 launches. So process 0
+# broadcasts each mutating REST request (path, method, params) to the
+# workers BEFORE handling it locally, and each worker replays the identical
+# request against the same route table. Identical requests → identical API
+# calls → identical jitted programs → matching collectives. (The reference
+# has no analog: its nodes exchange data via RPC; SPMD replicates control.)
+# Requests replay serially in arrival order; concurrent builds are
+# serialized by the broadcast lock.
+_BCAST_PORT_OFFSET = 2
+
+
+class _ReplayHandler:
+    """Duck-typed stand-in for the HTTP handler: routes need only
+    _params/_send/_error (+ raw send for byte routes, unused in replay)."""
+
+    def __init__(self, params):
+        self._p = dict(params)
+        self.out = None
+
+    def _params(self):
+        return dict(self._p)
+
+    def _send(self, obj, code=200):
+        self.out = obj
+
+    def _error(self, msg, code=400):
+        self.out = {"error": str(msg), "code": code}
+
+
+def replay_request(method: str, path: str, params: dict):
+    """Execute a REST request against the local route table (worker side)."""
+    from h2o3_tpu.api import server as _srv
+    h = _ReplayHandler(params)
+    for pat, m, fn in _srv.ROUTES:
+        if m != method:
+            continue
+        mm = pat.fullmatch(path)
+        if mm:
+            fn(h, *mm.groups())
+            return h.out
+    return {"error": f"no route {method} {path}"}
+
+
+class Broadcaster:
+    """Process-0 side: fan each mutating request out to every worker and
+    wait for receipt acks (ordering barrier) before local dispatch."""
+
+    def __init__(self, n_workers: int, port: int):
+        import socket
+        import threading
+        self._lock = threading.Lock()
+        self._conns = []
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", port))
+        srv.listen(n_workers)
+        for _ in range(n_workers):
+            conn, _addr = srv.accept()
+            self._conns.append(conn)
+        srv.close()
+
+    def broadcast(self, method: str, path: str, params: dict):
+        import pickle
+        import struct
+        payload = pickle.dumps((method, path, params))
+        with self._lock:
+            for c in self._conns:
+                c.sendall(struct.pack("!I", len(payload)) + payload)
+            for c in self._conns:
+                ack = c.recv(1)           # receipt ack: ordering barrier
+                assert ack == b"\x01"
+
+
+def worker_loop(coordinator_host: str, port: int):
+    """Worker side: block on the broadcast socket, replay each request."""
+    import pickle
+    import socket
+    import struct
+    import time as _time
+    for _ in range(120):                  # wait for process 0 to listen
+        try:
+            sock = socket.create_connection((coordinator_host, port))
+            break
+        except OSError:
+            _time.sleep(1)
+    else:
+        raise RuntimeError("broadcast coordinator unreachable")
+    while True:
+        hdr = sock.recv(4, socket.MSG_WAITALL)
+        if not hdr:
+            return
+        (ln,) = struct.unpack("!I", hdr)
+        method, path, params = pickle.loads(
+            sock.recv(ln, socket.MSG_WAITALL))
+        sock.sendall(b"\x01")             # ack receipt, then execute
+        try:
+            replay_request(method, path, params)
+        except Exception:                 # keep replaying; process 0 owns
+            import traceback              # error reporting to the client
+            traceback.print_exc()
+
+
+def serve(port: int = 54321):
+    """Container entrypoint: bootstrap the (possibly multi-host) cloud;
+    process 0 serves REST and broadcasts mutating requests, workers replay
+    them so every host issues the same device programs."""
+    import jax
+    cloud = bootstrap()
+    nproc = jax.process_count()
+    bport = port + _BCAST_PORT_OFFSET
+    if jax.process_index() == 0:
+        from h2o3_tpu.api.server import H2OServer
+        from h2o3_tpu.utils import config as _cfg
+        _cfg.set_property("api.bind_all", True)
+        srv = H2OServer(port)
+        if nproc > 1:
+            srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)
+        print(f"h2o3-tpu cloud: {cloud.n_devices} chips over "
+              f"{nproc} hosts; REST on :{port}")
+        srv.start(background=False)
+    else:
+        host = os.environ.get("H2O3_COORDINATOR_ADDRESS",
+                              "127.0.0.1:0").split(":")[0]
+        worker_loop(host, bport)
+
+
+if __name__ == "__main__":
+    import sys
+    serve(int(sys.argv[1]) if len(sys.argv) > 1 else 54321)

@@ -74,6 +74,8 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         "colsample_bylevel": None, "subsample": None, "max_bins": None,
         "min_split_loss": None, "gamma": None, "max_leaves": 0,
         "grow_policy": "depthwise", "dmatrix_type": "auto",
+        # DART (booster="dart"): per-iteration tree dropout
+        "rate_drop": 0.0, "skip_drop": 0.0, "one_drop": False,
     })
     _ALIASES = {
         "eta": "learn_rate", "min_child_weight": "min_rows",
@@ -134,12 +136,35 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         self._f0 = f0 = 0.5 if dist == "gaussian" else 0.0
         F = jnp.full(X.shape[0], f0, jnp.float32)
         sample_rate = float(self.params["sample_rate"])
+        # DART (arXiv:1505.01866 + xgboost gbm/gbtree.cc dart): drop a
+        # random subset of existing trees before computing gradients, then
+        # normalize (normalize_type="tree"): new tree weight eta/(k+eta),
+        # dropped trees rescaled by k/(k+eta). Per-tree weights are folded
+        # into the stored leaf VALUES at the end (w_t/eta) so standard
+        # scoring (lr * sum of trees), MOJO and TreeSHAP stay exact.
+        dart = self.params.get("booster") == "dart"
+        rate_drop = float(self.params.get("rate_drop") or 0.0)
+        one_drop = bool(self.params.get("one_drop"))
+        skip_drop = float(self.params.get("skip_drop") or 0.0)
+        tree_w: list = []          # per-tree weights (eta for plain boosting)
+        tree_pred: list = []       # per-tree per-row predictions (device)
+        rng = np.random.default_rng(seed if seed >= 0 else 42)
         trees = []
         gains_tot = jnp.zeros(X.shape[1], jnp.float32)
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
         for t in range(ntrees):
             key, k1, k2, k3 = jax.random.split(key, 4)
-            g, h = _objective_grad_hess(dist, F, y)
+            F_use = F
+            dropped: list = []
+            if dart and tree_pred and rate_drop > 0 \
+                    and rng.random() >= skip_drop:
+                dmask = rng.random(len(tree_pred)) < rate_drop
+                if one_drop and not dmask.any():
+                    dmask[rng.integers(len(tree_pred))] = True
+                dropped = list(np.nonzero(dmask)[0])
+                for i in dropped:
+                    F_use = F_use - tree_w[i] * tree_pred[i]
+            g, h = _objective_grad_hess(dist, F_use, y)
             wt = self._sample_weights(w, k1, sample_rate)
             cmask = self._col_mask(X.shape[1], k2)
             # hessian-weighted stats: w_stat=Σwh (→H), wy=Σwg (→G)
@@ -152,12 +177,32 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
             cover = E.node_covers(heap, wt * h, nodes=grower.nodes,
                                   D=grower.D)
             trees.append((col, thr, nal, val, cover))
-            F = F + eta * val[heap]
+            p_new = val[heap]
+            kdrop = len(dropped)
+            if dart:
+                if kdrop:
+                    scale = kdrop / (kdrop + eta)
+                    new_w = eta / (kdrop + eta)
+                    # rescale the dropped trees toward the new ensemble
+                    for i in dropped:
+                        F = F + (scale - 1.0) * tree_w[i] * tree_pred[i]
+                        tree_w[i] *= scale
+                else:
+                    new_w = eta
+                tree_w.append(new_w)
+                tree_pred.append(p_new)
+                F = F + new_w * p_new
+            else:
+                F = F + eta * p_new
             if (t + 1) % interval == 0 or t == ntrees - 1:
                 self._record_history(t + 1, F, y, w_metric, dist)
                 if self._should_stop():
                     break
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+        if dart and tree_w:
+            # fold DART weights into leaf values: lr * sum matches F
+            trees = [(c, th, na, v * (tw / eta), cv)
+                     for (c, th, na, v, cv), tw in zip(trees, tree_w)]
         self._trees = E.stack_trees(trees, grower.D)
         self._varimp_from_gains(np.asarray(gains_tot, np.float64))
         self._output.model_summary = {
@@ -171,6 +216,10 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         }
 
     def _fit_multinomial(self, X, y, w, job):
+        if self.params.get("booster") == "dart":
+            raise NotImplementedError(
+                "booster='dart' is implemented for regression/binomial "
+                "xgboost only; multinomial DART is not supported")
         K = self.nclasses
         ntrees = int(self.params["ntrees"])
         eta = float(self.params["learn_rate"])

@@ -106,6 +106,13 @@ def init(n_rows_shards: int | None = None, n_model_shards: int = 1,
         dev_grid = np.array(devices[:use]).reshape(n_rows_shards, n_model_shards)
         mesh = Mesh(dev_grid, (ROWS, MODEL))
         _CLOUD = Cloud(mesh=mesh, name=name)
+        # extension lifecycle (ExtensionManager onLocalNodeStarted analog)
+        try:
+            from h2o3_tpu.ext import load_configured_extensions
+            load_configured_extensions(_CLOUD)
+        except Exception:   # an extension failure must not kill the cloud
+            import traceback
+            traceback.print_exc()
         return _CLOUD
 
 

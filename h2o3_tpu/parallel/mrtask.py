@@ -63,14 +63,30 @@ def shard_sum(x, axis_name=_mesh.ROWS):
 
 
 def device_put_rows(host_array, ndim=None):
-    """Place a host array onto the mesh row-sharded (dim 0 over "rows")."""
+    """Place a host array onto the mesh row-sharded (dim 0 over "rows").
+
+    Multi-controller (deploy/multihost SPMD replay): every host holds the
+    FULL host array (requests replay identically), so each process builds
+    its addressable shards from its own copy via make_array_from_callback
+    — plain device_put cannot target non-addressable devices."""
     c = _mesh.cloud()
     nd = host_array.ndim if ndim is None else ndim
-    return jax.device_put(host_array, c.rows_sharding(nd))
+    sh = c.rows_sharding(nd)
+    if jax.process_count() > 1:
+        import numpy as _np
+        arr = _np.asarray(host_array)
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+    return jax.device_put(host_array, sh)
 
 
 def device_put_replicated(host_array):
     c = _mesh.cloud()
+    if jax.process_count() > 1:
+        import numpy as _np
+        arr = _np.asarray(host_array)
+        return jax.make_array_from_callback(arr.shape, c.replicated(),
+                                            lambda idx: arr[idx])
     return jax.device_put(host_array, c.replicated())
 
 

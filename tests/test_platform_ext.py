@@ -1,0 +1,172 @@
+"""Platform surfaces: REST auth (security layer), extension SPI, R client
+route contract, multihost bootstrap single-host path, deploy manifests."""
+
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_rest_basic_auth():
+    """H2OSecurityManager analog: credentialed server 401s anonymous
+    requests and serves authenticated ones."""
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0, auth={"alice": "s3cret"}).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{s.port}/3/Ping")
+        assert ei.value.code == 401
+        import base64
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/3/Ping",
+            headers={"Authorization": "Basic "
+                     + base64.b64encode(b"alice:s3cret").decode()})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["cloud_healthy"]
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/3/Ping",
+            headers={"Authorization": "Basic "
+                     + base64.b64encode(b"alice:wrong").decode()})
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            urllib.request.urlopen(bad)
+        assert ei2.value.code == 401
+    finally:
+        s.stop()
+
+
+def test_extension_spi(cloud8):
+    """ExtensionManager analog: an extension contributes an estimator, a
+    REST route and a Rapids prim, all live immediately."""
+    from h2o3_tpu.ext import H2OExtension, register_extension, extensions
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+
+    class MyGLM(H2OGeneralizedLinearEstimator):
+        algo = "myglm"
+
+    def _h_hello(h):
+        h._send({"__meta": {"schema_type": "HelloV99"}, "hello": "tpu"})
+
+    def _prim_answer(a, e):
+        return 42.0
+
+    inited = {}
+    register_extension(H2OExtension(
+        name="test-ext",
+        estimators={"myglm": MyGLM},
+        routes=[(r"/99/Hello", "GET", _h_hello)],
+        rapids={"the_answer": _prim_answer},
+        init=lambda cloud: inited.setdefault("cloud", cloud)))
+
+    assert any(e.name == "test-ext" for e in extensions())
+    from h2o3_tpu.models import ESTIMATORS
+    assert ESTIMATORS["myglm"] is MyGLM
+    from h2o3_tpu.rapids.rapids import rapids_exec
+    assert rapids_exec("(the_answer)") == 42.0
+
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    try:
+        out = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{s.port}/99/Hello").read())
+        assert out["hello"] == "tpu"
+        builders = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{s.port}/3/ModelBuilders").read())
+        assert "myglm" in builders["model_builders"]
+    finally:
+        s.stop()
+
+
+def test_r_client_route_contract():
+    """Every REST path the R client calls must exist on the server (the
+    cheap cross-language contract check; the R runtime is not in this
+    image, so the surface is held to the route table instead)."""
+    from h2o3_tpu.api.server import ROUTES
+    rdir = os.path.join(REPO, "clients", "r", "h2o3tpu", "R")
+    assert os.path.isdir(rdir), "R client package missing"
+    src = ""
+    for fn in os.listdir(rdir):
+        with open(os.path.join(rdir, fn)) as fh:
+            src += fh.read()
+    called = set(re.findall(r'"(/(?:3|99|4)/[A-Za-z0-9_./]*)', src))
+    assert len(called) >= 12, called
+    for path in called:
+        # compare against route patterns with their regex groups wildcarded
+        hit = False
+        probe = path.rstrip("/")
+        for pat, _m, _f in ROUTES:
+            rx = pat.pattern
+            if re.fullmatch(rx, probe) or \
+                    re.match("^" + rx, probe + "/x") or \
+                    rx.startswith(re.escape(probe)):
+                hit = True
+                break
+        assert hit, f"R client calls {path} but no server route matches"
+
+
+def test_multihost_bootstrap_single_host(cloud8):
+    """deploy/multihost.bootstrap is a no-op wrapper on one host."""
+    from h2o3_tpu.deploy import multihost
+    assert not multihost.is_multihost()
+    cloud = multihost.bootstrap()
+    assert cloud.n_devices >= 1
+
+
+def test_deploy_manifests_parse():
+    import re as _re
+    p = os.path.join(REPO, "deploy", "k8s", "statefulset.yaml")
+    text = open(p).read()
+    assert "StatefulSet" in text and "google.com/tpu" in text
+    assert "h2o3_tpu.deploy.multihost" in text
+    chart = os.path.join(REPO, "deploy", "helm", "h2o3-tpu", "Chart.yaml")
+    assert "h2o3-tpu" in open(chart).read()
+
+
+def test_multihost_request_replay(cloud8):
+    """SPMD replay layer: a mutating request reaches process 0's handler
+    AND every worker's replay loop (here: one worker thread in-process),
+    so all hosts issue the same programs."""
+    import threading
+    import time
+    from h2o3_tpu.api.server import H2OServer
+    from h2o3_tpu.deploy import multihost
+    from h2o3_tpu.ext import H2OExtension, register_extension
+
+    hits = {"n": 0}
+
+    def _h_count(h):
+        hits["n"] += 1
+        h._send({"__meta": {"schema_type": "CountV99"}, "n": hits["n"]})
+
+    register_extension(H2OExtension(name="replay-counter",
+                                    routes=[(r"/99/CountMe", "POST",
+                                             _h_count)]))
+
+    s = H2OServer(port=0).start()
+    bport = s.port + multihost._BCAST_PORT_OFFSET
+    worker = threading.Thread(
+        target=multihost.worker_loop, args=("127.0.0.1", bport),
+        daemon=True)
+    worker.start()
+    try:
+        s.httpd.broadcaster = multihost.Broadcaster(1, bport)
+        body = b"x=1"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/99/CountMe", data=body,
+            method="POST")
+        out = json.loads(urllib.request.urlopen(req).read())
+        # the worker replays first (receipt-ack barrier), then the local
+        # handler runs: two executions of the same request
+        for _ in range(50):
+            if hits["n"] >= 2:
+                break
+            time.sleep(0.05)
+        assert hits["n"] == 2, hits
+    finally:
+        s.stop()

@@ -1,6 +1,7 @@
 """XGBoost estimator — the TPU-native replacement for the xgboost extension
 (h2o-extensions/xgboost; hist semantics, Rabit → ICI psum)."""
 
+import h2o3_tpu.models
 import numpy as np
 import pytest
 
@@ -93,3 +94,43 @@ def test_xgboost_mojo_roundtrip(tmp_path):
     out = scorer.predict(rows)
     want = m.predict(f).to_numpy()[:25, 2]
     assert np.allclose(out["probs"][:, 1], want, atol=1e-5)
+
+
+def test_xgboost_dart():
+    """DART booster (arXiv:1505.01866): dropout changes the ensemble vs
+    gbtree, rate_drop=0 degenerates to plain boosting exactly, and the
+    folded tree weights keep scoring consistent (AUC intact)."""
+    rng = np.random.default_rng(21)
+    n = 600
+    X = rng.normal(0, 1, (n, 4))
+    y = (X[:, 0] - X[:, 1] > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+
+    base = h2o3_tpu.models.H2OXGBoostEstimator(ntrees=10, max_depth=3,
+                                               seed=5)
+    base.train(y="y", training_frame=f)
+    zero = h2o3_tpu.models.H2OXGBoostEstimator(ntrees=10, max_depth=3,
+                                               seed=5, booster="dart",
+                                               rate_drop=0.0)
+    zero.train(y="y", training_frame=f)
+    np.testing.assert_allclose(np.asarray(zero._trees.value),
+                               np.asarray(base._trees.value), atol=1e-6)
+
+    dart = h2o3_tpu.models.H2OXGBoostEstimator(ntrees=10, max_depth=3,
+                                               seed=5, booster="dart",
+                                               rate_drop=0.5, one_drop=True)
+    dart.train(y="y", training_frame=f)
+    assert not np.allclose(np.asarray(dart._trees.value),
+                           np.asarray(base._trees.value))
+    assert dart._output.training_metrics.auc > 0.9
+
+    with pytest.raises(NotImplementedError):
+        yc = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+        cols3 = {f"x{j}": X[:, j] for j in range(4)}
+        cols3["y"] = np.array(["a", "b", "c"], object)[yc]
+        f3 = Frame.from_dict(cols3)
+        m = h2o3_tpu.models.H2OXGBoostEstimator(ntrees=3, booster="dart",
+                                                rate_drop=0.3)
+        m.train(y="y", training_frame=f3)
