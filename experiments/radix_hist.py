@@ -1,0 +1,203 @@
+"""Radix-factored shallow-level histogram kernel — PERF_NOTES item 1,
+scoped to the regime the analysis says it can win (UNSORTED rows, small
+leaf windows).
+
+Idea: at level windows L<=2 the dense kernel's cost floor is the 256-wide
+one-hot generation (~210ms/level at 11M x 32). Factor code = hi*16+lo and
+fuse leaf+hi into ONE joint key compare:
+
+    key[r,c]  = leaf[r]*16 + hi[r,c]                  (i32, VPU)
+    J[(l,hi),r] = (iota == key)                       (L*16-wide compare)
+    A[(l,hi,s),r] = J ? stats[s,r] : 0                (select, L*16*S lanes)
+    H[(l,hi,s),lo] = A @ onehot_lo.T                  ((L*16*S, R)@(R, 16))
+
+VPU element-ops per (row, col): L*16 (compare) + L*16*S (select) + 16
+(lo compare)  vs  dense 256 (compare) + L*S (select):
+    L=1:  96 vs 260  (2.7x)     L=2: 176 vs 264  (1.5x)
+    L=4: 336 vs 272  (worse)    -> use radix ONLY for L<=2, dense beyond.
+
+Run on TPU:   python experiments/radix_hist.py            (measures)
+Correctness:  python experiments/radix_hist.py --interpret (any backend)
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+from h2o3_tpu.ops import hist_pallas as HP  # noqa: E402
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+
+NH = 16                       # hi radix width
+S = HP.S_STATS
+CB = HP.COL_TILE
+R = HP.BLOCK_ROWS
+
+
+def _radix_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
+                  nb, interpret):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    heap = heap_ref[0, :]                               # (R,)
+    leaf = heap - base
+    inw = (leaf >= 0) & (leaf < L)
+    leaf_c = jnp.where(inw, leaf, L)                    # dead -> key >= L*NH
+    nl = nb // NH                                       # lo width
+    stats = stats_ref[...]                              # (S, R)
+    acc = out_ref[...]
+    iota_k = lax.broadcasted_iota(jnp.int32, (L * NH, R), 0)
+    iota_lo = lax.broadcasted_iota(jnp.int32, (nl, R), 0)
+    parts = []
+    for c in range(CB):
+        code = codesT_ref[c, :]                         # (R,)
+        key = leaf_c * NH + (code // nl if nl != NH else code >> 4)
+        lo = code % nl
+        J = (iota_k == key[None, :])                    # (L*NH, R) i1
+        # A[(l,hi,s), r] = J ? stats[s] : 0
+        A = jnp.where(J[:, None, :], stats[None, :, :], 0.0) \
+            .reshape(L * NH * S, R).astype(jnp.bfloat16)
+        ohlo = (iota_lo == lo[None, :]).astype(jnp.bfloat16)   # (nl, R)
+        h = lax.dot_general(A, ohlo, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        parts.append(h)                                 # (L*NH*S, nl)
+    out_ref[...] = acc + jnp.stack(parts)[None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("base", "L", "nb", "interpret"))
+def radix_hist(codesT, heap, stats, *, base, L, nb=256, interpret=False):
+    """(L, C_pad, S, nb) histogram via the radix factorization; L <= 8."""
+    c_pad, n_pad = codesT.shape
+    ncb = c_pad // CB
+    kernel = functools.partial(_radix_kernel, base=base, L=L, nb=nb,
+                               interpret=interpret)
+    out = pl.pallas_call(
+        kernel,
+        grid=(ncb, n_pad // R),
+        in_specs=[
+            pl.BlockSpec((CB, R), lambda g, j: (g, j)),
+            pl.BlockSpec((1, R), lambda g, j: (0, j)),
+            pl.BlockSpec((S, R), lambda g, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, CB, L * NH * S, nb // NH),
+                               lambda g, j: (g, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((ncb, CB, L * NH * S, nb // NH),
+                                       jnp.float32),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(codesT, heap.reshape(1, n_pad), stats)
+    # (ncb, CB, L*NH*S, nl) -> (L, C_pad, S, nb)
+    nl = nb // NH
+    out = out.reshape(ncb, CB, L, NH, S, nl)
+    return out.transpose(2, 0, 1, 4, 3, 5).reshape(L, c_pad, S, nb)
+
+
+def radix_math(codes, heap, stats, *, base, L, nb):
+    """Pure-jnp replica of the kernel body (the factorization math, minus
+    the pallas tiling) — pallas interpret mode is impractically slow at
+    kernel shapes, so correctness splits into (a) this math check and
+    (b) the on-TPU parity check in measure()."""
+    c_pad, n_pad = codes.shape
+    nl = nb // NH
+    leaf = heap - base
+    inw = (leaf >= 0) & (leaf < L)
+    leaf_c = jnp.where(inw, leaf, L)
+    outs = []
+    for c in range(c_pad):
+        code = codes[c]
+        key = leaf_c * NH + code // nl
+        lo = code % nl
+        J = jax.nn.one_hot(key, L * NH, dtype=jnp.float32)      # (n, L*NH)
+        A = (J[:, :, None] * stats.T[:, None, :]) \
+            .reshape(n_pad, L * NH * S)
+        ohlo = jax.nn.one_hot(lo, nl, dtype=jnp.float32)
+        h = A.T @ ohlo                                          # (LNHS, nl)
+        outs.append(h.reshape(L, NH, S, nl).transpose(0, 2, 1, 3)
+                    .reshape(L, S, nb))
+    return jnp.stack(outs, axis=1)                              # (L,C,S,nb)
+
+
+def check_math(L=2, nb=256):
+    rng = np.random.default_rng(0)
+    n, c_pad = 4096, 8
+    codes = jnp.asarray(rng.integers(0, nb, (c_pad, n)), jnp.int32)
+    base = L - 1
+    heap = jnp.asarray(rng.integers(base, base + L + 1, n), jnp.int32)
+    stats = jnp.asarray(rng.normal(0, 1, (S, n)), jnp.float32)
+    got = radix_math(codes, heap, stats, base=base, L=L, nb=nb)
+    want = HP.sbh_hist_xla(codes, heap, stats, base=base, L=L, n_bins=nb)
+    d = float(jnp.max(jnp.abs(got - want[:L])))
+    print(f"radix math L={L}: max dev {d:.5f}")
+    assert d < 1e-2, d
+    return d
+
+
+def check(interpret=True, n_pad=2 * R, L=2, nb=256):
+    rng = np.random.default_rng(0)
+    c_pad = 2 * CB
+    codes = jnp.asarray(rng.integers(0, nb, (c_pad, n_pad)), jnp.int32)
+    base = L - 1
+    heap = jnp.asarray(rng.integers(base, base + L, n_pad), jnp.int32)
+    stats = jnp.asarray(rng.normal(0, 1, (S, n_pad)), jnp.float32)
+    got = radix_hist(codes, heap, stats, base=base, L=L, nb=nb,
+                     interpret=interpret)
+    want = HP.sbh_hist_xla(codes, heap, stats, base=base, L=L, n_bins=nb)
+    d = float(jnp.max(jnp.abs(got - want[:L])))
+    print(f"radix L={L} max dev vs xla: {d:.4f}")
+    assert d < 0.5, d          # bf16 accumulation tolerance
+    return d
+
+
+def measure():
+    N = 11_000_000
+    n_pad = -(-N // R) * R
+    c_pad = 32
+    rng = np.random.default_rng(0)
+    codes = jnp.asarray(rng.integers(0, 255, (c_pad, n_pad)), jnp.int32)
+    stats = jnp.asarray(rng.normal(0, 1, (S, n_pad)), jnp.float32)
+    for L in (1, 2, 4):
+        base = L - 1
+        heap = jnp.asarray(rng.integers(base, base + L, n_pad), jnp.int32)
+        r = radix_hist(codes, heap, stats, base=base, L=L)
+        float(r[0, 0, 0, 0])
+        t0 = time.time()
+        for _ in range(3):
+            r = radix_hist(codes, heap, stats, base=base, L=L)
+        float(r[0, 0, 0, 0])
+        tr = (time.time() - t0) / 3 * 1e3
+        d = HP.sbh_hist_pallas(codes, heap, stats, base=base, L=L,
+                               n_bins=256)
+        float(d[0, 0, 0, 0])
+        t0 = time.time()
+        for _ in range(3):
+            d = HP.sbh_hist_pallas(codes, heap, stats, base=base, L=L,
+                                   n_bins=256)
+        float(d[0, 0, 0, 0])
+        td = (time.time() - t0) / 3 * 1e3
+        print(f"L={L}: radix {tr:.0f} ms  dense {td:.0f} ms  "
+              f"({td / tr:.2f}x)")
+
+
+if __name__ == "__main__":
+    if "--interpret" in sys.argv:        # CPU-safe factorization check
+        for L in (1, 2, 4):
+            check_math(L=L)
+    else:                                # on-TPU parity + timings
+        check(interpret=False)
+        measure()

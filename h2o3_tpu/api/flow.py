@@ -1,0 +1,95 @@
+"""Flow-lite — a single-page operations UI served at `/` (the h2o-web /
+Flow notebook analog, reduced to its operational core: cluster status,
+frames, models with metrics, jobs, a model-build form and a Rapids
+console, all driven by the same public REST routes a browser user of the
+reference exercises through Flow)."""
+
+FLOW_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>h2o3-tpu Flow</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f6f8;color:#1d2733}
+ header{background:#123b57;color:#fff;padding:10px 18px;font-size:18px}
+ main{display:grid;grid-template-columns:1fr 1fr;gap:14px;padding:14px}
+ section{background:#fff;border-radius:8px;padding:12px 14px;box-shadow:0 1px 3px rgba(0,0,0,.12)}
+ h2{font-size:14px;margin:0 0 8px;color:#345}
+ table{width:100%;border-collapse:collapse;font-size:12px}
+ td,th{padding:3px 6px;border-bottom:1px solid #e5e9ee;text-align:left}
+ input,select,button,textarea{font:inherit;padding:4px 6px;margin:2px}
+ button{background:#1b6ca8;color:#fff;border:0;border-radius:4px;cursor:pointer}
+ pre{background:#0e1726;color:#d7e3f4;padding:8px;border-radius:6px;font-size:11px;overflow:auto;max-height:180px}
+ .full{grid-column:1/3}
+</style></head><body>
+<header>h2o3-tpu &mdash; Flow <span id="cloud" style="font-size:12px"></span></header>
+<main>
+ <section><h2>Frames</h2><table id="frames"></table></section>
+ <section><h2>Models</h2><table id="models"></table></section>
+ <section><h2>Jobs</h2><table id="jobs"></table></section>
+ <section><h2>Build model</h2>
+  <select id="algo"></select>
+  <input id="tf" placeholder="training_frame key">
+  <input id="y" placeholder="response column">
+  <input id="extra" placeholder="extra params k=v&k=v">
+  <button onclick="build()">Build</button>
+  <pre id="buildout"></pre></section>
+ <section class="full"><h2>Rapids console</h2>
+  <textarea id="ast" rows="2" style="width:90%">(+ 1 2)</textarea>
+  <button onclick="rapids()">Run</button>
+  <pre id="rapout"></pre></section>
+</main>
+<script>
+const J = async (p, o) => (await fetch(p, o)).json();
+async function refresh(){
+  const c = await J('/3/Cloud');
+  document.getElementById('cloud').textContent =
+    ` ${c.cloud_name} · ${c.cloud_size} shards · v${c.version}`;
+  const fr = await J('/3/Frames');
+  document.getElementById('frames').innerHTML =
+    '<tr><th>key</th><th>rows</th><th>cols</th></tr>' +
+    fr.frames.map(f=>`<tr><td>${f.frame_id.name}</td><td>${f.rows}</td><td>${f.column_count}</td></tr>`).join('');
+  const ms = await J('/3/Models');
+  document.getElementById('models').innerHTML =
+    '<tr><th>model</th><th>algo</th><th>metric</th></tr>' +
+    ms.models.map(m=>{const t=m.training_metrics||{};
+      const met = t.auc!=null?('auc '+(+t.auc).toFixed(4)):(t.rmse!=null?('rmse '+(+t.rmse).toFixed(4)):'');
+      return `<tr><td>${m.model_id}</td><td>${m.algo}</td><td>${met}</td></tr>`}).join('');
+  const js = await J('/3/Jobs');
+  document.getElementById('jobs').innerHTML =
+    '<tr><th>job</th><th>status</th><th>progress</th></tr>' +
+    js.jobs.slice(-12).reverse().map(j=>`<tr><td>${j.description}</td><td>${j.status}</td><td>${Math.round(100*j.progress)}%</td></tr>`).join('');
+}
+async function loadAlgos(){
+  const b = await J('/3/ModelBuilders');
+  document.getElementById('algo').innerHTML =
+    Object.keys(b.model_builders).map(a=>`<option>${a}</option>`).join('');
+}
+async function build(){
+  const p = new URLSearchParams();
+  p.set('training_frame', document.getElementById('tf').value);
+  const y = document.getElementById('y').value;
+  if (y) p.set('response_column', y);
+  for (const kv of document.getElementById('extra').value.split('&'))
+    if (kv.includes('=')) p.set(...kv.split('='));
+  const algo = document.getElementById('algo').value;
+  const r = await J('/3/ModelBuilders/'+algo, {method:'POST', body:p});
+  document.getElementById('buildout').textContent = JSON.stringify(r, null, 1);
+  setTimeout(refresh, 1200);
+}
+async function rapids(){
+  const p = new URLSearchParams();
+  p.set('ast', document.getElementById('ast').value);
+  const r = await J('/99/Rapids', {method:'POST', body:p});
+  document.getElementById('rapout').textContent = JSON.stringify(r, null, 1);
+  refresh();
+}
+loadAlgos(); refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+
+def h_flow(h):
+    body = FLOW_HTML.encode()
+    h.send_response(200)
+    h.send_header("Content-Type", "text/html; charset=utf-8")
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    h.wfile.write(body)

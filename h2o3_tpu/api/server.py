@@ -556,6 +556,14 @@ from h2o3_tpu.api import routes_ext as _ext  # noqa: E402
 
 ROUTES += _ext.build_routes()
 
+# Flow-lite UI (h2o-web analog) at / and /flow/index.html
+from h2o3_tpu.api import flow as _flow  # noqa: E402
+
+ROUTES += [
+    (re.compile(r"/"), "GET", _flow.h_flow),
+    (re.compile(r"/flow/index\.html"), "GET", _flow.h_flow),
+]
+
 
 class H2OServer:
     """Controller-side API server (h2o.init() + jetty in one).

@@ -203,3 +203,13 @@ def test_bindings_codegen_end_to_end(server, tmp_path, small_model):
     # unknown parameters are rejected client-side (generated param list)
     with pytest.raises(TypeError):
         mod.H2OGradientBoostingEstimator(conn, bogus_param=1)
+
+
+def test_flow_ui_served(server):
+    """Flow-lite (h2o-web analog): the operations UI serves at / and
+    drives only public REST routes."""
+    html = _get_raw(server, "/").decode()
+    assert "<title>h2o3-tpu Flow</title>" in html
+    assert "/3/ModelBuilders" in html and "/99/Rapids" in html
+    html2 = _get_raw(server, "/flow/index.html").decode()
+    assert html2 == html
