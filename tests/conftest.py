@@ -58,6 +58,7 @@ SLOW_TESTS = {
     "test_col_sample_rate_per_tree_on_binned",
     "test_estimator_uses_sharded_path",
     "test_algo_gbm_train_valid_metrics", "test_algo_gbm_varimp_finds_signal",
+    "test_multinomial_sharded_matches_single", "test_drf_sharded_oob_counts",
 }
 
 

@@ -150,3 +150,68 @@ def test_drf_binned_oob(cloud8):
     s = drf._output.model_summary
     assert s.get("engine") == "binned_pallas" and s.get("oob_scored")
     assert drf._output.training_metrics.auc > 0.8
+
+
+def test_multinomial_sharded_matches_single(cloud8, data):
+    """8-shard multinomial training == single-device (K-tree scan under
+    shard_map with the same per-level psum)."""
+    N, C, X, y3, spec = data
+    yk = (np.nan_to_num(X[:, 0]) > 0.5).astype(np.float32) + \
+        (np.nan_to_num(X[:, 1]) > 0).astype(np.float32)
+
+    def run(multi):
+        g = BN.BinnedGrower(spec, max_depth=3, min_rows=2.0,
+                            min_split_improvement=1e-5,
+                            axis_name=MESH.ROWS if multi else None)
+        n_pad = g.layout(N, shards=cloud8.n_rows_shards if multi else 1)
+        codes = BN.quantize(jnp.asarray(X), spec, n_pad=n_pad)
+        y1 = BN.pad_rows(jnp.asarray(yk), n_pad)
+        w1 = BN.pad_rows(jnp.ones(N, jnp.float32), n_pad)
+        F = jnp.zeros((n_pad, 3), jnp.float32)
+        if multi:
+            codes = jax.device_put(codes, cloud8.sharding(P(None, MESH.ROWS)))
+            y1 = jax.device_put(y1, cloud8.rows_sharding(1))
+            w1 = jax.device_put(w1, cloud8.rows_sharding(1))
+            F = jax.device_put(F, cloud8.sharding(P(MESH.ROWS, None)))
+        tr = BN.gbm_multi_chunk_trainer(
+            g, N, n_classes=3, eta=0.1, sample_rate=1.0, mtries=0,
+            k_iters=2, mesh=cloud8.mesh if multi else None)
+        F2, trees = tr(codes, y1, w1, F, jax.random.PRNGKey(0))
+        return np.asarray(F2)[:N], [np.asarray(t) for t in trees]
+
+    Fm, Tm = run(True)
+    Fs, Ts = run(False)
+    # the MODEL must agree: margins to 1e-4. Individual split slots may
+    # flip where a gain sits exactly at the msi threshold (f32 reduction
+    # order decides; the flipped split has ~zero gain so F is unchanged) —
+    # require the vast majority of split decisions identical.
+    np.testing.assert_allclose(Fm, Fs, atol=1e-4)
+    col_m = np.asarray(Tm[0]).ravel()
+    col_s = np.asarray(Ts[0]).ravel()
+    agree = (col_m == col_s).mean()
+    assert agree > 0.9, agree
+
+
+def test_drf_sharded_oob_counts(cloud8, data):
+    """Sharded DRF accumulates OOB sums/counts per shard-local rows; every
+    real row is OOB for roughly (1-rate)*ntrees trees."""
+    N, C, X, y, spec = data
+    g = BN.BinnedGrower(spec, max_depth=3, min_rows=2.0,
+                        min_split_improvement=1e-5, axis_name=MESH.ROWS)
+    n_pad = g.layout(N, shards=cloud8.n_rows_shards)
+    codes = jax.device_put(
+        BN.quantize(jnp.asarray(X), spec, n_pad=n_pad),
+        cloud8.sharding(P(None, MESH.ROWS)))
+    y1 = jax.device_put(BN.pad_rows(jnp.asarray(y), n_pad),
+                        cloud8.rows_sharding(1))
+    w1 = jax.device_put(BN.pad_rows(jnp.ones(N, jnp.float32), n_pad),
+                        cloud8.rows_sharding(1))
+    oob_s = jax.device_put(jnp.zeros(n_pad), cloud8.rows_sharding(1))
+    oob_c = jax.device_put(jnp.zeros(n_pad), cloud8.rows_sharding(1))
+    tr = BN.drf_chunk_trainer(g, N, sample_rate=0.632, mtries=0,
+                              k_trees=10, mesh=cloud8.mesh)
+    oob_s, oob_c, trees = tr(codes, y1, w1, oob_s, oob_c,
+                             jax.random.PRNGKey(1))
+    cnt = np.asarray(oob_c)[:N]
+    assert abs(cnt.mean() - 10 * (1 - 0.632)) < 0.5
+    assert (cnt > 0).mean() > 0.95
