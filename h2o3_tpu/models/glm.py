@@ -314,6 +314,9 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         # beta_constraints: list of {names, lower_bounds, upper_bounds}
         # rows or a dict {col: (lo, hi)} (GLM.java betaConstraints)
         "beta_constraints": None,
+        # interactions: numeric columns whose pairwise products enter the
+        # design (hex/DataInfo interactions; categorical pairs rejected)
+        "interactions": None,
     }
 
     # ------------------------------------------------------------------
@@ -440,6 +443,8 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         return lo, hi
 
     def _sparse_path_ok(self) -> bool:
+        if self.params.get("interactions"):
+            return False        # interaction columns need the dense design
         # the sparse NLLs are the canonical-link likelihoods only
         if (self._family, self._link) not in {
                 (GAUSSIAN, "identity"), (BINOMIAL, "logit"),
@@ -852,14 +857,12 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                 and not getattr(self, "_sparse_fit", False):
             raw = {}
             icept = st.beta[-1]
-            ncat = sum(di.cardinalities.get(c, 0) for c in di.cat_cols)
             for j, n in enumerate(di.feature_names):
                 b = st.beta[j]
-                if j >= ncat:  # numeric, was standardized
-                    cname = di.num_cols[j - ncat]
-                    s = max(di.sigmas[cname], 1e-10)
+                if n in di.means:      # numeric (incl. interaction cols):
+                    s = max(di.sigmas[n], 1e-10)    # was standardized
                     raw[n] = b / s
-                    icept -= b * di.means[cname] / s
+                    icept -= b * di.means[n] / s
                 else:
                     raw[n] = b
             raw["Intercept"] = icept

@@ -50,7 +50,8 @@ class DataInfo:
     def __init__(self, frame: Frame, x: Sequence[str], y: Optional[str],
                  cat_mode: str = "onehot", standardize: bool = False,
                  impute_missing: bool = True, weights: Optional[str] = None,
-                 offset: Optional[str] = None):
+                 offset: Optional[str] = None,
+                 interactions: Optional[Sequence[str]] = None):
         self.cat_mode = cat_mode
         self.standardize = standardize
         self.impute_missing = impute_missing
@@ -69,12 +70,41 @@ class DataInfo:
         # normalization stats from the TRAINING frame
         self.means = {c: frame.vec(c).mean() for c in self.num_cols}
         self.sigmas = {c: frame.vec(c).sigma() or 1.0 for c in self.num_cols}
+        # interactions (hex/DataInfo.java interactions / makeInteraction):
+        # pairwise PRODUCT columns over the listed numeric predictors,
+        # standardized with their own training-frame stats. Categorical
+        # interaction expansion is not implemented — rejected loudly.
+        self.inter_pairs: list = []
+        if interactions:
+            bad = [c for c in interactions if c in self.cat_cols]
+            if bad:
+                raise NotImplementedError(
+                    f"categorical interactions not supported: {bad} "
+                    "(numeric-numeric pairs only)")
+            unknown = [c for c in interactions if c not in self.num_cols]
+            if unknown:
+                raise ValueError(
+                    f"interactions reference unknown numeric predictors: "
+                    f"{unknown} (GLM interaction-column validation)")
+            cols = list(interactions)
+            import itertools as _it
+            for a, b in _it.combinations(cols, 2):
+                name = f"{a}:{b}"
+                self.inter_pairs.append((a, b, name))
+                prod = (frame.vec(a).as_f32()[: frame.nrows]
+                        * frame.vec(b).as_f32()[: frame.nrows])
+                pn = np.asarray(prod, np.float64)
+                ok = pn[~np.isnan(pn)]
+                self.means[name] = float(ok.mean()) if len(ok) else 0.0
+                self.sigmas[name] = float(ok.std(ddof=1)) or 1.0 \
+                    if len(ok) > 1 else 1.0
         # expanded feature names (coefficient_names order: cats first like H2O)
         self.feature_names: list[str] = []
         if cat_mode == "onehot":
             for c in self.cat_cols:
                 self.feature_names += [f"{c}.{l}" for l in self.domains[c]]
             self.feature_names += self.num_cols
+            self.feature_names += [n for _, _, n in self.inter_pairs]
         else:
             self.feature_names = list(self.predictors)
 
@@ -114,8 +144,21 @@ class DataInfo:
                     fill = jnp.zeros_like(means) if standardize else means
                     x = jnp.where(jnp.isnan(x), fill, x)
                 parts.append(x)
+            for (ia, ib, im, isg) in inter_idx:
+                p = raw_num[:, ia] * raw_num[:, ib]     # RAW product
+                if standardize:
+                    p = (p - im) / isg
+                if self.impute_missing:
+                    p = jnp.where(jnp.isnan(p),
+                                  0.0 if standardize else im, p)
+                parts.append(p[:, None])
             return jnp.concatenate(parts, axis=1)
 
+        inter_idx = tuple(
+            (self.num_cols.index(a), self.num_cols.index(b),
+             np.float32(self.means[n]),
+             np.float32(max(self.sigmas[n], 1e-10)))
+            for a, b, n in self.inter_pairs)
         out_sh = _mesh.cloud().rows_sharding(2)
         return jax.jit(build, out_shardings=out_sh)(raw_cat, raw_num, means, sigmas)
 
@@ -323,7 +366,8 @@ class ModelBase:
                         cat_mode=self._cat_mode(),
                         standardize=bool(self.params.get("standardize")),
                         weights=self.params.get("weights_column"),
-                        offset=self.params.get("offset_column"))
+                        offset=self.params.get("offset_column"),
+                        interactions=self.params.get("interactions"))
 
     def _cat_mode(self) -> str:
         return "onehot"

@@ -152,3 +152,38 @@ def test_non_negative_via_bounds():
     c = m.coef()
     assert c["x0"] >= -1e-8                  # true -2 clamped at 0
     assert c["x1"] > 0.5
+
+
+def test_glm_interactions():
+    """interactions= adds pairwise product terms (DataInfo interactions):
+    a pure-interaction signal is unlearnable without them."""
+    rng = np.random.default_rng(7)
+    n = 800
+    X = rng.normal(0, 1, (n, 3))
+    yv = 2.0 * X[:, 0] * X[:, 1] + rng.normal(0, 0.1, n)
+    f = Frame.from_dict({**{f"x{j}": X[:, j] for j in range(3)}, "y": yv})
+    plain = GLM(family="gaussian", lambda_=0.0)
+    plain.train(y="y", training_frame=f)
+    inter = GLM(family="gaussian", lambda_=0.0,
+                interactions=["x0", "x1", "x2"])
+    inter.train(y="y", training_frame=f)
+    assert inter._output.training_metrics.r2 > 0.95
+    assert plain._output.training_metrics.r2 < 0.3
+    c = inter.coef()
+    assert "x0:x1" in c and abs(c["x0:x1"] - 2.0) < 0.1
+    assert abs(c.get("x0:x2", 0.0)) < 0.1
+    # categorical interactions reject loudly
+    f2 = Frame.from_dict({"g": np.array(["a", "b"], object)[
+        rng.integers(0, 2, n)], "x0": X[:, 0], "y": yv})
+    with pytest.raises(NotImplementedError):
+        GLM(family="gaussian", interactions=["g", "x0"]).train(
+            y="y", training_frame=f2)
+
+
+def test_glm_interactions_unknown_column_rejected():
+    rng = np.random.default_rng(8)
+    f = Frame.from_dict({"x0": rng.normal(0, 1, 50),
+                         "y": rng.normal(0, 1, 50)})
+    with pytest.raises(ValueError):
+        GLM(family="gaussian", interactions=["x0", "nope"]).train(
+            y="y", training_frame=f)
