@@ -76,6 +76,13 @@ class DataInfo:
         # interaction expansion is not implemented — rejected loudly.
         self.inter_pairs: list = []
         if interactions:
+            if cat_mode != "onehot":
+                raise ValueError(
+                    "interactions are only supported with the one-hot "
+                    "design matrix (GLM-family models)")
+            # dedupe, order-preserving: a repeated entry would emit a
+            # degenerate self-pair product
+            interactions = list(dict.fromkeys(interactions))
             bad = [c for c in interactions if c in self.cat_cols]
             if bad:
                 raise NotImplementedError(

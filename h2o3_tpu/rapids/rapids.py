@@ -69,6 +69,14 @@ class _Parser:
         self.i += 1
         out = []
         while self.peek() != "]":
+            if not self.peek():
+                raise ValueError("unterminated [...] list")
+            if self.peek() in "\"'":
+                # h2o string lists use the same bracket syntax, e.g.
+                # (countmatches col ["o"]); _token() cannot consume a
+                # quote char so it must parse as a string here
+                out.append(self._string(self.peek())[1])
+                continue
             tok = self._token()
             if isinstance(tok, str) and ":" in tok:   # a:b span
                 a, b = tok.split(":")

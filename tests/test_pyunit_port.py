@@ -241,3 +241,125 @@ def test_algo_naive_bayes_classifies():
     m = models.H2ONaiveBayesEstimator()
     m.train(y="y", training_frame=f)
     assert m._output.training_metrics.auc > 0.8
+
+
+# ---- munging part 2: strings / time / misc (testdir_munging behaviors) --
+from h2o3_tpu.core.frame import Vec
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.rapids.rapids import rapids_exec
+
+
+def _put(key, **cols):
+    f = Frame.from_dict(cols, key=key)
+    return f
+
+
+def _put_str(key, name, values):
+    """String prims need T_STR columns (Frame.from_dict enum-encodes)."""
+    v = Vec._from_strings(np.asarray(values, object), force_type="str")
+    f = Frame([name], [v], key=key)
+    DKV.put(key, f)
+    return f
+
+
+def test_munging_string_ops():
+    _put_str("strf", "s", ["  Hello World  ", "FOO bar", "baz"])
+    try:
+        lo = rapids_exec('(tolower (cols strf [0]))')
+        assert list(lo.vecs[0].to_numpy())[0].strip() == "hello world"
+        up = rapids_exec('(toupper (cols strf [0]))')
+        assert "FOO BAR" in list(up.vecs[0].to_numpy())[1]
+        tr = rapids_exec('(trim (cols strf [0]))')
+        assert list(tr.vecs[0].to_numpy())[0] == "Hello World"
+        cm = rapids_exec('(countmatches (cols strf [0]) ["o"])')
+        assert list(cm.vecs[0].to_numpy()[:3]) == [2.0, 0.0, 0.0]  # case-sensitive
+    finally:
+        DKV.remove("strf")
+
+
+def test_munging_strsplit_substring():
+    _put_str("sp", "s", ["a-b-c", "d-e", "f"])
+    try:
+        out = rapids_exec('(strsplit (cols sp [0]) "-")')
+        assert out.ncols >= 3
+        sub = rapids_exec('(substring (cols sp [0]) #0 #1)')
+        assert list(sub.vecs[0].to_numpy())[:3] == ["a", "d", "f"]
+    finally:
+        DKV.remove("sp")
+
+
+def test_munging_which_and_table(df):
+    w = rapids_exec(f"(h2o.which (> (cols {df.frame_id} [0]) 0))")
+    idx = w.vecs[0].to_numpy()
+    a = df.as_data_frame()["a"].to_numpy()
+    np.testing.assert_array_equal(np.sort(idx), np.nonzero(a > 0)[0])
+
+
+def test_munging_na_omit_and_impute():
+    _put("naf", x=np.array([1.0, np.nan, 3.0, np.nan]),
+         z=np.array([1.0, 2.0, 3.0, 4.0]))
+    try:
+        out = rapids_exec("(na.omit naf)")
+        assert out.nrows == 2
+        rapids_exec('(h2o.impute naf #0 "median" "interpolate" [] [] [])')
+        got = DKV.get("naf").vecs[0].to_numpy()[:4]
+        assert not np.isnan(got).any()
+    finally:
+        DKV.remove("naf")
+
+
+def test_munging_hist_and_cor():
+    rng = np.random.default_rng(12)
+    x = rng.normal(0, 1, 500)
+    _put("hf", x=x, y=2 * x + rng.normal(0, 0.5, 500))
+    try:
+        h = rapids_exec("(hist (cols hf [0]) #10)")
+        counts = h.vec("counts").to_numpy()
+        assert np.nansum(counts) == 500
+        c = rapids_exec("(cor hf hf \"everything\" \"Pearson\")")
+        cm = c.to_numpy() if hasattr(c, "to_numpy") else c
+        r01 = np.asarray(cm)[0, 1]
+        assert 0.9 < r01 <= 1.0
+    finally:
+        DKV.remove("hf")
+
+
+def test_munging_difflag_topn():
+    _put("dl", x=np.array([1.0, 4.0, 9.0, 16.0]))
+    try:
+        d = rapids_exec("(difflag1 (cols dl [0]))")
+        vals = d.vecs[0].to_numpy()[:4]
+        np.testing.assert_allclose(vals[1:], [3.0, 5.0, 7.0])
+        t = rapids_exec("(topn dl #0 #50 #1)")   # top 50% by value, desc
+        assert t.nrows >= 1
+    finally:
+        DKV.remove("dl")
+
+
+def test_munging_kfold_columns():
+    _put("kf", x=np.arange(100, dtype=float))
+    try:
+        k = rapids_exec("(kfold_column kf #5 #42)")
+        folds = k.vecs[0].to_numpy()[:100]
+        assert set(np.unique(folds)) <= set(range(5))
+        m = rapids_exec("(modulo_kfold_column kf #4)")
+        mf = m.vecs[0].to_numpy()[:100]
+        np.testing.assert_array_equal(mf, np.arange(100) % 4)
+    finally:
+        DKV.remove("kf")
+
+
+def test_algo_coxph_risk_ordering():
+    """CoxPH: a covariate that accelerates hazard gets a positive coef."""
+    rng = np.random.default_rng(13)
+    n = 400
+    x = rng.normal(0, 1, n)
+    t = rng.exponential(np.exp(-x))          # higher x -> earlier event
+    ev = np.ones(n)
+    f = Frame.from_dict({"x": x, "time": t, "event": ev})
+    from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+    m = H2OCoxProportionalHazardsEstimator(stop_column="time")
+    m.train(x=["x"], y="event", training_frame=f)
+    coef = m.coef() if hasattr(m, "coef") else m._output.model_summary
+    val = coef.get("x") if isinstance(coef, dict) else None
+    assert val is not None and val > 0.5
