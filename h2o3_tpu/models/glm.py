@@ -659,6 +659,11 @@ class H2OGeneralizedLinearEstimator(ModelBase):
 
     def _resolve_family(self) -> str:
         fam = self.params.get("family", "AUTO")
+        if fam and str(fam).lower() in ("hglm", "fractionalbinomial"):
+            raise NotImplementedError(
+                f"family={fam} is not implemented (no silent fallback); "
+                "supported: gaussian/binomial/quasibinomial/poisson/gamma/"
+                "tweedie/negativebinomial/multinomial/ordinal")
         if fam and fam != "AUTO":
             return fam
         if self._dinfo.response_domain is None:

@@ -42,6 +42,12 @@ class SharedTreeEstimator(ModelBase):
         "build_tree_one_node": False, "histogram_type": "AUTO",
         "calibrate_model": False, "balance_classes": False,
         "monotone_constraints": None,
+        # nbins_top_level (DHistogram nbins halving): the binned engine
+        # uses GLOBAL quantile codes, so an explicit top-level resolution
+        # maps to the global bin count: b_val = max(nbins, value/4) capped
+        # at 255 (a root histogram at 1024 bins halved 2 levels ≈ 256).
+        # None = derive from nbins alone (the engine's own default).
+        "nbins_top_level": None,
         # TPU extension: int8-quantized histogram stats on the 2x-rate int8
         # MXU path (None = auto: on wherever the Pallas kernels run)
         "int8_hist": None,
@@ -58,6 +64,17 @@ class SharedTreeEstimator(ModelBase):
         w = di.weights(frame)
         w = jnp.where(jnp.isnan(y), 0.0, w)
         yz = jnp.where(jnp.isnan(y), 0.0, y)
+        # balance_classes (hex/ModelBuilder class-balancing): reweight so
+        # every class carries equal total weight — the weight-based
+        # equivalent of the reference's minority over-sampling, with no
+        # row duplication on device
+        if self.params.get("balance_classes") and self._is_classifier:
+            K = self.nclasses
+            yi = yz.astype(jnp.int32)
+            totals = jax.ops.segment_sum(w, yi, num_segments=K)
+            wsum = totals.sum()
+            factor = jnp.where(totals > 0, wsum / (K * totals), 1.0)
+            w = w * factor[yi]
         return X, yz, w
 
     def _grower(self):
@@ -109,7 +126,9 @@ class SharedTreeEstimator(ModelBase):
         cards = [di.cardinalities[c] for c in di.cat_cols]
         nbins = int(p["nbins"])
         nbins_cats = int(p.get("nbins_cats") or 1024)
-        b_val = max(nbins, min(nbins_cats, max(cards, default=0)))
+        nbins_top = int(p.get("nbins_top_level") or 0)
+        b_val = max(nbins, nbins_top // 4,
+                    min(nbins_cats, max(cards, default=0)))
         b_val = int(min(255, max(b_val, 4)))
         # bin edges come from a row sample: STRIDED device slice (a head
         # slice would bias quantiles on ordered data), tiny readback
@@ -594,11 +613,12 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         if self._is_classifier:
             m = M.binomial_metrics(y, mu[:, 1], w)
             h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
-                 "training_auc": m.auc, "training_rmse": m.rmse}
+                 "training_auc": m.auc, "training_pr_auc": m.pr_auc,
+                 "training_rmse": m.rmse}
         else:
             m = M.regression_metrics(y, mu, w)
             h = {"number_of_trees": ntrees, "training_rmse": m.rmse,
-                 "training_mae": m.mae}
+                 "training_mae": m.mae, "training_r2": m.r2}
         self._output.scoring_history.append(h)
 
     def _record_history_multi(self, ntrees, F, y, w):
@@ -610,22 +630,47 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
              "training_classification_error": m.error})
 
     def _should_stop(self) -> bool:
+        """ScoreKeeper.stopEarly: stop when the chosen stopping_metric has
+        not improved over the last `stopping_rounds` scoring events."""
         k = int(self.params.get("stopping_rounds") or 0)
         if k <= 0 or len(self._output.scoring_history) < 2 * k:
             return False
         hist = self._output.scoring_history
+        want = str(self.params.get("stopping_metric") or "AUTO").lower()
+        want = {"aucpr": "pr_auc"}.get(want, want)
+        maximize = want in ("auc", "pr_auc", "r2")
         metric = None
-        for cand in ("training_logloss", "training_rmse"):
-            if cand in hist[-1]:
-                metric = cand
-                break
+        explicit = want not in ("auto", "")
+        if explicit:
+            for key in hist[-1]:
+                if key.endswith("_" + want):
+                    metric = key
+                    break
+            if metric is None:
+                raise ValueError(
+                    f"stopping_metric={want!r} is not recorded for this "
+                    f"problem type (available: {sorted(hist[-1])})")
+        if metric is None:
+            maximize = False
+            for cand in ("training_logloss", "training_rmse"):
+                if cand in hist[-1]:
+                    metric = cand
+                    break
         if metric is None:
             return False
         vals = [h[metric] for h in hist]
+        # tolerance 0 is a VALID value (stop on any non-improvement):
+        # no falsy-or fallback; inclusive comparisons so an exact plateau
+        # stops (ScoreKeeper.stopEarly semantics)
+        tol_raw = self.params.get("stopping_tolerance")
+        tol = 1e-3 if tol_raw is None else float(tol_raw)
+        if maximize:
+            recent = max(vals[-k:])
+            past = max(vals[:-k])
+            return recent <= past * (1 + tol)
         recent = min(vals[-k:])
         past = min(vals[:-k])
-        tol = float(self.params.get("stopping_tolerance") or 1e-3)
-        return recent > past * (1 - tol)
+        return recent >= past * (1 - tol)
 
 
 # ---------------------------------------------------------------------------

@@ -100,15 +100,18 @@ class _Parser:
 
     def _string(self, q):
         self.i += 1
-        start = self.i
         out = []
-        while self.s[self.i] != q:
+        while self.i < len(self.s) and self.s[self.i] != q:
             ch = self.s[self.i]
             if ch == "\\":
                 self.i += 1
+                if self.i >= len(self.s):
+                    break
                 ch = self.s[self.i]
             out.append(ch)
             self.i += 1
+        if self.i >= len(self.s):
+            raise ValueError("unterminated string literal")
         self.i += 1
         return ("str", "".join(out))
 

@@ -279,3 +279,61 @@ def test_predict_domain_adaptation():
     glm.train(y="y", training_frame=tr)
     p = glm.predict(te)
     assert p.nrows == 2 and np.isfinite(p.vec("predict").to_numpy()).all()
+
+
+def test_balance_classes_reweights():
+    """balance_classes: equal per-class total weight (the weight-space
+    version of ModelBuilder minority oversampling)."""
+    rng = np.random.default_rng(31)
+    n = 600
+    X = rng.normal(0, 1, (n, 3))
+    y = (X[:, 0] + rng.normal(0, 0.4, n) > 1.1).astype(int)   # ~14% pos
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    plain = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=8, max_depth=3, seed=1)
+    plain.train(y="y", training_frame=f)
+    bal = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=8, max_depth=3, seed=1, balance_classes=True)
+    bal.train(y="y", training_frame=f)
+    # balancing shifts predicted base rates upward for the minority class
+    pp = plain.predict(f).vec("pp").to_numpy()[:n]
+    pb = bal.predict(f).vec("pp").to_numpy()[:n]
+    assert pb.mean() > pp.mean() + 0.05
+
+
+def test_stopping_metric_auc_maximizes():
+    rng = np.random.default_rng(32)
+    n = 500
+    X = rng.normal(0, 1, (n, 3))
+    y = (X[:, 0] > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    m = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=60, max_depth=3, seed=1, stopping_rounds=2,
+        stopping_metric="AUC", stopping_tolerance=0.0,
+        score_tree_interval=2)
+    m.train(y="y", training_frame=f)
+    # AUC saturates at 1.0 quickly on this separable data -> early stop
+    assert m._trees.ntrees < 60
+
+
+def test_hglm_rejected_loudly():
+    f = Frame.from_dict({"x": [1.0, 2.0, 3.0], "y": [1.0, 2.0, 3.0]})
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        h2o3_tpu.models.H2OGeneralizedLinearEstimator(family="hglm").train(
+            y="y", training_frame=f)
+
+
+def test_nbins_top_level_raises_resolution():
+    rng = np.random.default_rng(33)
+    n = 400
+    f = Frame.from_dict({"x": rng.normal(0, 1, n),
+                         "y": rng.normal(0, 1, n)})
+    m = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=2, max_depth=3, nbins=20, nbins_top_level=1024, seed=1)
+    m.train(y="y", training_frame=f)
+    assert m._output.model_summary["nbins_effective"] == 255
