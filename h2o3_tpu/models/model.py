@@ -335,6 +335,9 @@ class ModelBase:
         mrs = float(self.params.get("max_runtime_secs") or 0.0)
         if mrs > 0:
             job.deadline = t0 + mrs
+        # early stopping scores the validation frame when one is given
+        # (ScoreKeeper uses validation metrics over training metrics)
+        self._valid_for_scoring = validation_frame
 
         def work(job: Job):
             if int(self.params["nfolds"] or 0) > 1 or self.params.get("fold_column"):
@@ -342,6 +345,11 @@ class ModelBase:
             self._fit(frame, job)
             self._score_train_valid(frame, validation_frame)
             self._output.run_time_ms = int(1000 * (time.time() - t0))
+            # release validation scoring state: the margins/design matrix
+            # would otherwise pin device memory for the model's lifetime
+            # (and a retrain on this instance must never see stale state)
+            self._vstate = None
+            self._valid_for_scoring = None
             return self
 
         job.start(work, background=False)

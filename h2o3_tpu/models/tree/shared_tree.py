@@ -56,8 +56,33 @@ class SharedTreeEstimator(ModelBase):
     def _cat_mode(self):
         return "label"  # trees bin label-encoded categoricals natively
 
+    def _validate_early_stopping(self):
+        """Fail fast on an unusable stopping_metric (H2O validates at
+        build-parameter time, not 2*stopping_rounds scoring events in)."""
+        if int(self.params.get("stopping_rounds") or 0) <= 0:
+            return
+        want = str(self.params.get("stopping_metric") or "AUTO").lower()
+        want = {"aucpr": "pr_auc"}.get(want, want)
+        if want in ("auto", ""):
+            return
+        known = {"auc", "pr_auc", "logloss", "rmse", "mae", "r2",
+                 "classification_error"}
+        cls_only = {"auc", "pr_auc", "logloss", "classification_error"}
+        reg_only = {"mae", "r2"}
+        if want not in known:
+            raise ValueError(f"unknown stopping_metric {want!r}; "
+                             f"supported: {sorted(known)}")
+        if self._is_classifier and want in reg_only:
+            raise ValueError(f"stopping_metric={want!r} is a regression "
+                             "metric but the response is categorical")
+        if not self._is_classifier and want in cls_only:
+            raise ValueError(f"stopping_metric={want!r} is a "
+                             "classification metric but the response is "
+                             "numeric")
+
     # ---- shared plumbing -------------------------------------------------
     def _prep(self, frame: Frame):
+        self._validate_early_stopping()
         di = self._dinfo
         X = di.matrix(frame)           # (pad, C) f32 NaN-NA (label cats)
         y = di.response(frame)
@@ -316,6 +341,10 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             F = f0 + lr * E.predict_ensemble(X, pt)
         gains_tot = jnp.zeros(X.shape[1], jnp.float32)
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
+        self._valid_setup(f0)
+        if trees:   # checkpoint restart: prior ensemble scores valid too
+            self._valid_advance(E.stack_trees(trees, grower.D), lr)
+        last_scored = len(trees)
         for t in range(len(trees), ntrees):
             key, k1, k2, k3 = jax.random.split(key, 4)
             res, hess = _grad_hess(dist, F, y, udf=self._udf_dist)
@@ -332,6 +361,10 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             trees.append((col, thr, nal, val, cover))
             F = F + lr * val[heap]
             if (t + 1) % interval == 0 or t == ntrees - 1:
+                if self._vstate is not None and len(trees) > last_scored:
+                    self._valid_advance(
+                        E.stack_trees(trees[last_scored:], grower.D), lr)
+                    last_scored = len(trees)
                 self._record_history(t + 1, F, y, w, dist)
                 if self._should_stop():
                     break
@@ -420,6 +453,10 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         mtries = self._per_level_mtries(C)
         sample_rate = float(p["sample_rate"])
         col_rate_tree = float(p.get("col_sample_rate_per_tree") or 1.0)
+        self._valid_setup(f0)
+        if prev is not None:
+            # validation margins must include the checkpoint ensemble too
+            self._valid_advance(prev, lr)
         chunks = []
         done = prev.ntrees if prev is not None else 0
         if prev is not None and done >= ntrees:
@@ -437,6 +474,9 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             F, trees = trainer(ctx["codes"], y1, w1, F, kc)
             chunks.append(trees)
             done += k
+            if self._vstate is not None:
+                ta_chunk, _ = self._binned_tree_arrays(ctx, [trees])
+                self._valid_advance(ta_chunk, lr)
             self._record_history(done, F[:n], y, w, dist)
             job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
             if self._should_stop() or job.budget_exhausted:
@@ -455,6 +495,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
     def _fit_binned_multinomial(self, frame: Frame, job):
         """K class trees per iteration through ONE jitted binned program
         (the SharedTree.java:548-561 K-tree layer)."""
+        self._vstate = None   # no multinomial validation series (yet)
         p = self.params
         ctx = self._binned_setup(frame)
         BN, grower, cl = ctx["BN"], ctx["grower"], ctx["cl"]
@@ -537,6 +578,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         }
 
     def _fit_multinomial(self, X, y, w, job):
+        self._vstate = None   # no multinomial validation series (yet)
         K = self.nclasses
         ntrees = int(self.params["ntrees"])
         lr = float(self.params["learn_rate"])
@@ -619,15 +661,63 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             m = M.regression_metrics(y, mu, w)
             h = {"number_of_trees": ntrees, "training_rmse": m.rmse,
                  "training_mae": m.mae, "training_r2": m.r2}
+        h.update(self._valid_history_entry(dist))
         self._output.scoring_history.append(h)
+
+    # ---- incremental validation scoring (ScoreKeeper valid series) -------
+    def _valid_setup(self, f0):
+        """Prepare incremental validation margins: the in-progress model
+        scores the validation frame at every scoring event
+        (SharedTree.doScoringAndSaveModel), so the margins are maintained
+        chunk-by-chunk rather than rebuilt from the final ensemble."""
+        vf = getattr(self, "_valid_for_scoring", None)
+        self._vstate = None
+        if vf is None:
+            return
+        di = self._dinfo
+        nv = int(vf.nrows)
+        Xv = di.matrix(vf)[:nv]
+        yv = di.response(vf)[:nv]
+        wv = di.weights(vf)[:nv]
+        wv = jnp.where(jnp.isnan(yv), 0.0, wv)
+        yv = jnp.where(jnp.isnan(yv), 0.0, yv)
+        Fv = jnp.full(nv, float(np.asarray(f0).ravel()[0]), jnp.float32) \
+            if np.ndim(f0) == 0 or np.size(f0) == 1 else \
+            jnp.tile(jnp.asarray(f0, jnp.float32)[None, :], (nv, 1))
+        self._vstate = {"X": Xv, "y": yv, "w": wv, "F": Fv}
+
+    def _valid_advance(self, new_trees, lr):
+        """Add a just-trained tree batch's contribution to the validation
+        margins (one batched heap-walk over the valid rows)."""
+        if self._vstate is None or new_trees.ntrees == 0:
+            return
+        self._vstate["F"] = self._vstate["F"] + \
+            lr * E.predict_ensemble(self._vstate["X"], new_trees)
+
+    def _valid_history_entry(self, dist="gaussian") -> dict:
+        if getattr(self, "_vstate", None) is None:
+            return {}
+        vs = self._vstate
+        mu = _link_inv_dist(dist, vs["F"],
+                            udf=getattr(self, "_udf_dist", None))
+        if self._is_classifier and mu.ndim == 1:
+            mu = jnp.stack([1.0 - mu, mu], axis=1)
+        vm = self._metrics_from_preds(vs["y"], mu, vs["w"])
+        out = {}
+        for k in ("logloss", "auc", "pr_auc", "rmse", "mae", "r2"):
+            v = getattr(vm, k, None)
+            if v is not None:
+                out[f"validation_{k}"] = v
+        return out
 
     def _record_history_multi(self, ntrees, F, y, w):
         from h2o3_tpu.models import metrics as M
         P = jax.nn.softmax(F, axis=1)
         m = M.multinomial_metrics(y, P, w)
-        self._output.scoring_history.append(
-            {"number_of_trees": ntrees, "training_logloss": m.logloss,
-             "training_classification_error": m.error})
+        h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
+             "training_classification_error": m.error}
+        h.update(self._valid_history_entry())
+        self._output.scoring_history.append(h)
 
     def _should_stop(self) -> bool:
         """ScoreKeeper.stopEarly: stop when the chosen stopping_metric has
@@ -642,17 +732,24 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         metric = None
         explicit = want not in ("auto", "")
         if explicit:
-            for key in hist[-1]:
-                if key.endswith("_" + want):
-                    metric = key
+            # validation series wins when a validation frame was scored
+            for prefix in ("validation_", "training_"):
+                if prefix + want in hist[-1]:
+                    metric = prefix + want
                     break
+            if metric is None:
+                for key in hist[-1]:
+                    if key.endswith("_" + want):
+                        metric = key
+                        break
             if metric is None:
                 raise ValueError(
                     f"stopping_metric={want!r} is not recorded for this "
                     f"problem type (available: {sorted(hist[-1])})")
         if metric is None:
             maximize = False
-            for cand in ("training_logloss", "training_rmse"):
+            for cand in ("validation_logloss", "validation_rmse",
+                         "training_logloss", "training_rmse"):
                 if cand in hist[-1]:
                     metric = cand
                     break
@@ -661,16 +758,17 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         vals = [h[metric] for h in hist]
         # tolerance 0 is a VALID value (stop on any non-improvement):
         # no falsy-or fallback; inclusive comparisons so an exact plateau
-        # stops (ScoreKeeper.stopEarly semantics)
+        # stops; tol scales with |past| so negative metrics (r2 < 0) keep
+        # the intended direction (ScoreKeeper.stopEarly semantics)
         tol_raw = self.params.get("stopping_tolerance")
         tol = 1e-3 if tol_raw is None else float(tol_raw)
         if maximize:
             recent = max(vals[-k:])
             past = max(vals[:-k])
-            return recent <= past * (1 + tol)
+            return recent <= past + tol * abs(past)
         recent = min(vals[-k:])
         past = min(vals[:-k])
-        return recent >= past * (1 - tol)
+        return recent >= past - tol * abs(past)
 
 
 # ---------------------------------------------------------------------------

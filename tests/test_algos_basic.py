@@ -337,3 +337,23 @@ def test_nbins_top_level_raises_resolution():
         ntrees=2, max_depth=3, nbins=20, nbins_top_level=1024, seed=1)
     m.train(y="y", training_frame=f)
     assert m._output.model_summary["nbins_effective"] == 255
+
+
+def test_validation_based_early_stopping():
+    """Early stopping prefers the validation series (ScoreKeeper): a model
+    overfitting the training data stops when VALIDATION logloss stalls."""
+    rng = np.random.default_rng(34)
+    n = 500
+    X = rng.normal(0, 1, (n, 4))
+    y = ((X[:, 0] + rng.normal(0, 1.2, n)) > 0).astype(int)  # noisy signal
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    tr = Frame.from_dict({k: v[:350] for k, v in cols.items()})
+    va = Frame.from_dict({k: v[350:] for k, v in cols.items()})
+    m = h2o3_tpu.models.H2OGradientBoostingEstimator(
+        ntrees=80, max_depth=4, seed=1, stopping_rounds=2,
+        score_tree_interval=5, stopping_tolerance=1e-3)
+    m.train(y="y", training_frame=tr, validation_frame=va)
+    hist = m._output.scoring_history
+    assert "validation_logloss" in hist[-1]      # valid series recorded
+    assert m._trees.ntrees < 80                  # stopped on valid stall
