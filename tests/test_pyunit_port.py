@@ -363,3 +363,71 @@ def test_algo_coxph_risk_ordering():
     coef = m.coef() if hasattr(m, "coef") else m._output.model_summary
     val = coef.get("x") if isinstance(coef, dict) else None
     assert val is not None and val > 0.5
+
+
+# ---- munging part 3: stats / factor / misc prims ------------------------
+def test_munging_seq_rep_len():
+    s = rapids_exec("(seq #2 #10 #2)")
+    np.testing.assert_allclose(s.vecs[0].to_numpy()[:5],
+                               [2, 4, 6, 8, 10])
+    _put("rl", x=np.array([1.0, 2.0, 3.0]))
+    try:
+        r = rapids_exec("(rep_len (cols rl [0]) #7)")
+        np.testing.assert_allclose(r.vecs[0].to_numpy()[:7],
+                                   [1, 2, 3, 1, 2, 3, 1])
+    finally:
+        DKV.remove("rl")
+
+
+def test_munging_grep():
+    _put_str("gr", "s", ["alpha", "beta", "alphabet", "gamma"])
+    try:
+        g = rapids_exec('(grep (cols gr [0]) "alpha" #0 #0 #0 #1)')
+        hits = g.vecs[0].to_numpy()
+        assert set(np.asarray(hits[:2], int)) == {0, 2}
+    finally:
+        DKV.remove("gr")
+
+
+def test_munging_moments():
+    rng = np.random.default_rng(14)
+    x = rng.exponential(1.0, 2000)           # right-skewed
+    _put("mo", x=x)
+    try:
+        sk = rapids_exec("(skewness (cols mo [0]) #0)")
+        ku = rapids_exec("(kurtosis (cols mo [0]) #0)")
+        assert float(np.ravel(sk)[0]) > 1.0   # exponential skewness ~2
+        assert float(np.ravel(ku)[0]) > 4.0   # exponential kurtosis ~9
+    finally:
+        DKV.remove("mo")
+
+
+def test_munging_entropy_distance():
+    _put_str("en", "s", ["aaaa", "abcd"])
+    try:
+        e = rapids_exec("(entropy (cols en [0]))")
+        ev = e.vecs[0].to_numpy()[:2]
+        assert ev[0] < 0.1 and ev[1] > 1.9    # 0 bits vs 2 bits
+    finally:
+        DKV.remove("en")
+    _put_str("d1", "s", ["kitten"])
+    _put_str("d2", "s", ["sitting"])
+    try:
+        d = rapids_exec('(strDistance d1 d2 "lv" #0)')
+        val = float(np.ravel(d.vecs[0].to_numpy() if hasattr(d, "vecs")
+                             else d)[0])
+        # levenshtein("kitten","sitting") = 3 (or normalized similarity)
+        assert val == 3.0 or 0.5 < val < 0.6
+    finally:
+        DKV.remove("d1")
+        DKV.remove("d2")
+
+
+def test_munging_relevel():
+    f = Frame.from_dict(
+        {"g": np.array(["b", "a", "c", "a"], object)}, key="rlv")
+    try:
+        out = rapids_exec('(relevel (cols rlv [0]) "c")')
+        assert out.vecs[0].levels()[0] == "c"
+    finally:
+        DKV.remove("rlv")
