@@ -293,11 +293,11 @@ class BinnedGrower:
         self.axis_name = axis_name
         # int8_stats: quantize (w, wg, wh) to int8 per tree and accumulate
         # histograms on the 2x-rate int8 MXU path with exact i32 sums
-        # (PERF_NOTES item 2; quantum |g|max/127 — same error class as the
-        # bf16 inputs of the f32 kernel). Auto: on where the i8 kernel
-        # proves itself with a probe compile (never brick a TPU gen).
-        self.int8 = HP.i8_supported() if int8_stats is None \
-            else bool(int8_stats)
+        # (PERF_NOTES item 2; quantum |g|max/127). EXPLICIT OPT-IN: the
+        # compile probe (i8_supported) proves the kernel builds, not that
+        # end-to-end model accuracy matches the f32 path; until the on-chip
+        # AUC-parity measurement lands (bench --int8), default stays off.
+        self.int8 = False if int8_stats is None else bool(int8_stats)
         self.spec = spec
         self.D = int(max_depth)
         self.L = 2 ** self.D

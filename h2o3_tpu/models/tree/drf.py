@@ -59,6 +59,11 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
         oob_sum = jnp.zeros(X.shape[0], jnp.float32)
         oob_cnt = jnp.zeros(X.shape[0], jnp.float32)
         if K > 2:
+            if int(self.params.get("stopping_rounds") or 0) > 0:
+                raise NotImplementedError(
+                    "stopping_rounds for multinomial DRF is not supported "
+                    "yet (no per-class OOB vote series); set "
+                    "stopping_rounds=0 or use binomial/regression DRF")
             onehot = jax.nn.one_hot(y.astype(jnp.int32), K)
             trees_k = [[] for _ in range(K)]
             for t in range(ntrees):
@@ -78,7 +83,11 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                     break
             self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
         else:
+            interval = max(1, int(self.params.get("score_tree_interval")
+                                  or 5))
+            self._valid_setup(0.0)
             trees = []
+            scored_at = 0
             for t in range(ntrees):
                 key, k1, k2 = jax.random.split(key, 3)
                 u = jax.random.uniform(k1, w.shape)
@@ -96,14 +105,23 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                               E.node_covers(heap, wt, nodes=grower.nodes,
                                             D=grower.D)))
                 job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
+                if (t + 1) % interval == 0 or t + 1 == ntrees:
+                    if self._vstate is not None:
+                        self._valid_advance(
+                            E.stack_trees(trees[scored_at:], grower.D), 1.0)
+                    scored_at = len(trees)
+                    self._record_history_drf(t + 1, oob_sum, oob_cnt, y, w)
+                    if self._should_stop():
+                        break
                 if job.budget_exhausted:
                     break
             self._trees = E.stack_trees(trees, grower.D)
             self._oob_metrics = self._metrics_from_oob(oob_sum, oob_cnt,
                                                        y, w)
         self._varimp_from_gains(np.asarray(gains_tot, np.float64))
+        built = len(trees_k[0]) if K > 2 else int(self._trees.ntrees)
         self._output.model_summary = {
-            "number_of_trees": ntrees, "max_depth": grower.D,
+            "number_of_trees": built, "max_depth": grower.D,
             "mtries": mtries, "sample_rate": sample_rate,
             "oob_scored": K <= 2,
         }
@@ -128,6 +146,7 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
             oob_sum = jax.device_put(oob_sum, ctx["cl"].rows_sharding(1))
             oob_cnt = jax.device_put(oob_cnt, ctx["cl"].rows_sharding(1))
         interval = max(1, int(p.get("score_tree_interval") or 5))
+        self._valid_setup(0.0)
         chunks = []
         done = 0
         while done < ntrees:
@@ -140,8 +159,12 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
                                               oob_sum, oob_cnt, kc)
             chunks.append(trees)
             done += k
+            if self._vstate is not None:
+                ta_chunk, _ = self._binned_tree_arrays(ctx, [trees])
+                self._valid_advance(ta_chunk, 1.0)
+            self._record_history_drf(done, oob_sum[:n], oob_cnt[:n], y, w)
             job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
-            if job.budget_exhausted:
+            if self._should_stop() or job.budget_exhausted:
                 break
 
         self._trees, gainsT = self._binned_tree_arrays(ctx, chunks)
@@ -154,6 +177,40 @@ class H2ORandomForestEstimator(SharedTreeEstimator):
             "sample_rate": sample_rate, "engine": "binned_pallas",
             "oob_scored": True,
         }
+
+    # ---- scoring history / early stopping (OOB series) -------------------
+    # The reference DRF records its ScoreKeeper series from OOB predictions
+    # (doOOBScoring()=true) and honors stopping_rounds on it; we mirror
+    # that: history entries come from the OOB accumulators, validation
+    # entries from incrementally advanced margins (sum of tree votes,
+    # averaged at scoring time since DRF predicts the ensemble mean).
+    def _record_history_drf(self, done, oob_sum, oob_cnt, y, w):
+        m = self._metrics_from_oob(oob_sum, oob_cnt, y, w)
+        if self._is_classifier:
+            h = {"number_of_trees": done, "training_logloss": m.logloss,
+                 "training_auc": m.auc, "training_pr_auc": m.pr_auc,
+                 "training_rmse": m.rmse}
+        else:
+            h = {"number_of_trees": done, "training_rmse": m.rmse,
+                 "training_mae": m.mae, "training_r2": m.r2}
+        h.update(self._valid_history_entry_drf(done))
+        self._output.scoring_history.append(h)
+
+    def _valid_history_entry_drf(self, done) -> dict:
+        if getattr(self, "_vstate", None) is None:
+            return {}
+        vs = self._vstate
+        mu = vs["F"] / max(done, 1)          # vote sum → ensemble mean
+        if self._is_classifier:
+            mu = jnp.clip(mu, 1e-7, 1.0 - 1e-7)
+            mu = jnp.stack([1.0 - mu, mu], axis=1)
+        vm = self._metrics_from_preds(vs["y"], mu, vs["w"])
+        out = {}
+        for k in ("logloss", "auc", "pr_auc", "rmse", "mae", "r2"):
+            v = getattr(vm, k, None)
+            if v is not None:
+                out[f"validation_{k}"] = v
+        return out
 
     def _metrics_from_oob(self, oob_sum, oob_cnt, y, w):
         """Metrics over rows that were OOB for >= 1 tree, weighted as in

@@ -276,7 +276,7 @@ def node_covers(heap, w, *, nodes, D):
     TreeSHAP): terminal weights from the row router, then children sums
     propagate up the heap level by level."""
     cov = _node_covers_jit(heap, w, nodes=nodes, D=D)
-    if _CPU_BACKEND:
+    if _cpu_backend():
         # same flaky-CPU-collective guard as TreeGrower.grow: this program
         # contains a psum over the sharded row axis — drain before piling on
         jax.block_until_ready(cov)
@@ -450,7 +450,7 @@ class TreeGrower:
                 gains, col_mask, key, d=d, B=self.B, mtries=int(mtries),
                 min_rows=self.min_rows, min_split_improvement=self.msi,
                 reg_lambda=self.reg_lambda)
-            if _CPU_BACKEND:
+            if _cpu_backend():
                 # XLA CPU collectives abort flakily when programs containing
                 # all-reduces pile up in the async queue (virtual-device test
                 # mesh only): drain per level. And since the controller is
@@ -462,9 +462,24 @@ class TreeGrower:
                 if not bool(jnp.any(active)):
                     return colA, thrA, nalA, valA, heap, gains
         valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
-        if _CPU_BACKEND:
+        if _cpu_backend():
             jax.block_until_ready(valA)
         return colA, thrA, nalA, valA, heap, gains
 
 
-_CPU_BACKEND = jax.default_backend() == "cpu"
+_CPU_BACKEND_CACHE: bool | None = None
+
+
+def _cpu_backend() -> bool:
+    """Lazy, memoized backend probe.
+
+    Probing ``jax.default_backend()`` at module import initializes the
+    backend eagerly; when the TPU relay is down that raised (or hung) in
+    *import*, taking down every consumer including bench.py before it
+    could emit a structured record (BENCH_r03 lesson). Defer until the
+    first tree actually trains.
+    """
+    global _CPU_BACKEND_CACHE
+    if _CPU_BACKEND_CACHE is None:
+        _CPU_BACKEND_CACHE = jax.default_backend() == "cpu"
+    return _CPU_BACKEND_CACHE

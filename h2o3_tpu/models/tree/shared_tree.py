@@ -158,7 +158,8 @@ class SharedTreeEstimator(ModelBase):
         # bin edges come from a row sample: STRIDED device slice (a head
         # slice would bias quantiles on ordered data), tiny readback
         stride = max(1, n >> 18)
-        Xs = np.asarray(X[::stride][: 1 << 18])
+        from h2o3_tpu.parallel import mrtask as _mr
+        Xs = _mr.host_fetch(X[::stride][: 1 << 18])
         spec = BN.make_bins(Xs, is_cat, b_val)
 
         cl = MESH.cloud()
@@ -248,6 +249,128 @@ class SharedTreeEstimator(ModelBase):
 
     def _contrib_scale_bias(self):
         return 1.0, 0.0
+
+    # ---- scoring history / early stopping -------------------------------
+    def _record_history(self, ntrees, F, y, w, dist):
+        mu = _link_inv_dist(dist, F, udf=getattr(self, "_udf_dist", None))
+        from h2o3_tpu.models import metrics as M
+        if self._is_classifier:
+            m = M.binomial_metrics(y, mu[:, 1], w)
+            h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
+                 "training_auc": m.auc, "training_pr_auc": m.pr_auc,
+                 "training_rmse": m.rmse}
+        else:
+            m = M.regression_metrics(y, mu, w)
+            h = {"number_of_trees": ntrees, "training_rmse": m.rmse,
+                 "training_mae": m.mae, "training_r2": m.r2}
+        h.update(self._valid_history_entry(dist))
+        self._output.scoring_history.append(h)
+
+    # ---- incremental validation scoring (ScoreKeeper valid series) -------
+    def _valid_setup(self, f0):
+        """Prepare incremental validation margins: the in-progress model
+        scores the validation frame at every scoring event
+        (SharedTree.doScoringAndSaveModel), so the margins are maintained
+        chunk-by-chunk rather than rebuilt from the final ensemble."""
+        vf = getattr(self, "_valid_for_scoring", None)
+        self._vstate = None
+        if vf is None:
+            return
+        di = self._dinfo
+        nv = int(vf.nrows)
+        Xv = di.matrix(vf)[:nv]
+        yv = di.response(vf)[:nv]
+        wv = di.weights(vf)[:nv]
+        wv = jnp.where(jnp.isnan(yv), 0.0, wv)
+        yv = jnp.where(jnp.isnan(yv), 0.0, yv)
+        Fv = jnp.full(nv, float(np.asarray(f0).ravel()[0]), jnp.float32) \
+            if np.ndim(f0) == 0 or np.size(f0) == 1 else \
+            jnp.tile(jnp.asarray(f0, jnp.float32)[None, :], (nv, 1))
+        self._vstate = {"X": Xv, "y": yv, "w": wv, "F": Fv}
+
+    def _valid_advance(self, new_trees, lr):
+        """Add a just-trained tree batch's contribution to the validation
+        margins (one batched heap-walk over the valid rows)."""
+        if self._vstate is None or new_trees.ntrees == 0:
+            return
+        self._vstate["F"] = self._vstate["F"] + \
+            lr * E.predict_ensemble(self._vstate["X"], new_trees)
+
+    def _valid_history_entry(self, dist="gaussian") -> dict:
+        if getattr(self, "_vstate", None) is None:
+            return {}
+        vs = self._vstate
+        mu = _link_inv_dist(dist, vs["F"],
+                            udf=getattr(self, "_udf_dist", None))
+        if self._is_classifier and mu.ndim == 1:
+            mu = jnp.stack([1.0 - mu, mu], axis=1)
+        vm = self._metrics_from_preds(vs["y"], mu, vs["w"])
+        out = {}
+        for k in ("logloss", "auc", "pr_auc", "rmse", "mae", "r2"):
+            v = getattr(vm, k, None)
+            if v is not None:
+                out[f"validation_{k}"] = v
+        return out
+
+    def _record_history_multi(self, ntrees, F, y, w):
+        from h2o3_tpu.models import metrics as M
+        P = jax.nn.softmax(F, axis=1)
+        m = M.multinomial_metrics(y, P, w)
+        h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
+             "training_classification_error": m.error}
+        h.update(self._valid_history_entry())
+        self._output.scoring_history.append(h)
+
+    def _should_stop(self) -> bool:
+        """ScoreKeeper.stopEarly: stop when the chosen stopping_metric has
+        not improved over the last `stopping_rounds` scoring events."""
+        k = int(self.params.get("stopping_rounds") or 0)
+        if k <= 0 or len(self._output.scoring_history) < 2 * k:
+            return False
+        hist = self._output.scoring_history
+        want = str(self.params.get("stopping_metric") or "AUTO").lower()
+        want = {"aucpr": "pr_auc"}.get(want, want)
+        maximize = want in ("auc", "pr_auc", "r2")
+        metric = None
+        explicit = want not in ("auto", "")
+        if explicit:
+            # validation series wins when a validation frame was scored
+            for prefix in ("validation_", "training_"):
+                if prefix + want in hist[-1]:
+                    metric = prefix + want
+                    break
+            if metric is None:
+                for key in hist[-1]:
+                    if key.endswith("_" + want):
+                        metric = key
+                        break
+            if metric is None:
+                raise ValueError(
+                    f"stopping_metric={want!r} is not recorded for this "
+                    f"problem type (available: {sorted(hist[-1])})")
+        if metric is None:
+            maximize = False
+            for cand in ("validation_logloss", "validation_rmse",
+                         "training_logloss", "training_rmse"):
+                if cand in hist[-1]:
+                    metric = cand
+                    break
+        if metric is None:
+            return False
+        vals = [h[metric] for h in hist]
+        # tolerance 0 is a VALID value (stop on any non-improvement):
+        # no falsy-or fallback; inclusive comparisons so an exact plateau
+        # stops; tol scales with |past| so negative metrics (r2 < 0) keep
+        # the intended direction (ScoreKeeper.stopEarly semantics)
+        tol_raw = self.params.get("stopping_tolerance")
+        tol = 1e-3 if tol_raw is None else float(tol_raw)
+        if maximize:
+            recent = max(vals[-k:])
+            past = max(vals[:-k])
+            return recent <= past + tol * abs(past)
+        recent = min(vals[-k:])
+        past = min(vals[:-k])
+        return recent >= past - tol * abs(past)
 
     def _varimp_from_gains(self, gains: np.ndarray):
         names = self._dinfo.feature_names
@@ -506,8 +629,9 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         lr = float(p["learn_rate"])
         seed = int(p.get("seed") or -1)
         key = jax.random.PRNGKey(seed if seed >= 0 else 42)
-        wn = np.asarray(w, np.float64)
-        yin = np.asarray(y.astype(jnp.int32))
+        from h2o3_tpu.parallel import mrtask as _mr
+        wn = _mr.host_fetch(w).astype(np.float64)
+        yin = _mr.host_fetch(y.astype(jnp.int32))
         f0 = np.zeros(K, np.float32)
         for c in range(K):
             pc = (wn * (yin == c)).sum() / max(wn.sum(), 1e-30)
@@ -586,8 +710,9 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         key = jax.random.PRNGKey(seed if seed > 0 else 42)
         grower = self._grower()
         yi = y.astype(jnp.int32)
-        wn = np.asarray(w, np.float64)
-        yin = np.asarray(yi)
+        from h2o3_tpu.parallel import mrtask as _mr
+        wn = _mr.host_fetch(w).astype(np.float64)
+        yin = _mr.host_fetch(yi)
         f0 = np.zeros(K, np.float32)
         for c in range(K):
             pc = (wn * (yin == c)).sum() / max(wn.sum(), 1e-30)
@@ -648,127 +773,6 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
     def _contrib_scale_bias(self):
         return float(self.params["learn_rate"]), float(self._f0)
 
-    # ---- scoring history / early stopping -------------------------------
-    def _record_history(self, ntrees, F, y, w, dist):
-        mu = _link_inv_dist(dist, F, udf=getattr(self, "_udf_dist", None))
-        from h2o3_tpu.models import metrics as M
-        if self._is_classifier:
-            m = M.binomial_metrics(y, mu[:, 1], w)
-            h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
-                 "training_auc": m.auc, "training_pr_auc": m.pr_auc,
-                 "training_rmse": m.rmse}
-        else:
-            m = M.regression_metrics(y, mu, w)
-            h = {"number_of_trees": ntrees, "training_rmse": m.rmse,
-                 "training_mae": m.mae, "training_r2": m.r2}
-        h.update(self._valid_history_entry(dist))
-        self._output.scoring_history.append(h)
-
-    # ---- incremental validation scoring (ScoreKeeper valid series) -------
-    def _valid_setup(self, f0):
-        """Prepare incremental validation margins: the in-progress model
-        scores the validation frame at every scoring event
-        (SharedTree.doScoringAndSaveModel), so the margins are maintained
-        chunk-by-chunk rather than rebuilt from the final ensemble."""
-        vf = getattr(self, "_valid_for_scoring", None)
-        self._vstate = None
-        if vf is None:
-            return
-        di = self._dinfo
-        nv = int(vf.nrows)
-        Xv = di.matrix(vf)[:nv]
-        yv = di.response(vf)[:nv]
-        wv = di.weights(vf)[:nv]
-        wv = jnp.where(jnp.isnan(yv), 0.0, wv)
-        yv = jnp.where(jnp.isnan(yv), 0.0, yv)
-        Fv = jnp.full(nv, float(np.asarray(f0).ravel()[0]), jnp.float32) \
-            if np.ndim(f0) == 0 or np.size(f0) == 1 else \
-            jnp.tile(jnp.asarray(f0, jnp.float32)[None, :], (nv, 1))
-        self._vstate = {"X": Xv, "y": yv, "w": wv, "F": Fv}
-
-    def _valid_advance(self, new_trees, lr):
-        """Add a just-trained tree batch's contribution to the validation
-        margins (one batched heap-walk over the valid rows)."""
-        if self._vstate is None or new_trees.ntrees == 0:
-            return
-        self._vstate["F"] = self._vstate["F"] + \
-            lr * E.predict_ensemble(self._vstate["X"], new_trees)
-
-    def _valid_history_entry(self, dist="gaussian") -> dict:
-        if getattr(self, "_vstate", None) is None:
-            return {}
-        vs = self._vstate
-        mu = _link_inv_dist(dist, vs["F"],
-                            udf=getattr(self, "_udf_dist", None))
-        if self._is_classifier and mu.ndim == 1:
-            mu = jnp.stack([1.0 - mu, mu], axis=1)
-        vm = self._metrics_from_preds(vs["y"], mu, vs["w"])
-        out = {}
-        for k in ("logloss", "auc", "pr_auc", "rmse", "mae", "r2"):
-            v = getattr(vm, k, None)
-            if v is not None:
-                out[f"validation_{k}"] = v
-        return out
-
-    def _record_history_multi(self, ntrees, F, y, w):
-        from h2o3_tpu.models import metrics as M
-        P = jax.nn.softmax(F, axis=1)
-        m = M.multinomial_metrics(y, P, w)
-        h = {"number_of_trees": ntrees, "training_logloss": m.logloss,
-             "training_classification_error": m.error}
-        h.update(self._valid_history_entry())
-        self._output.scoring_history.append(h)
-
-    def _should_stop(self) -> bool:
-        """ScoreKeeper.stopEarly: stop when the chosen stopping_metric has
-        not improved over the last `stopping_rounds` scoring events."""
-        k = int(self.params.get("stopping_rounds") or 0)
-        if k <= 0 or len(self._output.scoring_history) < 2 * k:
-            return False
-        hist = self._output.scoring_history
-        want = str(self.params.get("stopping_metric") or "AUTO").lower()
-        want = {"aucpr": "pr_auc"}.get(want, want)
-        maximize = want in ("auc", "pr_auc", "r2")
-        metric = None
-        explicit = want not in ("auto", "")
-        if explicit:
-            # validation series wins when a validation frame was scored
-            for prefix in ("validation_", "training_"):
-                if prefix + want in hist[-1]:
-                    metric = prefix + want
-                    break
-            if metric is None:
-                for key in hist[-1]:
-                    if key.endswith("_" + want):
-                        metric = key
-                        break
-            if metric is None:
-                raise ValueError(
-                    f"stopping_metric={want!r} is not recorded for this "
-                    f"problem type (available: {sorted(hist[-1])})")
-        if metric is None:
-            maximize = False
-            for cand in ("validation_logloss", "validation_rmse",
-                         "training_logloss", "training_rmse"):
-                if cand in hist[-1]:
-                    metric = cand
-                    break
-        if metric is None:
-            return False
-        vals = [h[metric] for h in hist]
-        # tolerance 0 is a VALID value (stop on any non-improvement):
-        # no falsy-or fallback; inclusive comparisons so an exact plateau
-        # stops; tol scales with |past| so negative metrics (r2 < 0) keep
-        # the intended direction (ScoreKeeper.stopEarly semantics)
-        tol_raw = self.params.get("stopping_tolerance")
-        tol = 1e-3 if tol_raw is None else float(tol_raw)
-        if maximize:
-            recent = max(vals[-k:])
-            past = max(vals[:-k])
-            return recent <= past + tol * abs(past)
-        recent = min(vals[-k:])
-        past = min(vals[:-k])
-        return recent >= past - tol * abs(past)
 
 
 # ---------------------------------------------------------------------------

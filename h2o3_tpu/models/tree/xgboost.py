@@ -216,10 +216,6 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         }
 
     def _fit_multinomial(self, X, y, w, job):
-        if self.params.get("booster") == "dart":
-            raise NotImplementedError(
-                "booster='dart' is implemented for regression/binomial "
-                "xgboost only; multinomial DART is not supported")
         K = self.nclasses
         ntrees = int(self.params["ntrees"])
         eta = float(self.params["learn_rate"])
@@ -236,12 +232,33 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
         trees_k = [[] for _ in range(K)]
         gains_tot = jnp.zeros(X.shape[1], jnp.float32)
         interval = max(1, int(self.params.get("score_tree_interval") or 5))
+        # multinomial DART: one iteration grows a GROUP of K class trees;
+        # dropout operates on whole groups (the K trees of an iteration
+        # share one weight), matching the binomial path's normalize_type
+        # "tree" arithmetic with (n, K) round predictions.
+        dart = self.params.get("booster") == "dart"
+        rate_drop = float(self.params.get("rate_drop") or 0.0)
+        one_drop = bool(self.params.get("one_drop"))
+        skip_drop = float(self.params.get("skip_drop") or 0.0)
+        tree_w: list = []
+        tree_pred: list = []          # per round: (n, K) device array
+        rng = np.random.default_rng(seed if seed >= 0 else 42)
         for t in range(ntrees):
             key, k1, k2 = jax.random.split(key, 3)
-            P = jax.nn.softmax(F, axis=1)
+            F_use = F
+            dropped: list = []
+            if dart and tree_pred and rate_drop > 0 \
+                    and rng.random() >= skip_drop:
+                dmask = rng.random(len(tree_pred)) < rate_drop
+                if one_drop and not dmask.any():
+                    dmask[rng.integers(len(tree_pred))] = True
+                dropped = list(np.nonzero(dmask)[0])
+                for i in dropped:
+                    F_use = F_use - tree_w[i] * tree_pred[i]
+            P = jax.nn.softmax(F_use, axis=1)
             wt = self._sample_weights(w, k1, sample_rate)
             cmask = self._col_mask(X.shape[1], k2)
-            newF = []
+            p_round = []
             for c in range(K):
                 key, kc = jax.random.split(key)
                 g = onehot[:, c] - P[:, c]
@@ -255,13 +272,34 @@ class H2OXGBoostEstimator(H2OGradientBoostingEstimator):
                 cover = E.node_covers(heap, wt * h, nodes=grower.nodes,
                                       D=grower.D)
                 trees_k[c].append((col, thr, nal, val, cover))
-                newF.append(F[:, c] + eta * val[heap])
-            F = jnp.stack(newF, axis=1)
+                p_round.append(val[heap])
+            p_new = jnp.stack(p_round, axis=1)          # (n, K)
+            kdrop = len(dropped)
+            if dart:
+                if kdrop:
+                    scale = kdrop / (kdrop + eta)
+                    new_w = eta / (kdrop + eta)
+                    for i in dropped:
+                        F = F + (scale - 1.0) * tree_w[i] * tree_pred[i]
+                        tree_w[i] *= scale
+                else:
+                    new_w = eta
+                tree_w.append(new_w)
+                tree_pred.append(p_new)
+                F = F + new_w * p_new
+            else:
+                F = F + eta * p_new
             if (t + 1) % interval == 0 or t == ntrees - 1:
                 self._record_history_multi(t + 1, F, y, w)
                 if self._should_stop():
                     break
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"iter {t+1}")
+        if dart and tree_w:
+            # fold round weights into leaf values so lr * sum matches F
+            for c in range(K):
+                trees_k[c] = [
+                    (cl, th, na, v * (tw / eta), cv)
+                    for (cl, th, na, v, cv), tw in zip(trees_k[c], tree_w)]
         self._trees_k = [E.stack_trees(tl, grower.D) for tl in trees_k]
         self._varimp_from_gains(np.asarray(gains_tot, np.float64))
         self._output.model_summary = {

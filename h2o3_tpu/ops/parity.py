@@ -59,6 +59,32 @@ def kernel_parity_check(seed=0):
         devs[f"i8_half={half}"] = d
         assert d == 0, (half, d)       # i32 accumulation is exact
 
+    # radix shallow-window kernel: parity at its whole dispatch regime
+    # (windows 1 and 2, full + half, f32 + i8, n_bins % 16 == 0)
+    if HP.radix_supported():
+        codes2, heap2, stats2, _, _, _, bv2 = _rand_inputs(
+            seed + 3, b_val=255, n_bins=256, L=4)
+        si2 = jnp.asarray(np.random.default_rng(seed + 4).integers(
+            -127, 128, stats2.shape).astype(np.int32))
+        for Lw, half in ((1, False), (2, False), (2, True), (4, True)):
+            basew = Lw - 1
+            l_eff = (Lw + 1) // 2 if half else Lw
+            rp = HP.sbh_hist_radix(codes2, heap2 % Lw + basew, stats2,
+                                   base=basew, L=Lw, n_bins=256, half=half)
+            rx = HP.sbh_hist_xla(codes2, heap2 % Lw + basew, stats2,
+                                 base=basew, L=Lw, n_bins=256, half=half)
+            d = float(jnp.max(jnp.abs(rp - rx[:l_eff])))
+            devs[f"radix_L={Lw}_half={half}"] = d
+            assert d < 1e-2, (Lw, half, d)
+            ri = HP.sbh_hist_radix(codes2, heap2 % Lw + basew, si2,
+                                   base=basew, L=Lw, n_bins=256,
+                                   half=half, int8=True)
+            rxi = HP.sbh_hist_xla(codes2, heap2 % Lw + basew, si2,
+                                  base=basew, L=Lw, n_bins=256, half=half)
+            di = int(jnp.max(jnp.abs(ri - rxi[:l_eff])))
+            devs[f"radix_i8_L={Lw}_half={half}"] = di
+            assert di == 0, (Lw, half, di)
+
     # route: random split tables incl. categorical SET routing + NA dir
     rng = np.random.default_rng(seed + 2)
     Lp = max(8, L)

@@ -357,3 +357,55 @@ def test_validation_based_early_stopping():
     hist = m._output.scoring_history
     assert "validation_logloss" in hist[-1]      # valid series recorded
     assert m._trees.ntrees < 80                  # stopped on valid stall
+
+
+def test_drf_early_stopping_oob_series():
+    """DRF honors stopping_rounds on the OOB ScoreKeeper series
+    (DRF.java doOOBScoring; previously the parameter was silently
+    ignored and all ntrees always built)."""
+    rng = np.random.default_rng(35)
+    n = 500
+    X = rng.normal(0, 1, (n, 3))
+    y = (X[:, 0] > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.array(["n", "p"], object)[y]
+    f = Frame.from_dict(cols)
+    m = h2o3_tpu.models.H2ORandomForestEstimator(
+        ntrees=60, max_depth=4, seed=1, stopping_rounds=2,
+        stopping_metric="AUC", stopping_tolerance=0.0,
+        score_tree_interval=2)
+    m.train(y="y", training_frame=f)
+    hist = m._output.scoring_history
+    assert len(hist) >= 4 and "training_auc" in hist[-1]
+    assert m._output.model_summary["number_of_trees"] < 60
+
+
+def test_drf_validation_series_recorded():
+    rng = np.random.default_rng(36)
+    n = 400
+    X = rng.normal(0, 1, (n, 3))
+    y = X[:, 0] * 2.0 + rng.normal(0, 0.5, n)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = y
+    tr = Frame.from_dict({k: v[:300] for k, v in cols.items()})
+    va = Frame.from_dict({k: v[300:] for k, v in cols.items()})
+    m = h2o3_tpu.models.H2ORandomForestEstimator(
+        ntrees=10, max_depth=4, seed=1, score_tree_interval=5)
+    m.train(y="y", training_frame=tr, validation_frame=va)
+    hist = m._output.scoring_history
+    assert hist and "validation_rmse" in hist[-1]
+
+
+def test_drf_multinomial_stopping_rejected():
+    rng = np.random.default_rng(37)
+    n = 120
+    X = rng.normal(0, 1, (n, 3))
+    y = rng.integers(0, 3, n)
+    cols = {f"x{j}": X[:, j] for j in range(3)}
+    cols["y"] = np.array(["a", "b", "c"], object)[y]
+    f = Frame.from_dict(cols)
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        h2o3_tpu.models.H2ORandomForestEstimator(
+            ntrees=4, max_depth=3, seed=1, stopping_rounds=2).train(
+                y="y", training_frame=f)

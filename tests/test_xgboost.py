@@ -126,11 +126,25 @@ def test_xgboost_dart():
                            np.asarray(base._trees.value))
     assert dart._output.training_metrics.auc > 0.9
 
-    with pytest.raises(NotImplementedError):
-        yc = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
-        cols3 = {f"x{j}": X[:, j] for j in range(4)}
-        cols3["y"] = np.array(["a", "b", "c"], object)[yc]
-        f3 = Frame.from_dict(cols3)
-        m = h2o3_tpu.models.H2OXGBoostEstimator(ntrees=3, booster="dart",
-                                                rate_drop=0.3)
-        m.train(y="y", training_frame=f3)
+
+def test_xgboost_dart_multinomial():
+    """Multinomial DART: per-round group dropout trains a working
+    3-class model whose folded leaf weights score consistently."""
+    rng = np.random.default_rng(51)
+    n = 400
+    X = rng.normal(0, 1, (n, 4))
+    yc = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    cols = {f"x{j}": X[:, j] for j in range(4)}
+    cols["y"] = np.array(["a", "b", "c"], object)[yc]
+    f = Frame.from_dict(cols)
+    m = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=10, max_depth=3, seed=5, booster="dart", rate_drop=0.3,
+        one_drop=True)
+    m.train(y="y", training_frame=f)
+    assert m._output.training_metrics.logloss < 0.7
+    base = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=10, max_depth=3, seed=5)
+    base.train(y="y", training_frame=f)
+    # dropout must actually change the ensemble
+    assert not np.allclose(np.asarray(m._trees_k[0].value),
+                           np.asarray(base._trees_k[0].value))
