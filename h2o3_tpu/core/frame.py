@@ -198,8 +198,9 @@ class Vec:
         na = np.array([s is None or (isinstance(s, float) and math.isnan(s))
                        or (isinstance(s, str) and s == "") for s in sarr])
         if force_type == T_STR:
-            v = Vec(None, Codec("const"), None, n, T_STR, host_data=sarr)
-            return v
+            # device string plane: dictionary codes on device (CStrChunk
+            # analog; see StrVec) — no n-sized host object array retained
+            return StrVec.encode(sarr)
         if domain is None:
             uniq = sorted({str(s) for s, bad in zip(sarr, na) if not bad})
             domain = np.asarray(uniq, dtype=object)
@@ -224,7 +225,9 @@ class Vec:
     def to_numpy(self) -> np.ndarray:
         if self.type == T_STR:
             return self.host_data.copy()
-        x = np.asarray(self.as_f32())[: self.nrows]
+        # host_fetch: in a multi-controller cloud the decoded column spans
+        # every process's shards — gather before fetching
+        x = _mr.host_fetch(self.as_f32())[: self.nrows]
         return x
 
     def levels(self):
@@ -302,6 +305,131 @@ def _sparse_densify(rows, vals, *, pad, n):
     recompile per call and per column."""
     base = jnp.where(jnp.arange(pad) < n, 0.0, jnp.nan)
     return base.at[rows].set(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+class StrVec(Vec):
+    """Device-resident string column — the CStrChunk analog
+    (water/fvec/CStrChunk.java stores string bytes + per-row offsets in the
+    chunk; string Rapids prims are MRTasks over those chunks,
+    water/rapids/ast/prims/string/).
+
+    TPU-native representation: DICTIONARY ENCODING. Rows live on device as
+    int32 dictionary codes (row-sharded over the mesh; -1 = NA/padding);
+    the dictionary of unique strings is host metadata, typically ≪ n.
+    The op classes map as:
+      * value transforms (toupper/trim/gsub/substring/…): applied to the
+        DICTIONARY — O(unique) host work — then codes remap through one
+        device gather. A 2M-row gsub with 1k unique values costs 1k regex
+        calls + one (n,)-gather, never an n-sized host object array.
+      * per-row measures (strlen, countmatches): per-level table built
+        host-side (O(unique)), then one device gather codes→value.
+      * predicates (grep/match/==): per-level bool mask → device gather.
+    The legacy n-sized host object array materializes ONLY if a consumer
+    explicitly asks (`to_numpy`/`host_data`)."""
+
+    def __init__(self, codes_dev, levels, nrows: int):
+        self.codes = codes_dev                 # (padded,) i32, -1 = NA
+        self._levels = np.asarray(levels, dtype=object)
+        super().__init__(None, Codec("const"), None, nrows, T_STR)
+
+    @staticmethod
+    def encode(col: np.ndarray) -> "StrVec":
+        """Dictionary-encode a host object array into device codes."""
+        c = _mesh.cloud()
+        n = len(col)
+        na = np.array([s is None or (isinstance(s, float) and math.isnan(s))
+                       for s in col])
+        strs = np.asarray(["" if bad else str(s)
+                           for s, bad in zip(col, na)], dtype=object)
+        levels, inv = np.unique(strs[~na], return_inverse=True)
+        codes = np.full(n, -1, np.int64)
+        codes[~na] = inv
+        pad = c.padded_rows(n)
+        cp = np.full(pad, -1, np.int32)
+        cp[:n] = codes
+        return StrVec(_mr.device_put_rows(cp), levels, n)
+
+    # ---- Vec surface -----------------------------------------------------
+    @property
+    def padded_len(self) -> int:
+        return int(self.codes.shape[0])
+
+    @property
+    def levels_arr(self) -> np.ndarray:
+        return self._levels
+
+    @property
+    def host_data(self):
+        """Back-compat decode: n-sized object array ON DEMAND only."""
+        codes = _mr.host_fetch(self.codes)[: self.nrows]
+        out = np.empty(self.nrows, object)
+        ok = codes >= 0
+        out[ok] = self._levels[codes[ok]]
+        return out
+
+    @host_data.setter
+    def host_data(self, v):  # Vec.__init__ assigns None; ignore
+        if v is not None:
+            raise AttributeError("StrVec host_data is derived")
+
+    def to_numpy(self) -> np.ndarray:
+        return self.host_data
+
+    # ---- device string ops ----------------------------------------------
+    def map_values(self, fn) -> "StrVec":
+        """Value transform through the dictionary: O(unique) host calls,
+        one device gather to remap codes (levels may merge)."""
+        mapped = np.asarray([fn(s) for s in self._levels], dtype=object)
+        new_levels, remap = (np.unique(mapped, return_inverse=True)
+                             if len(mapped) else (mapped, mapped))
+        tbl = jnp.asarray(np.asarray(remap, np.int32).reshape(-1)
+                          if len(mapped) else np.zeros(1, np.int32))
+        codes2 = _remap_codes(self.codes, tbl)
+        return StrVec(codes2, new_levels, self.nrows)
+
+    def map_values_opt(self, fn) -> "StrVec":
+        """Like map_values but fn may return None (→ NA), e.g. a strsplit
+        part a level doesn't have."""
+        mapped = [fn(s) for s in self._levels]
+        keep = [m for m in mapped if m is not None]
+        new_levels, inv = (np.unique(np.asarray(keep, object),
+                                     return_inverse=True)
+                           if keep else (np.asarray([], object), []))
+        lut = {s: i for i, s in enumerate(new_levels)}
+        remap = np.asarray([-1 if m is None else lut[m] for m in mapped]
+                           or [-1], np.int32)
+        codes2 = _remap_codes(self.codes, jnp.asarray(remap))
+        return StrVec(codes2, new_levels, self.nrows)
+
+    def per_level_f32(self, fn) -> jax.Array:
+        """(padded,) f32 measure: per-level host table + device gather
+        (NaN at NA/padding rows)."""
+        tbl = jnp.asarray(np.asarray(
+            [float(fn(s)) for s in self._levels] or [0.0], np.float32))
+        return _gather_level_f32(self.codes, tbl)
+
+    def level_mask(self, pred) -> jax.Array:
+        """(padded,) f32 0/1 predicate through the dictionary."""
+        return self.per_level_f32(lambda s: 1.0 if pred(s) else 0.0)
+
+    def _compute_rollups(self) -> Rollups:
+        codes = _mr.host_fetch(self.codes)[: self.nrows]
+        nas = int((codes < 0).sum())
+        return Rollups(min=math.nan, max=math.nan, mean=math.nan,
+                       sigma=math.nan, nas=nas, zeros=0, is_int=False)
+
+
+@jax.jit
+def _remap_codes(codes, tbl):
+    safe = jnp.clip(codes, 0, tbl.shape[0] - 1)
+    return jnp.where(codes >= 0, jnp.take(tbl, safe), -1)
+
+
+@jax.jit
+def _gather_level_f32(codes, tbl):
+    safe = jnp.clip(codes, 0, tbl.shape[0] - 1)
+    return jnp.where(codes >= 0, jnp.take(tbl, safe), jnp.nan)
 
 
 # ---------------------------------------------------------------------------

@@ -27,6 +27,10 @@ def _lib():
         lib.fastcsv_parse.restype = ctypes.c_void_p
         lib.fastcsv_parse.argtypes = [ctypes.c_char_p, ctypes.c_char,
                                       ctypes.c_int]
+        lib.fastcsv_parse_range.restype = ctypes.c_void_p
+        lib.fastcsv_parse_range.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                            ctypes.c_long, ctypes.c_long,
+                                            ctypes.c_int]
         lib.fastcsv_nrows.restype = ctypes.c_int64
         lib.fastcsv_nrows.argtypes = [ctypes.c_void_p]
         lib.fastcsv_ncols.restype = ctypes.c_int64
@@ -56,10 +60,17 @@ def available() -> bool:
         return False
 
 
-def parse_columns(path: str, sep: str, header: bool):
-    """Returns list of (numeric ndarray, {row: str}) per column."""
+def parse_columns(path: str, sep: str, header: bool,
+                  start: int = 0, end: int = -1):
+    """Returns list of (numeric ndarray, {row: str}) per column, for the
+    byte range [start, end) (chunk-boundary semantics: a range at
+    start > 0 begins after the first newline and runs through the line
+    straddling `end` — the MultiFileParseTask chunk contract). The
+    ctypes call releases the GIL, so ThreadPoolExecutor over ranges
+    tokenizes in true parallel."""
     lib = _lib()
-    h = lib.fastcsv_parse(path.encode(), sep.encode(), 1 if header else 0)
+    h = lib.fastcsv_parse_range(path.encode(), sep.encode(),
+                                start, end, 1 if header else 0)
     if not h:
         raise IOError(f"fastcsv failed on {path}")
     try:

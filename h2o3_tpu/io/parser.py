@@ -355,8 +355,24 @@ def import_file(path: str, destination_frame: Optional[str] = None,
                 sep: Optional[str] = None) -> Frame:
     """h2o.import_file analog: setup-guess then parse in one call.
     Columnar formats (parquet/ORC/feather/avro) dispatch to the Arrow-backed
-    providers (io/columnar.py); text formats go through ParseSetup."""
+    providers (io/columnar.py); text formats go through ParseSetup.
+    Directories, glob patterns and path lists route to the distributed
+    2-phase parse (io/dparse.py — MultiFileParseTask analog)."""
     from h2o3_tpu.io import uri as _uri
+    if isinstance(path, (list, tuple)) or (
+            isinstance(path, str) and not _uri.is_remote(path)
+            and (os.path.isdir(path) or any(c in path for c in "*?["))):
+        from h2o3_tpu.io import dparse
+        setup = None
+        if header is not None or sep is not None:
+            first = dparse.expand_paths(path)[0]
+            setup = parse_setup(first)
+            if header is not None:
+                setup.header = header
+            if sep is not None:
+                setup.separator = sep
+        return dparse.parse_files(path, setup, destination_frame,
+                                  col_types)
     staged = None
     if _uri.is_remote(path):
         # eager remote read (PersistManager + PersistEagerHTTP / persist-gcs)

@@ -71,9 +71,10 @@ def export_frame(frame: Frame, path: str) -> str:
             arrays[f"zr{j}"] = np.asarray(v.nz_rows)
             arrays[f"zv{j}"] = np.asarray(v.nz_vals)
         elif v.type == "str":
+            data = v.host_data    # one device fetch+decode, not two
             arrays[f"s{j}"] = np.array([x if x is not None else ""
-                                        for x in v.host_data])
-            arrays[f"sm{j}"] = np.array([x is None for x in v.host_data])
+                                        for x in data])
+            arrays[f"sm{j}"] = np.array([x is None for x in data])
         else:
             arrays[f"d{j}"] = np.asarray(v.data)
             if v.mask is not None:

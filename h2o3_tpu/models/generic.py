@@ -63,21 +63,31 @@ class H2OGenericEstimator:
         sc = self._scorer
         m = sc.meta
         rows = []
-        host = {c: test_data.vec(c) for c in test_data.names}
+        # materialize each predictor column ONCE (to_numpy/host_data are
+        # device readbacks — per-row access would be O(n) each)
+        cols_host = {}
+        for c in m["predictors"]:
+            if c not in test_data.names:
+                cols_host[c] = None
+                continue
+            v = test_data.vec(c)
+            cols_host[c] = (v.type, v.host_data if v.type == "str"
+                            else v.to_numpy(), v.domain)
         for i in range(test_data.nrows):
             row = {}
             for c in m["predictors"]:
-                if c not in host:
+                ch = cols_host[c]
+                if ch is None:
                     row[c] = None
                     continue
-                v = host[c]
-                if v.type == "enum":
-                    code = v.to_numpy()[i]
-                    row[c] = None if np.isnan(code) else v.domain[int(code)]
-                elif v.type == "str":
-                    row[c] = v.host_data[i]
+                vtype, data, dom = ch
+                if vtype == "enum":
+                    code = data[i]
+                    row[c] = None if np.isnan(code) else dom[int(code)]
+                elif vtype == "str":
+                    row[c] = data[i]
                 else:
-                    x = v.to_numpy()[i]
+                    x = data[i]
                     row[c] = None if np.isnan(x) else float(x)
             rows.append(row)
         out = sc.predict(rows)

@@ -62,6 +62,20 @@ def shard_sum(x, axis_name=_mesh.ROWS):
     return jax.lax.psum(x, axis_name)
 
 
+def host_fetch(x) -> "np.ndarray":
+    """np.asarray of a possibly globally-sharded jax.Array.
+
+    In a multi-controller runtime (deploy/multihost), fetching an array
+    whose shards live on other processes' devices raises; gather it to
+    every host first (the MRTask result-collection hop). Single-process
+    arrays take the plain fast path."""
+    import numpy as np
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def device_put_rows(host_array, ndim=None):
     """Place a host array onto the mesh row-sharded (dim 0 over "rows").
 

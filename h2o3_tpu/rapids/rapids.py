@@ -625,7 +625,14 @@ def _assign(a, e):
     key = a[0]
     val = _eval(a[1], e)
     if isinstance(val, Frame):
-        DKV.remove(val.key)
+        if val.key and DKV.get(val.key) is val:
+            # identity-returning prims (as.factor on an already-enum col,
+            # …) hand back the SOURCE frame: alias with a fresh handle
+            # instead of stealing its key (which silently dropped the
+            # source binding)
+            val = Frame(list(val.names), list(val.vecs))
+        else:
+            DKV.remove(val.key)
         val.key = key
     DKV.put(key, val)
     e.session.register(key)
@@ -930,8 +937,14 @@ def _impute(a, e):
 
 # ---- string ops (prims/string) --------------------------------------------
 def _str_map(args, env, fn):
+    from h2o3_tpu.core.frame import StrVec
     f = _eval(args[0], env)
     v = f.vecs[0]
+    if isinstance(v, StrVec):
+        # device string plane: transform the DICTIONARY (O(unique) host
+        # calls), remap codes with one device gather — the n-sized host
+        # object array never materializes (CStrChunk MRTask analog)
+        return Frame(f.names[:1], [v.map_values(fn)])
     if v.type == T_STR:
         data = v.host_data
         out = np.array([None if s is None else fn(s) for s in data], object)
@@ -958,8 +971,13 @@ def _trim(a, e): return _str_map(a, e, str.strip)
 
 @prim("nchar", "strlen", "length")
 def _nchar(a, e):
+    from h2o3_tpu.core.frame import StrVec
     f = _eval(a[0], e)
     v = f.vecs[0]
+    if isinstance(v, StrVec):
+        # per-level length table + one device gather: O(unique) host work
+        x = v.per_level_f32(len)[: v.nrows]
+        return Frame(f.names[:1], [Vec.from_device_floats(x)])
     if v.type == T_STR:
         out = np.array([np.nan if s is None else float(len(s))
                         for s in v.host_data])
@@ -973,17 +991,24 @@ def _nchar(a, e):
 
 @prim("replaceall", "gsub")
 def _gsub(a, e):
-    pat = _eval(a[0], e)
-    rep = _eval(a[1], e)
-    rest = a[2:]
-    return _str_map(rest, e, lambda s: re.sub(pat, rep, s))
+    """(replaceall fr pattern replacement ignore_case) —
+    AstReplaceAll.java argument order."""
+    pat = _eval(a[1], e)
+    rep = _eval(a[2], e)
+    ic = bool(_eval(a[3], e)) if len(a) > 3 else False
+    flags = re.IGNORECASE if ic else 0
+    return _str_map(a[:1], e, lambda s: re.sub(pat, rep, s, flags=flags))
 
 
 @prim("replacefirst", "sub")
 def _sub_str(a, e):
-    pat = _eval(a[0], e)
-    rep = _eval(a[1], e)
-    return _str_map(a[2:], e, lambda s: re.sub(pat, rep, s, count=1))
+    """(replacefirst fr pattern replacement ignore_case)."""
+    pat = _eval(a[1], e)
+    rep = _eval(a[2], e)
+    ic = bool(_eval(a[3], e)) if len(a) > 3 else False
+    flags = re.IGNORECASE if ic else 0
+    return _str_map(a[:1], e,
+                    lambda s: re.sub(pat, rep, s, count=1, flags=flags))
 
 
 @prim("substring")
@@ -996,9 +1021,21 @@ def _substring(a, e):
 
 @prim("strsplit")
 def _strsplit(a, e):
+    from h2o3_tpu.core.frame import StrVec
     f = _eval(a[0], e)
     pat = _eval(a[1], e)
     v = f.vecs[0]
+    if isinstance(v, StrVec):
+        # split the DICTIONARY once; each output part is a StrVec sharing
+        # the row codes (missing parts -> NA via map_values_opt)
+        lv_parts = [re.split(pat, s) for s in v.levels_arr]
+        width = max((len(p) for p in lv_parts), default=0)
+        by_level = {s: p for s, p in zip(v.levels_arr, lv_parts)}
+        cols = [v.map_values_opt(
+                    lambda s, j=j: (by_level[s][j]
+                                    if j < len(by_level[s]) else None))
+                for j in range(width)]
+        return Frame([f"C{j+1}" for j in range(width)], cols)
     data = v.host_data if v.type == T_STR else np.array(
         [None if math.isnan(c) else v.levels()[int(c)] for c in v.to_numpy()],
         object)
@@ -1013,10 +1050,15 @@ def _strsplit(a, e):
 
 @prim("countmatches")
 def _countmatches(a, e):
+    from h2o3_tpu.core.frame import StrVec
     f = _eval(a[0], e)
     pat = _eval(a[1], e)
     pats = pat if isinstance(pat, list) else [pat]
     v = f.vecs[0]
+    if isinstance(v, StrVec):
+        x = v.per_level_f32(
+            lambda s: float(sum(s.count(p) for p in pats)))[: v.nrows]
+        return Frame(f.names[:1], [Vec.from_device_floats(x)])
     data = v.host_data if v.type == T_STR else np.array(
         [None if math.isnan(c) else v.levels()[int(c)] for c in v.to_numpy()],
         object)

@@ -80,25 +80,73 @@ void put_cell(ParseResult* r, size_t col, int64_t row, const char* s,
 
 }  // namespace
 
+namespace {
+
+// Parse the byte buffer [p, endp) into r (quote-aware, sequential).
+void parse_buffer(ParseResult* r, const char* p, const char* endp,
+                  char sep, int skip_header);
+
+}  // namespace
+
 extern "C" {
 
-// Parse a CSV file. Returns an opaque handle (nullptr on error).
-void* fastcsv_parse(const char* path, char sep, int skip_header) {
+// Parse a byte range of a CSV file — the unit of the distributed 2-phase
+// parse (water/parser/FVecParseReader chunk semantics): a chunk at
+// start > 0 skips forward past the first '\n' (the previous chunk owns
+// that partial line) and parses THROUGH the first '\n' at/after `end`,
+// so every line is parsed exactly once across adjacent ranges.
+// Caveat shared with the reference's chunked reader: a quoted field
+// containing '\n' must not straddle a range boundary (range boundaries
+// are caller-aligned to multi-MB, making this astronomically unlikely;
+// the single-range path has no such constraint).
+void* fastcsv_parse_range(const char* path, char sep, long start, long end,
+                          int skip_header) {
     FILE* f = fopen(path, "rb");
     if (!f) return nullptr;
     fseek(f, 0, SEEK_END);
     long size = ftell(f);
-    fseek(f, 0, SEEK_SET);
-    std::vector<char> buf(size);
-    if (size > 0 && fread(buf.data(), 1, size, f) != (size_t)size) {
+    if (end < 0 || end > size) end = size;
+    if (start < 0) start = 0;
+    // extend end through the line straddling it
+    long ext = end;
+    if (ext < size) {
+        fseek(f, ext, SEEK_SET);
+        int ch;
+        while (ext < size && (ch = fgetc(f)) != EOF) {
+            ext++;
+            if (ch == '\n') break;
+        }
+    }
+    fseek(f, start, SEEK_SET);
+    std::vector<char> buf(ext - start);
+    if (ext > start &&
+        fread(buf.data(), 1, ext - start, f) != (size_t)(ext - start)) {
         fclose(f);
         return nullptr;
     }
     fclose(f);
-
-    auto* r = new ParseResult();
     const char* p = buf.data();
-    const char* endp = p + size;
+    const char* endp = p + buf.size();
+    if (start > 0) {  // skip the partial first line (previous chunk's)
+        while (p < endp && *p != '\n') p++;
+        if (p < endp) p++;
+    }
+    auto* r = new ParseResult();
+    parse_buffer(r, p, endp, sep, start == 0 ? skip_header : 0);
+    return r;
+}
+
+// Parse a whole CSV file. Returns an opaque handle (nullptr on error).
+void* fastcsv_parse(const char* path, char sep, int skip_header) {
+    return fastcsv_parse_range(path, sep, 0, -1, skip_header);
+}
+
+}  // extern "C"
+
+namespace {
+
+void parse_buffer(ParseResult* r, const char* p, const char* endp,
+                  char sep, int skip_header) {
     bool in_quote = false;
     const char* field_start = p;
     size_t col = 0;
@@ -154,8 +202,11 @@ void* fastcsv_parse(const char* path, char sep, int skip_header) {
             c.na_count++;
         }
     }
-    return r;
 }
+
+}  // namespace
+
+extern "C" {
 
 int64_t fastcsv_nrows(void* h) { return ((ParseResult*)h)->nrows; }
 int64_t fastcsv_ncols(void* h) { return (int64_t)((ParseResult*)h)->cols.size(); }

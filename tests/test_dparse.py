@@ -1,0 +1,125 @@
+"""Distributed 2-phase parse (io/dparse.py) vs the sequential path.
+
+Reference: water/parser/ParseDataset.java:253 (MultiFileParseTask over
+byte-range chunks), :356-440 (cluster-wide categorical merge + renumber).
+The chunked/multi-file parse must produce a frame IDENTICAL to the
+single-sequential path regardless of chunk geometry."""
+
+import os
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import T_CAT, T_NUM
+from h2o3_tpu.io import dparse
+from h2o3_tpu.io.parser import import_file, parse
+
+
+def _write_csv(path, n, seed, header=True):
+    rng = np.random.default_rng(seed)
+    cats = np.array(["alpha", "beta", "gamma", "delta", "eps"])
+    with open(path, "w") as f:
+        if header:
+            f.write("num,cat,mixed,t\n")
+        for i in range(n):
+            num = f"{rng.normal():.6f}" if rng.random() > 0.05 else "NA"
+            cat = cats[rng.integers(0, len(cats))]
+            mixed = (cat if rng.random() < 0.5
+                     else str(rng.integers(0, 100)))
+            t = f"2024-0{rng.integers(1, 9)}-1{rng.integers(0, 9)}"
+            f.write(f"{num},{cat},{mixed},{t}\n")
+
+
+def _assert_frames_equal(a, b):
+    assert a.nrows == b.nrows and a.names == b.names
+    for name in a.names:
+        va, vb = a.vec(name), b.vec(name)
+        assert va.type == vb.type, name
+        if va.type == T_CAT:
+            # identical decoded strings (domains may order identically too,
+            # but compare decoded values to be robust)
+            da, db = va.levels(), vb.levels()
+            xa, xb = va.to_numpy(), vb.to_numpy()
+            sa = [None if np.isnan(x) else da[int(x)] for x in xa]
+            sb = [None if np.isnan(x) else db[int(x)] for x in xb]
+            assert sa == sb, name
+        else:
+            np.testing.assert_allclose(va.to_numpy(), vb.to_numpy(),
+                                       rtol=1e-6, equal_nan=True)
+
+
+def test_chunked_parse_identical_to_sequential(tmp_path):
+    p = str(tmp_path / "a.csv")
+    _write_csv(p, 500, seed=1)
+    seq = parse(p)
+    # tiny chunk size -> many byte-range chunks crossing row boundaries
+    chunked = dparse.parse_files([p], chunk_bytes=1 << 10)
+    _assert_frames_equal(seq, chunked)
+
+
+def test_multifile_parse_merges_categoricals(tmp_path):
+    # file B contains levels file A never sees: the global domain must
+    # be the union and codes renumbered (EnumUpdateTask)
+    pa, pb = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    with open(pa, "w") as f:
+        f.write("x,c\n1,aa\n2,bb\n")
+    with open(pb, "w") as f:
+        f.write("x,c\n3,cc\n4,aa\n")
+    fr = dparse.parse_files([pa, pb])
+    assert fr.nrows == 4
+    v = fr.vec("c")
+    assert v.type == T_CAT and sorted(v.levels()) == ["aa", "bb", "cc"]
+    dec = [v.levels()[int(x)] for x in v.to_numpy()]
+    assert dec == ["aa", "bb", "cc", "aa"]
+    np.testing.assert_allclose(fr.vec("x").to_numpy(), [1, 2, 3, 4])
+
+
+def test_directory_import_routes_to_dparse(tmp_path):
+    d = tmp_path / "dir"
+    d.mkdir()
+    _write_csv(str(d / "part1.csv"), 60, seed=2)
+    _write_csv(str(d / "part2.csv"), 40, seed=3)
+    fr = import_file(str(d))
+    assert fr.nrows == 100
+    assert fr.vec("num").type == T_NUM
+
+
+def test_glob_import(tmp_path):
+    _write_csv(str(tmp_path / "g1.csv"), 30, seed=4)
+    _write_csv(str(tmp_path / "g2.csv"), 30, seed=5)
+    fr = import_file(str(tmp_path / "g*.csv"))
+    assert fr.nrows == 60
+
+
+def test_python_fallback_range_contract(tmp_path):
+    """The pure-python range tokenizer obeys the same chunk contract as
+    the native one: each line parsed exactly once across ranges."""
+    p = str(tmp_path / "c.csv")
+    with open(p, "w") as f:
+        f.write("x\n")
+        for i in range(100):
+            f.write(f"{i}\n")
+    size = os.path.getsize(p)
+    mid = size // 2
+    c1 = dparse._tokenize_range_py(p, ",", True, 0, mid)
+    c2 = dparse._tokenize_range_py(p, ",", True, mid, size)
+    got = np.concatenate([c1[0][0], c2[0][0]])
+    np.testing.assert_allclose(got, np.arange(100))
+
+
+@pytest.mark.slow
+def test_ingest_throughput_multichunk(tmp_path):
+    """Honest throughput record: chunked parse of a larger file; the 10x
+    target needs a many-core host (this CI box has 1), so assert
+    correctness + record MB/s to stderr rather than a speedup."""
+    import sys
+    import time
+    p = str(tmp_path / "big.csv")
+    _write_csv(p, 50_000, seed=6)
+    t0 = time.time()
+    fr = dparse.parse_files([p], chunk_bytes=1 << 20)
+    dt = time.time() - t0
+    assert fr.nrows == 50_000
+    mb = os.path.getsize(p) / 1e6
+    print(f"dparse: {mb / dt:.1f} MB/s over {mb:.1f} MB "
+          f"({os.cpu_count()} cores)", file=sys.stderr)
