@@ -129,11 +129,15 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._check_auth():
             return
         path = urllib.parse.urlparse(self.path).path
-        # SPMD replay (deploy/multihost): mutating requests broadcast to
-        # every worker BEFORE local dispatch so all hosts issue the same
-        # device programs (a lone host in a collective would deadlock)
+        # SPMD replay (deploy/multihost): requests broadcast to every
+        # worker BEFORE local dispatch so all hosts issue the same device
+        # programs (a lone host in a collective would deadlock). GETs are
+        # included — frame rollups, dataset downloads and diagnostics all
+        # jit/readback over globally sharded arrays, and in a
+        # multi-controller runtime those launches must be collective too;
+        # replaying an idempotent GET is free, deadlocking the cloud isn't.
         bc = getattr(self.server, "broadcaster", None)
-        if bc is not None and method in ("POST", "DELETE"):
+        if bc is not None and not _is_static_path(path):
             params = self._params()
             self._cached_params = params
             bc.broadcast(method, path, params)
@@ -148,6 +152,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(f"no route {method} {path}", 404)
         except Exception as ex:  # noqa: BLE001 — handler errors → H2OError
             self._error(repr(ex), 500)
+
+
+def _is_static_path(path: str) -> bool:
+    """Static Flow-UI assets never touch device arrays — broadcasting
+    them would serialize page loads behind the cluster replay barrier."""
+    return path == "/" or path.startswith("/flow")
 
 
 def _json_default(o):
@@ -555,6 +565,10 @@ ROUTES = [
 from h2o3_tpu.api import routes_ext as _ext  # noqa: E402
 
 ROUTES += _ext.build_routes()
+
+from h2o3_tpu.api import routes_ext2 as _ext2  # noqa: E402
+
+ROUTES += _ext2.build_routes()
 
 # Flow-lite UI (h2o-web analog) at / and /flow/index.html
 from h2o3_tpu.api import flow as _flow  # noqa: E402

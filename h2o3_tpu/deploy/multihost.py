@@ -67,7 +67,76 @@ def bootstrap(n_rows_shards=None, n_model_shards: int = 1):
 # has no analog: its nodes exchange data via RPC; SPMD replicates control.)
 # Requests replay serially in arrival order; concurrent builds are
 # serialized by the broadcast lock.
+#
+# Channel security: frames are JSON (never pickle — a spoofed peer must not
+# get arbitrary-object deserialization) authenticated with HMAC-SHA256 under
+# a shared secret (H2O3_CLUSTER_SECRET, injected by the StatefulSet secret).
+# Connection setup is a mutual challenge-response — the coordinator proves
+# freshness to the worker and vice versa — and subsequent frames are keyed
+# by a per-session key derived from both nonces with a monotone sequence
+# number, so neither a rogue pod that races a worker's slot nor a replayed
+# capture of an earlier session is accepted.
 _BCAST_PORT_OFFSET = 2
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+def _cluster_secret() -> bytes:
+    s = os.environ.get("H2O3_CLUSTER_SECRET", "")
+    if not s:
+        raise RuntimeError(
+            "H2O3_CLUSTER_SECRET is required for the multi-host replay "
+            "channel (the k8s chart injects it from a Secret; for local "
+            "clouds export any shared random string)")
+    return s.encode()
+
+
+def _recvall(sock, n: int) -> bytes:
+    import socket as _socket
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf), _socket.MSG_WAITALL)
+        if not part:
+            return b""
+        buf += part
+    return buf
+
+
+def _send_frame(sock, key: bytes, obj) -> None:
+    import hashlib
+    import hmac
+    import json
+    import struct
+    payload = json.dumps(obj).encode()
+    tag = hmac.new(key, payload, hashlib.sha256).digest()
+    sock.sendall(struct.pack("!I", len(payload)) + tag + payload)
+
+
+def _recv_frame(sock, key: bytes):
+    import hashlib
+    import hmac
+    import json
+    import struct
+    hdr = _recvall(sock, 4)
+    if not hdr:
+        return None
+    (ln,) = struct.unpack("!I", hdr)
+    if ln > _MAX_FRAME:
+        raise RuntimeError(f"replay channel: oversized frame ({ln} bytes)")
+    tag = _recvall(sock, 32)
+    payload = _recvall(sock, ln)
+    if len(tag) != 32 or len(payload) != ln:
+        return None
+    want = hmac.new(key, payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, want):
+        raise RuntimeError("replay channel: HMAC mismatch (untrusted peer?)")
+    return json.loads(payload)
+
+
+def _session_key(secret: bytes, nonce_c: str, nonce_w: str) -> bytes:
+    import hashlib
+    import hmac
+    return hmac.new(secret, f"{nonce_c}:{nonce_w}".encode(),
+                    hashlib.sha256).digest()
 
 
 class _ReplayHandler:
@@ -103,41 +172,67 @@ def replay_request(method: str, path: str, params: dict):
 
 
 class Broadcaster:
-    """Process-0 side: fan each mutating request out to every worker and
-    wait for receipt acks (ordering barrier) before local dispatch."""
+    """Process-0 side: fan each request out to every worker and wait for
+    receipt acks (ordering barrier) before local dispatch. Accepts only
+    peers that pass the mutual challenge-response under the cluster
+    secret; unauthenticated connections are dropped and the slot re-armed."""
 
     def __init__(self, n_workers: int, port: int):
+        import secrets as _secrets
         import socket
         import threading
+        secret = _cluster_secret()
         self._lock = threading.Lock()
-        self._conns = []
+        self._conns = []          # [(sock, session_key)]
+        self._seq = 0
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("0.0.0.0", port))
         srv.listen(n_workers)
-        for _ in range(n_workers):
-            conn, _addr = srv.accept()
-            self._conns.append(conn)
+        seen = set()
+        while len(self._conns) < n_workers:
+            conn, addr = srv.accept()
+            try:
+                conn.settimeout(10.0)
+                nonce_c = _secrets.token_hex(16)
+                _send_frame(conn, secret, {"challenge": nonce_c})
+                hello = _recv_frame(conn, secret)
+                if (not hello or hello.get("echo") != nonce_c
+                        or not isinstance(hello.get("hello"), int)
+                        or hello["hello"] in seen):
+                    raise RuntimeError(f"bad hello from {addr}")
+                nonce_w = str(hello.get("nonce", ""))
+                key = _session_key(secret, nonce_c, nonce_w)
+                _send_frame(conn, key, {"welcome": hello["hello"]})
+                conn.settimeout(None)
+                seen.add(hello["hello"])
+                self._conns.append((conn, key))
+            except Exception as ex:  # noqa: BLE001 — drop peer, re-arm slot
+                print(f"replay channel: rejected peer {addr}: {ex}")
+                conn.close()
         srv.close()
 
     def broadcast(self, method: str, path: str, params: dict):
-        import pickle
-        import struct
-        payload = pickle.dumps((method, path, params))
         with self._lock:
-            for c in self._conns:
-                c.sendall(struct.pack("!I", len(payload)) + payload)
-            for c in self._conns:
-                ack = c.recv(1)           # receipt ack: ordering barrier
-                assert ack == b"\x01"
+            self._seq += 1
+            msg = {"seq": self._seq, "method": method, "path": path,
+                   "params": params}
+            for c, key in self._conns:
+                _send_frame(c, key, msg)
+            for c, key in self._conns:
+                ack = _recv_frame(c, key)  # receipt ack: ordering barrier
+                assert ack and ack.get("ack") == self._seq
 
 
 def worker_loop(coordinator_host: str, port: int):
-    """Worker side: block on the broadcast socket, replay each request."""
-    import pickle
+    """Worker side: authenticate the coordinator, then block on the
+    broadcast socket and replay each request in sequence order."""
+    import secrets as _secrets
     import socket
-    import struct
     import time as _time
+    secret = _cluster_secret()
+    import jax
+    pid = jax.process_index()
     for _ in range(120):                  # wait for process 0 to listen
         try:
             sock = socket.create_connection((coordinator_host, port))
@@ -146,16 +241,28 @@ def worker_loop(coordinator_host: str, port: int):
             _time.sleep(1)
     else:
         raise RuntimeError("broadcast coordinator unreachable")
+    chal = _recv_frame(sock, secret)
+    if not chal or "challenge" not in chal:
+        raise RuntimeError("replay channel: no challenge from coordinator")
+    nonce_w = _secrets.token_hex(16)
+    _send_frame(sock, secret,
+                {"hello": pid, "echo": chal["challenge"], "nonce": nonce_w})
+    key = _session_key(secret, chal["challenge"], nonce_w)
+    welcome = _recv_frame(sock, key)      # proves coordinator freshness too
+    if not welcome or welcome.get("welcome") != pid:
+        raise RuntimeError("replay channel: coordinator failed handshake")
+    expect = 1
     while True:
-        hdr = sock.recv(4, socket.MSG_WAITALL)
-        if not hdr:
+        msg = _recv_frame(sock, key)
+        if msg is None:
             return
-        (ln,) = struct.unpack("!I", hdr)
-        method, path, params = pickle.loads(
-            sock.recv(ln, socket.MSG_WAITALL))
-        sock.sendall(b"\x01")             # ack receipt, then execute
+        if msg.get("seq") != expect:      # replayed/reordered frame
+            raise RuntimeError(f"replay channel: bad seq {msg.get('seq')}"
+                               f" (expected {expect})")
+        expect += 1
+        _send_frame(sock, key, {"ack": msg["seq"]})  # ack, then execute
         try:
-            replay_request(method, path, params)
+            replay_request(msg["method"], msg["path"], msg["params"])
         except Exception:                 # keep replaying; process 0 owns
             import traceback              # error reporting to the client
             traceback.print_exc()
@@ -173,6 +280,14 @@ def serve(port: int = 54321):
         from h2o3_tpu.api.server import H2OServer
         from h2o3_tpu.utils import config as _cfg
         _cfg.set_property("api.bind_all", True)
+        # Binding 0.0.0.0 without credentials exposes the whole modeling
+        # surface to the pod network; require auth unless explicitly waived
+        # (mirrors the reference's -disable_web/-hash_login posture).
+        if (not _cfg.get_property("api.auth_file", None)
+                and os.environ.get("H2O3_INSECURE_BIND_ALL") != "1"):
+            raise RuntimeError(
+                "serve() binds 0.0.0.0: configure ai.h2o.api.auth_file "
+                "(Basic auth) or set H2O3_INSECURE_BIND_ALL=1 to waive")
         srv = H2OServer(port)
         if nproc > 1:
             srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)

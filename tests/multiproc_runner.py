@@ -1,0 +1,34 @@
+"""Entry point for the real multi-process SPMD test (one invocation per
+process). Forms a 2-process jax.distributed CPU cloud, then runs the
+deploy/multihost serve() path: process 0 serves REST + broadcasts, worker
+replays — the multiNodeUtils.sh 4-JVM local-cloud analog, reduced to 2.
+
+Usage: python multiproc_runner.py <process_id> <num_procs> <coord_port> \
+           <rest_port>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, coord_port, rest_port = (int(a) for a in sys.argv[1:5])
+    # sitecustomize imports jax at interpreter start, so the JAX_PLATFORMS
+    # env var is read too late — force the backend via config (the same
+    # workaround tests/conftest.py uses)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("H2O3_CLUSTER_SECRET", "multiproc-test-secret")
+    os.environ["H2O3_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
+    os.environ["H2O3_NUM_PROCESSES"] = str(nproc)
+    os.environ["H2O3_PROCESS_ID"] = str(pid)
+    os.environ["H2O3_INSECURE_BIND_ALL"] = "1"   # loopback-only test
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from h2o3_tpu.deploy import multihost
+    multihost.serve(rest_port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""SPMD replay-channel security: JSON+HMAC framing, mutual
+challenge-response handshake, sequence enforcement (no pickle anywhere).
+
+Reference relationship: the reference's multi-node control plane is
+authenticated-by-deployment (YARN/k8s network policy); our replay channel
+carries REST requests between controller processes, so it authenticates
+peers itself (ADVICE r3: unauthenticated pickle channel = RCE)."""
+
+import socket
+import threading
+
+import pytest
+
+from h2o3_tpu.deploy import multihost as MH
+
+
+@pytest.fixture()
+def secret_env(monkeypatch):
+    monkeypatch.setenv("H2O3_CLUSTER_SECRET", "test-cluster-secret")
+    return b"test-cluster-secret"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _worker_handshake(sock, secret, pid=0):
+    chal = MH._recv_frame(sock, secret)
+    nonce_w = "deadbeef" * 4
+    MH._send_frame(sock, secret,
+                   {"hello": pid, "echo": chal["challenge"],
+                    "nonce": nonce_w})
+    key = MH._session_key(secret, chal["challenge"], nonce_w)
+    welcome = MH._recv_frame(sock, key)
+    assert welcome == {"welcome": pid}
+    return key
+
+
+def test_broadcast_roundtrip(secret_env):
+    port = _free_port()
+    out = {}
+
+    def coord():
+        bc = MH.Broadcaster(1, port)
+        out["bc"] = bc
+        bc.broadcast("POST", "/3/Frames", {"a": "1"})
+        bc.broadcast("GET", "/3/Cloud", {})
+
+    t = threading.Thread(target=coord, daemon=True)
+    t.start()
+    sock = _connect(port)
+    key = _worker_handshake(sock, secret_env)
+    m1 = MH._recv_frame(sock, key)
+    assert m1 == {"seq": 1, "method": "POST", "path": "/3/Frames",
+                  "params": {"a": "1"}}
+    MH._send_frame(sock, key, {"ack": 1})
+    m2 = MH._recv_frame(sock, key)
+    assert m2["seq"] == 2 and m2["method"] == "GET"
+    MH._send_frame(sock, key, {"ack": 2})
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def _connect(port, tries=50):
+    import time
+    for _ in range(tries):
+        try:
+            return socket.create_connection(("127.0.0.1", port))
+        except OSError:
+            time.sleep(0.1)
+    raise RuntimeError("coordinator not listening")
+
+
+def test_unauthenticated_peer_rejected(secret_env):
+    """A peer without the secret is dropped and its worker slot re-armed;
+    a legitimate peer connecting after still completes the handshake."""
+    port = _free_port()
+
+    def coord():
+        MH.Broadcaster(1, port)
+
+    t = threading.Thread(target=coord, daemon=True)
+    t.start()
+    rogue = _connect(port)
+    # rogue can read the (secret-tagged) challenge frame but cannot forge
+    # a valid reply; send garbage
+    rogue.sendall(b"\x00\x00\x00\x04" + b"x" * 32 + b"evil")
+    rogue.close()
+    good = _connect(port)
+    _worker_handshake(good, secret_env)
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_wrong_secret_hmac_mismatch(secret_env):
+    port = _free_port()
+
+    def coord():
+        try:
+            MH.Broadcaster(1, port)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=coord, daemon=True)
+    t.start()
+    sock = _connect(port)
+    with pytest.raises(RuntimeError, match="HMAC mismatch"):
+        MH._recv_frame(sock, b"the-wrong-secret")
+    sock.close()
+
+
+def test_secret_required(monkeypatch):
+    monkeypatch.delenv("H2O3_CLUSTER_SECRET", raising=False)
+    with pytest.raises(RuntimeError, match="H2O3_CLUSTER_SECRET"):
+        MH._cluster_secret()
+
+
+def test_no_pickle_in_channel():
+    import inspect
+    src = inspect.getsource(MH)
+    assert "import pickle" not in src and "pickle." not in src

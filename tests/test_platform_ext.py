@@ -128,7 +128,7 @@ def test_deploy_manifests_parse():
     assert "h2o3-tpu" in open(chart).read()
 
 
-def test_multihost_request_replay(cloud8):
+def test_multihost_request_replay(cloud8, monkeypatch):
     """SPMD replay layer: a mutating request reaches process 0's handler
     AND every worker's replay loop (here: one worker thread in-process),
     so all hosts issue the same programs."""
@@ -137,6 +137,9 @@ def test_multihost_request_replay(cloud8):
     from h2o3_tpu.api.server import H2OServer
     from h2o3_tpu.deploy import multihost
     from h2o3_tpu.ext import H2OExtension, register_extension
+
+    # the replay channel authenticates with the cluster secret now
+    monkeypatch.setenv("H2O3_CLUSTER_SECRET", "test-secret")
 
     hits = {"n": 0}
 

@@ -1,0 +1,203 @@
+"""REST long-tail part-2 routes (api/routes_ext2.py) — the push toward
+RequestServer.java's ~150-route surface: frame introspection, job control,
+MakeGLMModel/RegPath/DataInfoFrame, NPS, segment builders, Tabulate,
+leaderboards, metrics-maker, v4 info routes."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api.server import H2OServer, ROUTES
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def frame():
+    rng = np.random.default_rng(5)
+    n = 200
+    f = Frame.from_dict({
+        "x0": rng.normal(0, 1, n), "x1": rng.normal(0, 1, n),
+        "g": np.array(["a", "b", "c"], object)[rng.integers(0, 3, n)],
+        "y": rng.normal(0, 1, n)}, key="extf")
+    DKV.put("extf", f)
+    yield f
+    if DKV.get("extf") is not None:
+        DKV.remove("extf")
+
+
+def _get(s, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{s.port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(s, path, **data):
+    body = urllib.parse.urlencode(data).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{s.port}{path}",
+                                 data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _delete(s, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{s.port}{path}",
+                                 method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_route_count_at_least_120(server):
+    assert len(ROUTES) >= 120, len(ROUTES)
+    eps = _get(server, "/3/Metadata/endpoints")
+    assert eps["num_routes"] >= 120
+
+
+def test_frame_light_and_domain_and_chunks(server, frame):
+    lt = _get(server, "/3/Frames/extf/light")["frames"][0]
+    assert lt["rows"] == 200 and lt["columns"] == 4
+    dom = _get(server, "/3/Frames/extf/columns/g/domain")
+    assert dom["domain"][0] == ["a", "b", "c"]
+    ch = _get(server, "/3/FrameChunks/extf")
+    assert sum(c["row_count"] for c in ch["chunks"]) >= 200
+
+
+def test_find_route(server, frame):
+    r = _get(server, "/3/Find?key=extf&column=g&match=b&row=0")
+    assert r["next"] >= 0
+    g = frame.vec("g")
+    assert g.levels()[int(g.to_numpy()[r["next"]])] == "b"
+
+
+def test_rebalance(server, frame):
+    r = _post(server, "/3/Rebalance", dataset="extf", dest="extf_rb")
+    assert r["dest"]["name"] == "extf_rb"
+    rb = DKV.get("extf_rb")
+    np.testing.assert_allclose(rb.vec("x0").to_numpy(),
+                               frame.vec("x0").to_numpy())
+    DKV.remove("extf_rb")
+
+
+def test_make_glm_model_and_reg_path(server, frame):
+    _post(server, "/3/ModelBuilders/glm", training_frame="extf",
+          response_column="y", x=json.dumps(["x0", "x1"]),
+          model_id="glm_rp", family="gaussian", lambda_search="true")
+    import time
+    for _ in range(150):
+        try:
+            if _get(server, "/3/Models/glm_rp").get("models"):
+                break
+        except urllib.error.HTTPError:
+            pass                       # still building
+        time.sleep(0.2)
+    rp = _get(server, "/3/GetGLMRegPath?model=glm_rp")
+    assert len(rp["lambdas"]) == len(rp["coefficients"]) > 1
+    mk = _post(server, "/3/MakeGLMModel", model="glm_rp",
+               names=json.dumps(["x0"]), beta=json.dumps([0.5]),
+               dest="glm_custom")
+    assert mk["model_id"]["name"] == "glm_custom"
+    assert DKV.get("glm_custom")._coefficients["x0"] == 0.5
+    _delete(server, "/3/Models/glm_rp")
+    _delete(server, "/3/Models/glm_custom")
+
+
+def test_data_info_frame(server, frame):
+    r = _post(server, "/99/DataInfoFrame", frame="extf",
+              response_column="y", dest="dif")
+    # one-hot g (3) + x0 + x1 = 5 expanded features
+    assert r["num_features"] == 5
+    dif = DKV.get("dif")
+    assert dif.ncols == 5
+    DKV.remove("dif")
+
+
+def test_nps_roundtrip(server):
+    assert _get(server, "/3/NodePersistentStorage/configured")["configured"]
+    _post(server, "/3/NodePersistentStorage/notebooks/flow1",
+          value="{\"cells\": []}")
+    got = _get(server, "/3/NodePersistentStorage/notebooks/flow1")
+    assert got["value"] == "{\"cells\": []}"
+    lst = _get(server, "/3/NodePersistentStorage/notebooks")
+    assert any(e["name"] == "flow1" for e in lst["entries"])
+    _delete(server, "/3/NodePersistentStorage/notebooks/flow1")
+    with pytest.raises(urllib.error.HTTPError):
+        _get(server, "/3/NodePersistentStorage/notebooks/flow1")
+
+
+def test_segment_models_rest(server, frame):
+    r = _post(server, "/99/SegmentModelsBuilders/glm",
+              training_frame="extf", response_column="y",
+              segment_columns=json.dumps(["g"]), family="gaussian",
+              dest="segm")
+    assert r["n_segments"] == 3
+    got = _get(server, "/99/SegmentModels/segm")
+    assert len(got["segments"]) == 3
+    DKV.remove("segm")
+
+
+def test_tabulate(server, frame):
+    r = _post(server, "/99/Tabulate", dataset="extf", predictor="g",
+              response="y")
+    assert r["count_table"]["labels"] == ["a", "b", "c"]
+    assert sum(r["count_table"]["counts"]) == 200
+
+
+def test_metrics_maker(server):
+    rng = np.random.default_rng(9)
+    n = 300
+    y = rng.normal(0, 1, n)
+    pred = y + rng.normal(0, 0.1, n)
+    DKV.put("mm_act", Frame.from_dict({"y": y}, key="mm_act"))
+    DKV.put("mm_pred", Frame.from_dict({"predict": pred}, key="mm_pred"))
+    r = _post(server,
+              "/3/ModelMetrics/predictions_frame/mm_pred"
+              "/actuals_frame/mm_act")
+    mm = r["model_metrics"][0]
+    assert mm["RMSE"] < 0.2
+    DKV.remove("mm_act")
+    DKV.remove("mm_pred")
+
+
+def test_misc_info_routes(server):
+    assert _get(server, "/3/Metadata/schemas")["schemas"]
+    assert _get(server, "/3/Metadata/schemas/FrameV3")
+    ep0 = _get(server, "/3/Metadata/endpoints/0")
+    assert ep0["url_pattern"]
+    hp = _get(server, "/99/Rapids/help")
+    assert hp["n_prims"] >= 200
+    mi = _get(server, "/4/modelsinfo")
+    assert any(m["algo"] == "gbm" for m in mi["models"])
+    st = _get(server, "/3/steam/instances")["instances"]
+    assert st and st[0]["status"] == "running"
+    assert _get(server, "/3/KillMinus3")["dumped"]
+    assert _get(server, "/4/sessions/s1")["session_key"] == "s1"
+
+
+def test_loud_reject_routes(server):
+    for path in ("/3/DecryptionSetup", "/3/ImportHiveTable",
+                 "/3/SaveToHiveTable"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server, path, x="1")
+        assert ei.value.code == 501
+
+
+def test_leaderboards_listing(server):
+    r = _get(server, "/99/Leaderboards")
+    assert "leaderboards" in r
+
+
+def test_delete_all_models_and_frames(server):
+    DKV.put("delf", Frame.from_dict({"a": [1.0, 2.0]}, key="delf"))
+    r = _delete(server, "/3/Frames")
+    assert r["deleted"] >= 1
+    assert DKV.get("delf") is None
