@@ -1,14 +1,21 @@
 """Cox Proportional Hazards — hex/coxph/CoxPH.java + EfronMethod.java.
 
-Reference: Newton-Raphson on the Cox partial likelihood with Efron tie
-handling and optional strata; the per-iteration statistics (risk-set sums of
-exp(Xβ), weighted covariate sums at each event time) are MRTask reductions.
+Reference: Newton-Raphson on the Cox partial likelihood with Efron or
+Breslow tie handling and optional strata (CoxPH.java:128-136
+`stratify_by`: risk sets form within each stratum; the baseline hazard is
+stratum-specific while beta is shared). The per-iteration statistics
+(risk-set sums of exp(Xbeta), covariate sums at event times) are MRTask
+reductions in the reference.
 
-TPU-native design: order rows by stop-time once on the controller; each
-Newton iteration is a fused jit computing the Efron log-likelihood, gradient
-and (diagonal-free full) Hessian via segment-sums over event-time groups and
-suffix-scans for risk sets — one device program per iteration, solve on the
-small (p×p) system.
+TPU-native design: order rows by (stratum, -stop_time) once on the
+controller and precompute the (stratum, time)-group index arrays as
+constants; each Newton iteration is ONE fused jit computing the partial
+log-likelihood via cumsum + segment reductions (risk sets never
+materialize), with gradient/Hessian by autodiff on the same program;
+the p x p solve happens on the controller. Ties: Efron (default) via
+per-event-row rank within its tie group, Breslow via the plain group
+risk sum. Model metrics report the concordance index (CoxPH.java
+concordance on the training frame).
 """
 
 from __future__ import annotations
@@ -27,7 +34,8 @@ class H2OCoxProportionalHazardsEstimator(ModelBase):
     algo = "coxph"
     _defaults = {
         "stop_column": None, "start_column": None, "ties": "efron",
-        "max_iterations": 20, "lre_min": 9.0, "use_all_factor_levels": False,
+        "stratify_by": None, "max_iterations": 20, "lre_min": 9.0,
+        "use_all_factor_levels": False,
     }
 
     def train(self, x=None, y=None, training_frame=None, **kw):
@@ -37,13 +45,24 @@ class H2OCoxProportionalHazardsEstimator(ModelBase):
 
     def _resolve_predictors(self, frame, x, y):
         x = ModelBase._resolve_predictors(self, frame, x, y)
-        drop = {self.params.get("stop_column"), self.params.get("start_column")}
+        drop = {self.params.get("stop_column"),
+                self.params.get("start_column")}
+        drop.update(self._strata_cols())
         return [c for c in x if c not in drop]
+
+    def _strata_cols(self):
+        s = self.params.get("stratify_by")
+        if not s:
+            return []
+        return [s] if isinstance(s, str) else list(s)
 
     def _fit(self, frame: Frame, job):
         di = self._dinfo
         stop_col = self.params["stop_column"]
         assert stop_col, "coxph requires stop_column (event time)"
+        ties = str(self.params.get("ties") or "efron").lower()
+        if ties not in ("efron", "breslow"):
+            raise ValueError(f"ties must be efron|breslow, got {ties!r}")
         X = np.asarray(di.matrix(frame))[: frame.nrows]
         X = np.nan_to_num(X)
         t = frame.vec(stop_col).to_numpy()
@@ -51,35 +70,76 @@ class H2OCoxProportionalHazardsEstimator(ModelBase):
         w = np.ones(frame.nrows)
         if self.params.get("weights_column"):
             w = frame.vec(self.params["weights_column"]).to_numpy()
+
+        # strata: integer id per row from the cross of stratify_by columns
+        # (CoxPH.java: strata columns must be categorical)
+        strat = np.zeros(frame.nrows, np.int64)
+        for c in self._strata_cols():
+            v = frame.vec(c)
+            if v.type != "enum":
+                raise ValueError(
+                    f"stratify_by column {c!r} must be categorical "
+                    "(CoxPH strata are enum crosses)")
+            codes = np.nan_to_num(v.to_numpy(), nan=-1).astype(np.int64)
+            strat = strat * (v.cardinality + 1) + (codes + 1)
+
         ok = ~(np.isnan(t) | np.isnan(ev))
-        X, t, ev, w = X[ok], t[ok], ev[ok], w[ok]
-        order = np.argsort(-t)          # descending time → suffix sums = cumsum
-        X, t, ev, w = X[order], t[order], ev[order], w[order]
+        X, t, ev, w, strat = X[ok], t[ok], ev[ok], w[ok], strat[ok]
+        # renumber strata densely, order rows (stratum asc, time desc):
+        # within a stratum the prefix cumsum of r is the risk-set sum
+        _, strat = np.unique(strat, return_inverse=True)
+        order = np.lexsort((-t, strat))
+        X, t, ev, w, strat = (X[order], t[order], ev[order], w[order],
+                              strat[order])
         n, p = X.shape
-        # group rows by event time for Efron ties
+
+        # (stratum, time) tie groups + per-group constants, all host-side
+        new_grp = np.ones(n, bool)
+        new_grp[1:] = (strat[1:] != strat[:-1]) | (t[1:] != t[:-1])
+        grp = np.cumsum(new_grp) - 1                     # (n,) group id
+        n_grp = int(grp[-1]) + 1 if n else 0
+        new_strat = np.ones(n, bool)
+        new_strat[1:] = strat[1:] != strat[:-1]
+        strat_id = np.cumsum(new_strat) - 1              # stratum id per row
+        first_idx = np.where(new_strat)[0]               # row idx per stratum
+        # Efron rank among EVENT rows of the tie group and group event count
+        is_ev = ev > 0
+        gs_idx = np.where(new_grp)[0]                    # start row per group
+        evcum = np.cumsum(is_ev)
+        before_grp = np.where(gs_idx > 0, evcum[np.maximum(gs_idx - 1, 0)], 0)
+        rank = np.where(is_ev, evcum - 1 - before_grp[grp], 0.0)
+        dcount = np.bincount(grp[is_ev], minlength=n_grp).astype(np.float64)
+
         Xj = jnp.asarray(X, jnp.float32)
-        tj = jnp.asarray(t, jnp.float32)
         evj = jnp.asarray(ev * w, jnp.float32)
         wj = jnp.asarray(w, jnp.float32)
+        grp_j = jnp.asarray(grp, jnp.int32)
+        strat_j = jnp.asarray(strat_id, jnp.int32)
+        base_j = jnp.asarray(first_idx - 1, jnp.int32)   # (-1 for stratum 0)
+        rank_j = jnp.asarray(rank, jnp.float32)
+        d_j = jnp.asarray(np.maximum(dcount, 1.0), jnp.float32)
+        isev_j = jnp.asarray(is_ev, jnp.float32) * wj
 
         def nll_fn(beta):
             eta = Xj @ beta
             r = wj * jnp.exp(eta)
-            # risk set sum at row i = Σ_{t_j >= t_i} r_j = prefix cumsum
             csum = jnp.cumsum(r)
-            # Breslow approximation to ties (Efron refinement: next round)
-            # rows sharing a time must share the full risk set: use the last
-            # index of their time group
-            same_next = jnp.concatenate([tj[1:] == tj[:-1],
-                                         jnp.array([False])])
-            # propagate group-end csum backward via segment trick
-            grp = jnp.cumsum(jnp.concatenate(
-                [jnp.array([0], jnp.int32),
-                 (tj[1:] != tj[:-1]).astype(jnp.int32)]))
-            grp_max = jax.ops.segment_max(csum, grp,
-                                          num_segments=n)
-            risk = grp_max[grp]
-            ll = (evj * (eta - jnp.log(jnp.maximum(risk, 1e-30)))).sum()
+            # per-group end cumsum, minus the cumsum before this stratum —
+            # risk sets never cross strata (CoxPH.java:128-136)
+            grp_max = jax.ops.segment_max(csum, grp_j, num_segments=n_grp)
+            strat_base = jnp.where(base_j >= 0,
+                                   csum[jnp.maximum(base_j, 0)], 0.0)
+            risk = grp_max[grp_j] - strat_base[strat_j]
+            if ties == "efron":
+                # tie-group event risk sum T_g; k-th event in the group sees
+                # denominator R_g - (k/d_g) * T_g (EfronMethod.java)
+                tie_r = jax.ops.segment_sum(
+                    r * (isev_j > 0), grp_j, num_segments=n_grp)[grp_j]
+                denom = risk - (rank_j / d_j[grp_j]) * tie_r
+            else:
+                denom = risk
+            ll = (evj * eta).sum() - (
+                isev_j * jnp.log(jnp.maximum(denom, 1e-30))).sum()
             return -ll
 
         beta = jnp.zeros(p, jnp.float32)
@@ -115,13 +175,17 @@ class H2OCoxProportionalHazardsEstimator(ModelBase):
         self._output.scoring_history = history
         names = di.feature_names
         self._coefficients = dict(zip(names, self._beta.tolist()))
+        conc = _concordance(t, ev, strat,
+                            np.asarray(X @ np.asarray(beta, np.float64)))
         self._output.model_summary = {
             "loglik": -prev, "iterations": len(history),
             "coefficients": self._coefficients,
             "exp_coef": {k: math.exp(v) for k, v in
                          self._coefficients.items()},
             "se_coef": dict(zip(names, self._se.tolist())),
-            "ties": "breslow",
+            "ties": ties, "concordance": conc,
+            "strata": self._strata_cols() or None,
+            "n_strata": int(strat.max()) + 1 if n else 0,
         }
 
     def coef(self):
@@ -132,7 +196,31 @@ class H2OCoxProportionalHazardsEstimator(ModelBase):
         return jnp.where(jnp.isnan(X), 0.0, X) @ b   # linear predictor (lp)
 
     def _compute_metrics(self, frame):
-        return None  # concordance index: future round
+        return None
 
     def _score_train_valid(self, frame, valid):
         pass
+
+
+def _concordance(t, ev, strat, lp, cap: int = 8000) -> float:
+    """Concordance index over comparable pairs within strata (the
+    reference's MetricsCoxPH concordance). O(n^2) with broadcasting,
+    subsampled beyond `cap` rows for boundedness."""
+    n = len(t)
+    if n == 0:
+        return float("nan")
+    if n > cap:
+        rng = np.random.default_rng(0)
+        idx = rng.choice(n, cap, replace=False)
+        t, ev, strat, lp = t[idx], ev[idx], strat[idx], lp[idx]
+    # pair (i, j) comparable when t_i < t_j, ev_i = 1, same stratum
+    ti, tj = t[:, None], t[None, :]
+    comp = (ti < tj) & (ev[:, None] > 0) & \
+        (strat[:, None] == strat[None, :])
+    li, ljj = lp[:, None], lp[None, :]
+    conc = comp & (li > ljj)
+    tied = comp & (li == ljj)
+    n_comp = comp.sum()
+    if n_comp == 0:
+        return float("nan")
+    return float((conc.sum() + 0.5 * tied.sum()) / n_comp)

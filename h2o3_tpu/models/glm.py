@@ -131,6 +131,10 @@ def _cod_solve(G, q, lam, alpha, p_pen, beta0, tol=1e-8, max_sweeps=1000,
     """
     p = len(q)
     beta = beta0.copy()
+    if lo is not None:
+        # a warm start outside the box must not survive (coordinates whose
+        # denom<=0 are never updated below and would keep the stale value)
+        beta = np.minimum(np.maximum(beta, lo), hi)
     l1 = lam * alpha
     l2 = lam * (1 - alpha)
     for _ in range(max_sweeps):
@@ -411,8 +415,6 @@ class H2OGeneralizedLinearEstimator(ModelBase):
             return None, None
         lo = np.full(p1, -np.inf)
         hi = np.full(p1, np.inf)
-        if nn:
-            lo[:p_pen] = 0.0
         names = self._dinfo.feature_names
         if isinstance(bc, Frame):
             rows = {bc.vec("names").to_numpy()[i]: i
@@ -440,6 +442,10 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                     j = names.index(nm)
                     lo[j] = row.get("lower_bounds", -np.inf)
                     hi[j] = row.get("upper_bounds", np.inf)
+        if nn:
+            # intersect with the non_negative floor (GLM.java combines the
+            # two constraint sources; a user lower bound must not loosen it)
+            lo[:p_pen] = np.maximum(lo[:p_pen], 0.0)
         return lo, hi
 
     def _sparse_path_ok(self) -> bool:

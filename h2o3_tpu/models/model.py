@@ -70,11 +70,16 @@ class DataInfo:
         # normalization stats from the TRAINING frame
         self.means = {c: frame.vec(c).mean() for c in self.num_cols}
         self.sigmas = {c: frame.vec(c).sigma() or 1.0 for c in self.num_cols}
-        # interactions (hex/DataInfo.java interactions / makeInteraction):
-        # pairwise PRODUCT columns over the listed numeric predictors,
-        # standardized with their own training-frame stats. Categorical
-        # interaction expansion is not implemented — rejected loudly.
-        self.inter_pairs: list = []
+        # interactions (hex/DataInfo.java interactions / makeInteraction /
+        # InteractionWrappedVec): pairwise interaction columns over the
+        # listed predictors. num x num -> product column (standardized with
+        # its own training stats); cat x cat -> interaction categorical
+        # whose indicator block spans the level CROSS; cat x num -> one
+        # numeric column per level of the categorical (the wrapped-vec
+        # expansion: num value in the active level's slot, 0 elsewhere).
+        self.inter_pairs: list = []      # (num_a, num_b, name)
+        self.inter_catcat: list = []     # (cat_a, cat_b, name)
+        self.inter_catnum: list = []     # (cat_a, num_b, name)
         if interactions:
             if cat_mode != "onehot":
                 raise ValueError(
@@ -83,28 +88,34 @@ class DataInfo:
             # dedupe, order-preserving: a repeated entry would emit a
             # degenerate self-pair product
             interactions = list(dict.fromkeys(interactions))
-            bad = [c for c in interactions if c in self.cat_cols]
-            if bad:
-                raise NotImplementedError(
-                    f"categorical interactions not supported: {bad} "
-                    "(numeric-numeric pairs only)")
-            unknown = [c for c in interactions if c not in self.num_cols]
+            unknown = [c for c in interactions if c not in self.predictors]
             if unknown:
                 raise ValueError(
-                    f"interactions reference unknown numeric predictors: "
+                    f"interactions reference unknown predictors: "
                     f"{unknown} (GLM interaction-column validation)")
-            cols = list(interactions)
             import itertools as _it
-            for a, b in _it.combinations(cols, 2):
-                name = f"{a}:{b}"
-                self.inter_pairs.append((a, b, name))
-                prod = (frame.vec(a).as_f32()[: frame.nrows]
-                        * frame.vec(b).as_f32()[: frame.nrows])
-                pn = np.asarray(prod, np.float64)
-                ok = pn[~np.isnan(pn)]
-                self.means[name] = float(ok.mean()) if len(ok) else 0.0
-                self.sigmas[name] = float(ok.std(ddof=1)) or 1.0 \
-                    if len(ok) > 1 else 1.0
+            for a, b in _it.combinations(interactions, 2):
+                a_cat, b_cat = a in self.cat_cols, b in self.cat_cols
+                if a_cat and b_cat:
+                    cross = (self.cardinalities[a] * self.cardinalities[b])
+                    if cross > 10_000:
+                        raise ValueError(
+                            f"categorical interaction {a}x{b} expands to "
+                            f"{cross} indicator columns (cap 10000)")
+                    self.inter_catcat.append((a, b, f"{a}_{b}"))
+                elif a_cat or b_cat:
+                    ca, nb = (a, b) if a_cat else (b, a)
+                    self.inter_catnum.append((ca, nb, f"{ca}:{nb}"))
+                else:
+                    name = f"{a}:{b}"
+                    self.inter_pairs.append((a, b, name))
+                    prod = (frame.vec(a).as_f32()[: frame.nrows]
+                            * frame.vec(b).as_f32()[: frame.nrows])
+                    pn = np.asarray(prod, np.float64)
+                    ok = pn[~np.isnan(pn)]
+                    self.means[name] = float(ok.mean()) if len(ok) else 0.0
+                    self.sigmas[name] = float(ok.std(ddof=1)) or 1.0 \
+                        if len(ok) > 1 else 1.0
         # expanded feature names (coefficient_names order: cats first like H2O)
         self.feature_names: list[str] = []
         if cat_mode == "onehot":
@@ -112,6 +123,13 @@ class DataInfo:
                 self.feature_names += [f"{c}.{l}" for l in self.domains[c]]
             self.feature_names += self.num_cols
             self.feature_names += [n for _, _, n in self.inter_pairs]
+            for a, b, name in self.inter_catcat:
+                self.feature_names += [
+                    f"{name}.{la}_{lb}" for la in self.domains[a]
+                    for lb in self.domains[b]]
+            for a, b, name in self.inter_catnum:
+                self.feature_names += [f"{a}.{la}:{b}"
+                                       for la in self.domains[a]]
         else:
             self.feature_names = list(self.predictors)
 
@@ -159,6 +177,30 @@ class DataInfo:
                     p = jnp.where(jnp.isnan(p),
                                   0.0 if standardize else im, p)
                 parts.append(p[:, None])
+            for (ia, ib, ka, kb) in catcat_idx:
+                # interaction categorical: indicator over the level cross;
+                # NA in either factor -> all-zero row (InteractionWrappedVec)
+                ca = raw_cat[:, ia]
+                cb = raw_cat[:, ib]
+                bad = jnp.isnan(ca) | jnp.isnan(cb)
+                code = jnp.where(
+                    bad, -1,
+                    jnp.nan_to_num(ca) * kb + jnp.nan_to_num(cb)
+                ).astype(jnp.int32)
+                parts.append(jax.nn.one_hot(code, ka * kb,
+                                            dtype=jnp.float32))
+            for (ia, ib, ka, im, isg) in catnum_idx:
+                # cat x num wrapped vec: num value in the active level slot
+                ca = raw_cat[:, ia]
+                code = jnp.where(jnp.isnan(ca), -1, ca).astype(jnp.int32)
+                x = raw_num[:, ib]
+                if standardize:
+                    x = (x - im) / isg
+                if self.impute_missing:
+                    x = jnp.where(jnp.isnan(x), 0.0 if standardize else im,
+                                  x)
+                parts.append(jax.nn.one_hot(code, ka, dtype=jnp.float32)
+                             * x[:, None])
             return jnp.concatenate(parts, axis=1)
 
         inter_idx = tuple(
@@ -166,6 +208,15 @@ class DataInfo:
              np.float32(self.means[n]),
              np.float32(max(self.sigmas[n], 1e-10)))
             for a, b, n in self.inter_pairs)
+        catcat_idx = tuple(
+            (self.cat_cols.index(a), self.cat_cols.index(b),
+             self.cardinalities[a], self.cardinalities[b])
+            for a, b, _ in self.inter_catcat)
+        catnum_idx = tuple(
+            (self.cat_cols.index(a), self.num_cols.index(b),
+             self.cardinalities[a], np.float32(self.means[b]),
+             np.float32(max(self.sigmas[b], 1e-10)))
+            for a, b, _ in self.inter_catnum)
         out_sh = _mesh.cloud().rows_sharding(2)
         return jax.jit(build, out_shardings=out_sh)(raw_cat, raw_num, means, sigmas)
 
@@ -406,11 +457,12 @@ class ModelBase:
         return len(d) if d else 1
 
     def predict(self, test_data: Frame) -> Frame:
+        from h2o3_tpu.parallel import mrtask as _mrt
         X = self._dinfo.matrix(test_data)
         out = self._score_matrix(X)
         n = test_data.nrows
         if self._is_classifier:
-            probs = np.asarray(out)[:n]
+            probs = _mrt.host_fetch(out)[:n]
             pred = probs.argmax(axis=1).astype(np.float64)
             dom = self._dinfo.response_domain
             cols = {"predict": Vec._from_floats(pred, np.zeros(n, bool),
@@ -418,7 +470,7 @@ class ModelBase:
             for k, lvl in enumerate(dom):
                 cols[f"p{lvl}"] = Vec.from_numpy(probs[:, k].astype(np.float64))
             return Frame(list(cols), list(cols.values()))
-        pred = np.asarray(out)[:n].astype(np.float64)
+        pred = _mrt.host_fetch(out)[:n].astype(np.float64)
         return Frame(["predict"], [Vec.from_numpy(pred)])
 
     def model_performance(self, test_data: Optional[Frame] = None):

@@ -133,3 +133,91 @@ def test_recovery(tmp_path):
     m = out["models"][0]
     p = m.predict(out["frames"][0])
     assert p.nrows == 100
+
+
+def test_coxph_efron_vs_breslow_ties():
+    """With ties present Efron and Breslow give different (both finite)
+    estimates; with no ties they agree exactly (EfronMethod.java)."""
+    rng = np.random.default_rng(71)
+    n = 200
+    x = rng.normal(0, 1, n)
+    tm = np.round(rng.exponential(np.exp(-0.8 * x)), 1) + 0.1  # heavy ties
+    evt = (rng.random(n) < 0.8).astype(float)
+    f = Frame.from_dict({"x": x, "time": tm, "event": evt})
+    ms = {}
+    for ties in ("efron", "breslow"):
+        m = H2OCoxProportionalHazardsEstimator(
+            stop_column="time", ties=ties)
+        m.train(x=["x"], y="event", training_frame=f)
+        ms[ties] = m.coef()["x"]
+        assert np.isfinite(ms[ties])
+        assert m._output.model_summary["ties"] == ties
+    assert abs(ms["efron"] - ms["breslow"]) > 1e-6  # ties matter
+    # scale exp(-0.8x) => hazard exp(+0.8x): both positive
+    assert ms["efron"] > 0 and ms["breslow"] > 0
+
+    tm2 = rng.exponential(np.exp(-0.8 * x)) + 0.001  # continuous: no ties
+    f2 = Frame.from_dict({"x": x, "time": tm2, "event": evt})
+    cs = {}
+    for ties in ("efron", "breslow"):
+        m = H2OCoxProportionalHazardsEstimator(
+            stop_column="time", ties=ties)
+        m.train(x=["x"], y="event", training_frame=f2)
+        cs[ties] = m.coef()["x"]
+    assert abs(cs["efron"] - cs["breslow"]) < 1e-5
+
+
+def test_coxph_strata_duplicate_invariance():
+    """Two strata that are exact copies of one dataset must give the SAME
+    beta as the single-stratum fit (the stratified partial likelihood
+    factorizes; CoxPH.java:128-136 stratify_by)."""
+    rng = np.random.default_rng(72)
+    n = 150
+    x = rng.normal(0, 1, n)
+    tm = rng.exponential(np.exp(-0.6 * x)) + 0.01
+    evt = (rng.random(n) < 0.85).astype(float)
+    f1 = Frame.from_dict({"x": x, "time": tm, "event": evt})
+    m1 = H2OCoxProportionalHazardsEstimator(stop_column="time")
+    m1.train(x=["x"], y="event", training_frame=f1)
+
+    g = np.array(["a"] * n + ["b"] * n, object)
+    f2 = Frame.from_dict({"x": np.concatenate([x, x]),
+                          "time": np.concatenate([tm, tm]),
+                          "event": np.concatenate([evt, evt]),
+                          "g": g})
+    m2 = H2OCoxProportionalHazardsEstimator(
+        stop_column="time", stratify_by=["g"])
+    m2.train(x=["x"], y="event", training_frame=f2)
+    assert m2._output.model_summary["n_strata"] == 2
+    # f32 cumsum + Newton stopping tolerance: agreement to ~0.5%
+    assert abs(m1.coef()["x"] - m2.coef()["x"]) < 5e-3
+
+
+def test_coxph_strata_recovers_shifted_baseline():
+    """Per-stratum baseline hazards: pooling two groups with very
+    different baselines biases the unstratified fit; stratification
+    recovers the shared beta."""
+    rng = np.random.default_rng(73)
+    n = 400
+    x = rng.normal(0, 1, n)
+    grp = rng.integers(0, 2, n)
+    scale = np.where(grp == 0, 1.0, 25.0)     # stratum 1 lives much longer
+    tm = scale * rng.exponential(np.exp(-0.7 * x)) + 0.01
+    evt = np.ones(n)
+    f = Frame.from_dict({"x": x, "time": tm, "event": evt,
+                         "g": np.array(["s0", "s1"], object)[grp]})
+    m = H2OCoxProportionalHazardsEstimator(
+        stop_column="time", stratify_by="g")
+    m.train(x=["x"], y="event", training_frame=f)
+    assert 0.4 < m.coef()["x"] < 1.0          # near the true +0.7
+    assert m._output.model_summary["concordance"] > 0.6
+
+
+def test_coxph_strata_requires_categorical():
+    f = Frame.from_dict({"x": [1.0, 2.0, 3.0], "time": [1.0, 2.0, 3.0],
+                         "event": [1.0, 1.0, 0.0], "z": [0.1, 0.2, 0.3]})
+    import pytest as _pytest
+    m = H2OCoxProportionalHazardsEstimator(
+        stop_column="time", stratify_by="z")
+    with _pytest.raises(Exception, match="categorical"):
+        m.train(x=["x"], y="event", training_frame=f)

@@ -172,12 +172,44 @@ def test_glm_interactions():
     c = inter.coef()
     assert "x0:x1" in c and abs(c["x0:x1"] - 2.0) < 0.1
     assert abs(c.get("x0:x2", 0.0)) < 0.1
-    # categorical interactions reject loudly
-    f2 = Frame.from_dict({"g": np.array(["a", "b"], object)[
-        rng.integers(0, 2, n)], "x0": X[:, 0], "y": yv})
-    with pytest.raises(NotImplementedError):
-        GLM(family="gaussian", interactions=["g", "x0"]).train(
-            y="y", training_frame=f2)
+
+def test_glm_categorical_interactions():
+    """cat x num and cat x cat interactions (hex/DataInfo.java
+    makeInteraction / InteractionWrappedVec): a per-group slope is
+    unlearnable without the cat x num expansion."""
+    rng = np.random.default_rng(9)
+    n = 900
+    g = rng.integers(0, 2, n)
+    x = rng.normal(0, 1, n)
+    # slope +2 in group a, -2 in group b: zero pooled slope
+    yv = np.where(g == 0, 2.0, -2.0) * x + rng.normal(0, 0.1, n)
+    f = Frame.from_dict({"g": np.array(["a", "b"], object)[g],
+                         "x": x, "y": yv})
+    plain = GLM(family="gaussian", lambda_=0.0)
+    plain.train(y="y", training_frame=f)
+    inter = GLM(family="gaussian", lambda_=0.0, interactions=["g", "x"])
+    inter.train(y="y", training_frame=f)
+    assert plain._output.training_metrics.r2 < 0.3
+    assert inter._output.training_metrics.r2 > 0.95
+    c = inter.coef()
+    # x main effect + per-level slope are collinear (x = g.a:x + g.b:x);
+    # the identified quantities are the per-group TOTAL slopes
+    assert abs(c["x"] + c["g.a:x"] - 2.0) < 0.15
+    assert abs(c["x"] + c["g.b:x"] + 2.0) < 0.15
+
+    # cat x cat: XOR-style cell means need the cross indicators
+    h = rng.integers(0, 2, n)
+    yv2 = np.where(g == h, 1.0, -1.0) + rng.normal(0, 0.1, n)
+    f2 = Frame.from_dict({"g": np.array(["a", "b"], object)[g],
+                          "h": np.array(["u", "v"], object)[h],
+                          "y": yv2})
+    plain2 = GLM(family="gaussian", lambda_=0.0)
+    plain2.train(y="y", training_frame=f2)
+    inter2 = GLM(family="gaussian", lambda_=0.0, interactions=["g", "h"])
+    inter2.train(y="y", training_frame=f2)
+    assert plain2._output.training_metrics.r2 < 0.3
+    assert inter2._output.training_metrics.r2 > 0.9
+    assert any(k.startswith("g_h.") for k in inter2.coef())
 
 
 def test_glm_interactions_unknown_column_rejected():
@@ -187,3 +219,22 @@ def test_glm_interactions_unknown_column_rejected():
     with pytest.raises(ValueError):
         GLM(family="gaussian", interactions=["x0", "nope"]).train(
             y="y", training_frame=f)
+
+
+def test_non_negative_intersects_beta_constraints():
+    """GLM.java combines constraint sources: a user lower bound of -1 must
+    not loosen the non_negative floor (previously it silently did)."""
+    rng = np.random.default_rng(44)
+    n = 300
+    x0 = rng.normal(0, 1, n)
+    x1 = rng.normal(0, 1, n)
+    y = -2.0 * x0 + 1.0 * x1 + rng.normal(0, 0.1, n)
+    f = Frame.from_dict({"x0": x0, "x1": x1, "y": y})
+    m = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", non_negative=True, lambda_=0.0,
+        beta_constraints={"x0": (-1.0, 5.0)}, solver="COORDINATE_DESCENT")
+    m.train(y="y", training_frame=f)
+    coefs = m.coef()
+    # the true x0 coefficient is -2; the intersected box clamps it at 0
+    assert coefs["x0"] >= -1e-9
+    assert coefs["x1"] > 0.5
