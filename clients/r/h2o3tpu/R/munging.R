@@ -1,0 +1,199 @@
+# Frame munging operators — h2o-r/h2o-package/R/frame.R operator surface.
+# Every operator builds a Rapids AST string and evaluates it server-side
+# (/99/Rapids), assigning the result to a fresh temp key — the same lazy
+# key-handle model the reference client uses (ExprNode + eval).
+
+.h2o.tmp_key <- local({
+  n <- 0L
+  function() {
+    n <<- n + 1L
+    sprintf("rtmp_%d_%d", Sys.getpid(), n)
+  }
+})
+
+#' Evaluate a Rapids expression into a new frame handle.
+.h2o.eval_frame <- function(ast) {
+  key <- .h2o.tmp_key()
+  .h2o.POST("/99/Rapids", list(ast = sprintf("(tmp= %s %s)", key, ast)))
+  .h2o.frame(key)
+}
+
+#' Evaluate a Rapids expression returning a scalar.
+.h2o.eval_scalar <- function(ast) {
+  r <- .h2o.POST("/99/Rapids", list(ast = ast))
+  if (!is.null(r$scalar)) as.numeric(r$scalar) else r$string
+}
+
+.h2o.ref <- function(x) {
+  if (inherits(x, "H2OFrame")) x$key
+  else if (is.character(x)) sprintf('"%s"', x)
+  else if (is.logical(x)) if (isTRUE(x)) "TRUE" else "FALSE"
+  else as.character(x)
+}
+
+# ---- arithmetic / comparison (Ops group generic) ---------------------------
+Ops.H2OFrame <- function(e1, e2) {
+  op <- switch(.Generic, "%%" = "mod", "%/%" = "intDiv", .Generic)
+  .h2o.eval_frame(sprintf("(%s %s %s)", op, .h2o.ref(e1), .h2o.ref(e2)))
+}
+
+# ---- math (Math group generic) ---------------------------------------------
+Math.H2OFrame <- function(x, ...) {
+  op <- switch(.Generic, "log1p" = "log1p", "expm1" = "expm1",
+               "ceiling" = "ceiling", "floor" = "floor", "trunc" = "trunc",
+               .Generic)
+  .h2o.eval_frame(sprintf("(%s %s)", op, x$key))
+}
+
+# ---- column/row selection --------------------------------------------------
+`[.H2OFrame` <- function(x, i, j, ...) {
+  has_i <- !missing(i)
+  has_j <- !missing(j)
+  ast <- x$key
+  if (has_j) {
+    jj <- if (is.character(j)) sprintf('["%s"]', paste(j, collapse = '" "'))
+          else sprintf("[%s]", paste(as.integer(j) - 1L, collapse = " "))
+    ast <- sprintf("(cols %s %s)", ast, jj)
+  }
+  if (has_i) {
+    ii <- if (inherits(i, "H2OFrame")) i$key
+          else sprintf("[%s]", paste(as.integer(i) - 1L, collapse = " "))
+    ast <- sprintf("(rows %s %s)", ast, ii)
+  }
+  .h2o.eval_frame(ast)
+}
+
+`$.H2OFrame` <- function(x, name) {
+  if (name %in% c("key", "algo")) return(unclass(x)[[name]])
+  .h2o.eval_frame(sprintf('(cols %s ["%s"])', unclass(x)$key, name))
+}
+
+`[[.H2OFrame` <- function(x, name) unclass(x)[[name]]
+
+`$<-.H2OFrame` <- function(x, name, value) {
+  key <- unclass(x)$key
+  if (name %in% c("key", "algo")) {
+    y <- unclass(x); y[[name]] <- value
+    return(structure(y, class = "H2OFrame"))
+  }
+  v <- if (inherits(value, "H2OFrame")) value$key else .h2o.ref(value)
+  out <- .h2o.tmp_key()
+  .h2o.POST("/99/Rapids", list(ast = sprintf(
+    '(tmp= %s (append %s %s "%s"))', out, key, v, name)))
+  .h2o.frame(out)
+}
+
+# ---- dimensions / names ----------------------------------------------------
+h2o.nrow <- function(x) as.integer(.h2o.eval_scalar(
+  sprintf("(nrow %s)", x$key)))
+h2o.ncol <- function(x) as.integer(.h2o.eval_scalar(
+  sprintf("(ncol %s)", x$key)))
+h2o.colnames <- function(x) {
+  f <- .h2o.GET(paste0("/3/Frames/", x$key))$frames
+  unlist(f$columns[[1]]$label %||% f$columns[[1]]$name)
+}
+dim.H2OFrame <- function(x) c(h2o.nrow(x), h2o.ncol(x))
+
+# ---- aggregations -----------------------------------------------------------
+h2o.mean <- function(x, na.rm = TRUE)
+  .h2o.eval_scalar(sprintf("(mean %s)", x$key))
+h2o.sum <- function(x, na.rm = TRUE)
+  .h2o.eval_scalar(sprintf("(sumNA %s)", x$key))
+h2o.min <- function(x) .h2o.eval_scalar(sprintf("(min %s)", x$key))
+h2o.max <- function(x) .h2o.eval_scalar(sprintf("(max %s)", x$key))
+h2o.sd <- function(x) .h2o.eval_scalar(sprintf("(sd %s)", x$key))
+h2o.median <- function(x) .h2o.eval_scalar(sprintf("(median %s)", x$key))
+h2o.var <- function(x) .h2o.eval_scalar(sprintf("(var %s)", x$key))
+
+h2o.quantile <- function(x, probs = c(0.1, 0.25, 0.5, 0.75, 0.9)) {
+  .h2o.eval_frame(sprintf("(quantile %s [%s] \"interpolate\")", x$key,
+                          paste(probs, collapse = " ")))
+}
+
+# ---- factors / types --------------------------------------------------------
+h2o.asfactor <- function(x)
+  .h2o.eval_frame(sprintf("(as.factor %s)", x$key))
+h2o.asnumeric <- function(x)
+  .h2o.eval_frame(sprintf("(as.numeric %s)", x$key))
+h2o.ascharacter <- function(x)
+  .h2o.eval_frame(sprintf("(as.character %s)", x$key))
+h2o.levels <- function(x) {
+  f <- .h2o.GET(paste0("/3/Frames/", x$key))$frames
+  f$columns[[1]]$domain
+}
+h2o.unique <- function(x)
+  .h2o.eval_frame(sprintf("(unique %s)", x$key))
+h2o.table <- function(x)
+  .h2o.eval_frame(sprintf("(table %s)", x$key))
+h2o.ifelse <- function(test, yes, no)
+  .h2o.eval_frame(sprintf("(ifelse %s %s %s)", test$key,
+                          .h2o.ref(yes), .h2o.ref(no)))
+h2o.cut <- function(x, breaks)
+  .h2o.eval_frame(sprintf("(cut %s [%s])", x$key,
+                          paste(breaks, collapse = " ")))
+h2o.isna <- function(x)
+  .h2o.eval_frame(sprintf("(is.na %s)", x$key))
+
+# ---- combining / reshaping --------------------------------------------------
+h2o.cbind <- function(...) {
+  keys <- vapply(list(...), function(f) f$key, character(1))
+  .h2o.eval_frame(sprintf("(cbind %s)", paste(keys, collapse = " ")))
+}
+h2o.rbind <- function(...) {
+  keys <- vapply(list(...), function(f) f$key, character(1))
+  .h2o.eval_frame(sprintf("(rbind %s)", paste(keys, collapse = " ")))
+}
+h2o.merge <- function(x, y, all.x = FALSE, all.y = FALSE) {
+  .h2o.eval_frame(sprintf("(merge %s %s %s %s [] [] \"auto\")",
+                          x$key, y$key,
+                          if (all.x) "TRUE" else "FALSE",
+                          if (all.y) "TRUE" else "FALSE"))
+}
+h2o.arrange <- function(x, ...) {
+  cols <- c(...)
+  idx <- vapply(cols, function(cn)
+    which(h2o.colnames(x) == cn) - 1L, integer(1))
+  .h2o.eval_frame(sprintf("(sort %s [%s] [%s])", x$key,
+                          paste(idx, collapse = " "),
+                          paste(rep(1L, length(idx)), collapse = " ")))
+}
+h2o.group_by <- function(x, by, agg = "mean", col = NULL) {
+  byi <- which(h2o.colnames(x) == by) - 1L
+  coli <- if (is.null(col)) byi else which(h2o.colnames(x) == col) - 1L
+  .h2o.eval_frame(sprintf('(GB %s [%s] "%s" %s "all")',
+                          x$key, byi, agg, coli))
+}
+h2o.head <- function(x, n = 6L) x[seq_len(n), ]
+h2o.scale <- function(x, center = TRUE, scale = TRUE)
+  .h2o.eval_frame(sprintf("(scale %s %s %s)", x$key,
+                          if (center) "TRUE" else "FALSE",
+                          if (scale) "TRUE" else "FALSE"))
+
+# ---- string munging ---------------------------------------------------------
+h2o.toupper <- function(x)
+  .h2o.eval_frame(sprintf("(toupper %s)", x$key))
+h2o.tolower <- function(x)
+  .h2o.eval_frame(sprintf("(tolower %s)", x$key))
+h2o.trim <- function(x) .h2o.eval_frame(sprintf("(trim %s)", x$key))
+h2o.nchar <- function(x) .h2o.eval_frame(sprintf("(strlen %s)", x$key))
+h2o.gsub <- function(pattern, replacement, x, ignore.case = FALSE)
+  .h2o.eval_frame(sprintf('(replaceall %s "%s" "%s" %s)', x$key, pattern,
+                          replacement,
+                          if (ignore.case) "TRUE" else "FALSE"))
+h2o.sub <- function(pattern, replacement, x, ignore.case = FALSE)
+  .h2o.eval_frame(sprintf('(replacefirst %s "%s" "%s" %s)', x$key, pattern,
+                          replacement,
+                          if (ignore.case) "TRUE" else "FALSE"))
+h2o.strsplit <- function(x, split)
+  .h2o.eval_frame(sprintf('(strsplit %s "%s")', x$key, split))
+h2o.substring <- function(x, first, last = 1000000L)
+  .h2o.eval_frame(sprintf("(substring %s %d %d)", x$key,
+                          as.integer(first) - 1L, as.integer(last)))
+
+# ---- imputation -------------------------------------------------------------
+h2o.impute <- function(data, column, method = "mean") {
+  coli <- which(h2o.colnames(data) == column) - 1L
+  .h2o.POST("/99/Rapids", list(ast = sprintf(
+    '(h2o.impute %s %d "%s")', data$key, coli, method)))
+  invisible(data)
+}

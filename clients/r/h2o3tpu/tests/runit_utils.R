@@ -1,0 +1,23 @@
+# Shared harness for runit-style tests (h2o-r/tests/../h2o-runit.R analog).
+# Each runit_*.R sources this, runs, and stops() on failure.
+suppressMessages({
+  for (f in list.files("../../R", full.names = TRUE)) source(f)
+})
+h2o.init(port = as.integer(Sys.getenv("H2O3_PORT", "54321")))
+
+expect_true <- function(x, msg = "expectation failed") {
+  if (!isTRUE(x)) stop(msg)
+}
+expect_equal <- function(a, b, tol = 1e-6, msg = NULL) {
+  if (is.numeric(a) && is.numeric(b)) {
+    if (any(abs(a - b) > tol))
+      stop(msg %||% sprintf("expected %s, got %s", b, a))
+  } else if (!identical(a, b)) stop(msg %||% "not identical")
+}
+test_frame <- function(n = 100, seed = 42) {
+  set.seed(seed)
+  as.h2o(data.frame(x = rnorm(n), y = rnorm(n),
+                    g = sample(c("a", "b", "c"), n, TRUE),
+                    s = sprintf(" Str%d ", seq_len(n)),
+                    stringsAsFactors = FALSE))
+}

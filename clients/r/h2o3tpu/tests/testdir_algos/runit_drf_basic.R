@@ -1,0 +1,4 @@
+# runit: drf_basic (h2o-r/tests/testdir_algos analog) — through REST.
+source("../runit_utils.R")
+fr <- test_frame(300, 3); m <- h2o.randomForest(y = 'y', training_frame = fr, ntrees = 5); expect_true(h2o.rmse(m) > 0)
+cat("runit_drf_basic: PASS\n")

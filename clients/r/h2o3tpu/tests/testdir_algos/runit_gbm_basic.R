@@ -1,0 +1,4 @@
+# runit: gbm_basic (h2o-r/tests/testdir_algos analog) — through REST.
+source("../runit_utils.R")
+fr <- test_frame(300, 1); m <- h2o.gbm(y = 'y', training_frame = fr, ntrees = 5, max_depth = 3); expect_true(h2o.rmse(m) > 0)
+cat("runit_gbm_basic: PASS\n")

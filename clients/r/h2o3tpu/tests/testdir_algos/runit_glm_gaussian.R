@@ -1,0 +1,4 @@
+# runit: glm_gaussian (h2o-r/tests/testdir_algos analog) — through REST.
+source("../runit_utils.R")
+fr <- test_frame(300, 2); m <- h2o.glm(y = 'y', training_frame = fr, family = 'gaussian'); expect_true(is.finite(h2o.rmse(m)))
+cat("runit_glm_gaussian: PASS\n")

@@ -1,0 +1,4 @@
+# runit: kmeans_basic (h2o-r/tests/testdir_algos analog) — through REST.
+source("../runit_utils.R")
+fr <- test_frame(300, 4); m <- h2o.kmeans(training_frame = fr, x = c('x', 'y'), k = 3); expect_true(!is.null(m$key))
+cat("runit_kmeans_basic: PASS\n")

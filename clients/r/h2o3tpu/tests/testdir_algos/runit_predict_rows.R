@@ -1,0 +1,4 @@
+# runit: predict_rows (h2o-r/tests/testdir_algos analog) — through REST.
+source("../runit_utils.R")
+fr <- test_frame(200, 5); m <- h2o.gbm(y = 'y', training_frame = fr, ntrees = 3); p <- h2o.predict(m, fr); expect_equal(h2o.nrow(p), 200)
+cat("runit_predict_rows: PASS\n")

@@ -1,0 +1,4 @@
+# runit: arith_ops (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- fr$x + fr$y * 2; expect_equal(h2o.nrow(z), 100)
+cat("runit_arith_ops: PASS\n")

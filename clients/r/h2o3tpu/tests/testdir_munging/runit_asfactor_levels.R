@@ -1,0 +1,4 @@
+# runit: asfactor_levels (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); g <- h2o.asfactor(fr$g); expect_equal(sort(unlist(h2o.levels(g))), c('a','b','c'))
+cat("runit_asfactor_levels: PASS\n")

@@ -1,0 +1,4 @@
+# runit: boolean_row_filter (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- fr[fr$x > 0, ]; expect_true(h2o.nrow(z) < 100)
+cat("runit_boolean_row_filter: PASS\n")

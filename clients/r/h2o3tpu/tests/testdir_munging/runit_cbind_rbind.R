@@ -1,0 +1,4 @@
+# runit: cbind_rbind (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); a <- fr[, 'x']; b <- fr[, 'y']; cb <- h2o.cbind(a, b); expect_equal(h2o.ncol(cb), 2); rb <- h2o.rbind(a, a); expect_equal(h2o.nrow(rb), 200)
+cat("runit_cbind_rbind: PASS\n")

@@ -1,0 +1,4 @@
+# runit: col_select (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- fr[, c('x', 'y')]; expect_equal(h2o.ncol(z), 2)
+cat("runit_col_select: PASS\n")

@@ -1,0 +1,4 @@
+# runit: compare_ops (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- fr$x > 0; expect_true(h2o.mean(z) > 0.2 && h2o.mean(z) < 0.8)
+cat("runit_compare_ops: PASS\n")

@@ -1,0 +1,4 @@
+# runit: cut_bins (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- h2o.cut(fr$x, c(-10, 0, 10)); expect_equal(h2o.nrow(z), 100)
+cat("runit_cut_bins: PASS\n")

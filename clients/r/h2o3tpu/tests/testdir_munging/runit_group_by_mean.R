@@ -1,0 +1,4 @@
+# runit: group_by_mean (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); gb <- h2o.group_by(fr, 'g', 'mean', 'x'); expect_equal(h2o.nrow(gb), 3)
+cat("runit_group_by_mean: PASS\n")

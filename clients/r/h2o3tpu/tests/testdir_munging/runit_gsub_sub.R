@@ -1,0 +1,4 @@
+# runit: gsub_sub (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- h2o.gsub('Str', 'X', fr$s); expect_equal(h2o.nrow(z), 100)
+cat("runit_gsub_sub: PASS\n")

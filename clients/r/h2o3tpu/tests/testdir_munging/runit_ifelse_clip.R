@@ -1,0 +1,4 @@
+# runit: ifelse_clip (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- h2o.ifelse(fr$x > 0, 1, 0); expect_true(h2o.max(z) <= 1)
+cat("runit_ifelse_clip: PASS\n")

@@ -1,0 +1,4 @@
+# runit: isna_count (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- h2o.isna(fr$x); expect_equal(h2o.sum(z), 0)
+cat("runit_isna_count: PASS\n")

@@ -1,0 +1,4 @@
+# runit: math_ops (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- abs(fr$x); expect_true(h2o.min(z) >= 0); z2 <- exp(fr$x); expect_true(h2o.min(z2) > 0)
+cat("runit_math_ops: PASS\n")

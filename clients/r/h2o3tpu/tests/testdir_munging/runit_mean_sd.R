@@ -1,0 +1,4 @@
+# runit: mean_sd (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); m <- h2o.mean(fr$x); expect_true(abs(m) < 0.5); expect_true(h2o.sd(fr$x) > 0.5)
+cat("runit_mean_sd: PASS\n")

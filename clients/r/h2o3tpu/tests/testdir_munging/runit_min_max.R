@@ -1,0 +1,4 @@
+# runit: min_max (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); expect_true(h2o.min(fr$x) < h2o.max(fr$x))
+cat("runit_min_max: PASS\n")

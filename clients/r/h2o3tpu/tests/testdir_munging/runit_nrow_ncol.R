@@ -1,0 +1,4 @@
+# runit: nrow_ncol (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); expect_equal(h2o.nrow(fr), 100); expect_equal(h2o.ncol(fr), 4)
+cat("runit_nrow_ncol: PASS\n")

@@ -1,0 +1,4 @@
+# runit: quantile_monotone (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); q <- h2o.quantile(fr$x, c(0.25, 0.5, 0.75)); expect_equal(h2o.nrow(q), 3)
+cat("runit_quantile_monotone: PASS\n")

@@ -1,0 +1,4 @@
+# runit: row_slice (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- fr[1:10, ]; expect_equal(h2o.nrow(z), 10)
+cat("runit_row_slice: PASS\n")

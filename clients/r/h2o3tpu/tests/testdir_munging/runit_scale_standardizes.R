@@ -1,0 +1,4 @@
+# runit: scale_standardizes (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); z <- h2o.scale(fr[, c('x','y')]); expect_true(abs(h2o.mean(z[, 'x'])) < 1e-5)
+cat("runit_scale_standardizes: PASS\n")

@@ -1,0 +1,4 @@
+# runit: sort_frame (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); s <- h2o.arrange(fr, 'x'); expect_equal(h2o.nrow(s), 100)
+cat("runit_sort_frame: PASS\n")

@@ -1,0 +1,4 @@
+# runit: string_prims (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); up <- h2o.toupper(h2o.trim(fr$s)); nc <- h2o.nchar(up); expect_true(h2o.min(nc) >= 4)
+cat("runit_string_prims: PASS\n")

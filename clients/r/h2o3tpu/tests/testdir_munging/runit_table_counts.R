@@ -1,0 +1,4 @@
+# runit: table_counts (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); tb <- h2o.table(fr$g); expect_equal(h2o.nrow(tb), 3)
+cat("runit_table_counts: PASS\n")

@@ -1,0 +1,4 @@
+# runit: unique_vals (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+source("../runit_utils.R")
+fr <- test_frame(); u <- h2o.unique(fr$g); expect_equal(h2o.nrow(u), 3)
+cat("runit_unique_vals: PASS\n")

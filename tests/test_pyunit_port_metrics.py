@@ -1,0 +1,223 @@
+"""Acceptance battery II: model metrics vs scikit-learn oracles + parser
+edge battery (testdir_parser behaviors) — the reference pyunits'
+numerical-parity discipline with sklearn standing in as the independent
+implementation."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from h2o3_tpu.models import metrics as M
+from h2o3_tpu.io.parser import import_file, parse_setup
+
+
+# ---- binomial metrics vs sklearn ------------------------------------------
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_auc_matches_sklearn(seed):
+    from sklearn.metrics import roc_auc_score
+    rng = np.random.default_rng(seed)
+    n = 4000
+    y = rng.integers(0, 2, n).astype(float)
+    p = np.clip(0.3 * y + rng.random(n) * 0.7, 1e-6, 1 - 1e-6)
+    m = M.binomial_metrics(jnp.asarray(y), jnp.asarray(p),
+                           jnp.ones(n, jnp.float32))
+    want = roc_auc_score(y, p)
+    assert abs(m.auc - want) < 2e-3, (m.auc, want)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_logloss_matches_sklearn(seed):
+    from sklearn.metrics import log_loss
+    rng = np.random.default_rng(seed)
+    n = 2000
+    y = rng.integers(0, 2, n).astype(float)
+    p = np.clip(0.4 * y + rng.random(n) * 0.6, 1e-6, 1 - 1e-6)
+    m = M.binomial_metrics(jnp.asarray(y), jnp.asarray(p),
+                           jnp.ones(n, jnp.float32))
+    assert abs(m.logloss - log_loss(y, p)) < 1e-4
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_pr_auc_close_to_sklearn(seed):
+    from sklearn.metrics import average_precision_score
+    rng = np.random.default_rng(seed)
+    n = 4000
+    y = (rng.random(n) < 0.3).astype(float)
+    p = np.clip(0.4 * y + rng.random(n) * 0.6, 1e-6, 1 - 1e-6)
+    m = M.binomial_metrics(jnp.asarray(y), jnp.asarray(p),
+                           jnp.ones(n, jnp.float32))
+    want = average_precision_score(y, p)
+    # 1024-bin PR curve vs sklearn's exact step integral
+    assert abs(m.pr_auc - want) < 2e-2
+
+
+# ---- regression metrics vs sklearn ----------------------------------------
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("metric", ["rmse", "mae", "r2"])
+def test_regression_metrics_match_sklearn(seed, metric):
+    from sklearn.metrics import (mean_absolute_error, mean_squared_error,
+                                 r2_score)
+    rng = np.random.default_rng(seed)
+    n = 3000
+    y = rng.normal(0, 2, n)
+    p = y + rng.normal(0, 0.7, n)
+    m = M.regression_metrics(jnp.asarray(y), jnp.asarray(p),
+                             jnp.ones(n, jnp.float32))
+    want = {"rmse": float(np.sqrt(mean_squared_error(y, p))),
+            "mae": float(mean_absolute_error(y, p)),
+            "r2": float(r2_score(y, p))}[metric]
+    assert abs(getattr(m, metric) - want) < 1e-4
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_multinomial_logloss_matches_sklearn(seed):
+    from sklearn.metrics import log_loss
+    rng = np.random.default_rng(seed)
+    n, k = 2000, 4
+    y = rng.integers(0, k, n)
+    logits = rng.normal(0, 1, (n, k)) + 2.0 * np.eye(k)[y]
+    P = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    m = M.multinomial_metrics(jnp.asarray(y.astype(float)),
+                              jnp.asarray(P), jnp.ones(n, jnp.float32))
+    assert abs(m.logloss - log_loss(y, P, labels=list(range(k)))) < 1e-4
+
+
+def test_weighted_metrics_respect_weights():
+    rng = np.random.default_rng(5)
+    n = 1000
+    y = rng.normal(0, 1, n)
+    p = y + rng.normal(0, 1.0, n)
+    w = np.zeros(n)
+    w[:100] = 1.0           # only first 100 rows count
+    m = M.regression_metrics(jnp.asarray(y), jnp.asarray(p),
+                             jnp.asarray(w.astype(np.float32)))
+    m100 = M.regression_metrics(jnp.asarray(y[:100]), jnp.asarray(p[:100]),
+                                jnp.ones(100, jnp.float32))
+    assert abs(m.rmse - m100.rmse) < 1e-5
+
+
+# ---- confusion-derived metrics --------------------------------------------
+@pytest.mark.parametrize("seed", [1, 2])
+def test_binomial_error_at_threshold(seed):
+    rng = np.random.default_rng(seed)
+    n = 1500
+    y = rng.integers(0, 2, n).astype(float)
+    p = np.clip(0.5 * y + rng.random(n) * 0.5, 1e-6, 1 - 1e-6)
+    m = M.binomial_metrics(jnp.asarray(y), jnp.asarray(p),
+                           jnp.ones(n, jnp.float32))
+    from sklearn.metrics import f1_score
+    # the F1 at the reported max-F1 threshold must at least match the
+    # plain 0.5-threshold F1 sklearn computes
+    sk_f1 = f1_score(y, (p > 0.5).astype(int))
+    assert m.f1 >= sk_f1 - 1e-6
+    assert 0.0 <= m.mean_per_class_error <= 0.5
+
+
+# ---- parser edge battery (testdir_parser) ----------------------------------
+@pytest.mark.parametrize("sep", [",", ";", "\t", "|"])
+def test_parser_separator_sniffing(tmp_path, sep):
+    p = tmp_path / "sep.csv"
+    rows = [sep.join(["a", "b", "c"])] + \
+        [sep.join(str(v) for v in (i, i * 2.5, i * 3)) for i in range(30)]
+    p.write_text("\n".join(rows) + "\n")
+    st = parse_setup(str(p))
+    assert st.separator == sep
+    fr = import_file(str(p))
+    assert fr.nrows == 30 and fr.ncols == 3
+
+
+@pytest.mark.parametrize("na", ["NA", "", "null", "NaN", "?"])
+def test_parser_na_tokens(tmp_path, na):
+    p = tmp_path / "na.csv"
+    p.write_text(f"x,y\n1,{na}\n2,5\n{na},6\n")
+    fr = import_file(str(p))
+    assert fr.vec("x").na_cnt() == 1
+    assert fr.vec("y").na_cnt() == 1
+
+
+def test_parser_quoted_fields_with_separators(tmp_path):
+    p = tmp_path / "q.csv"
+    p.write_text('x,s\n1,"hello, world"\n2,"a ""b"" c"\n')
+    fr = import_file(str(p))
+    assert fr.nrows == 2
+    sv = fr.vec("s")
+    vals = [str(s) for s in
+            (sv.levels() or list(sv.to_numpy()))]
+    assert any("hello" in v for v in vals)
+
+
+def test_parser_headerless_autonames(tmp_path):
+    p = tmp_path / "nohead.csv"
+    p.write_text("1,2.5,7\n2,3.5,8\n3,4.5,9\n")
+    fr = import_file(str(p))
+    assert list(fr.names) == ["C1", "C2", "C3"]
+    assert fr.nrows == 3
+
+
+def test_parser_gzip_roundtrip(tmp_path):
+    p = tmp_path / "z.csv.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("x,y\n1,a\n2,b\n3,a\n")
+    fr = import_file(str(p))
+    assert fr.nrows == 3
+    assert fr.vec("y").type == "enum"
+
+
+def test_parser_type_override(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("id,x\n001,1.5\n002,2.5\n007,3.5\n")
+    fr = import_file(str(p), col_types={"id": "enum"})
+    assert fr.vec("id").type == "enum"
+
+
+def test_parser_ragged_rows_pad_na(tmp_path):
+    p = tmp_path / "r.csv"
+    p.write_text("a,b,c\n1,2,3\n4,5\n6\n")
+    fr = import_file(str(p))
+    assert fr.nrows == 3
+    assert fr.vec("c").na_cnt() == 2
+
+
+def test_parser_time_column(tmp_path):
+    p = tmp_path / "tm.csv"
+    p.write_text("d,x\n2024-01-15,1\n2024-02-20,2\n2024-03-25,3\n")
+    fr = import_file(str(p))
+    assert fr.vec("d").type == "time"
+    v = fr.vec("d").to_numpy()
+    assert v[1] > v[0] and v[2] > v[1]
+
+
+def test_parser_svmlight_sparse(tmp_path):
+    p = tmp_path / "s.svm"
+    p.write_text("1 1:0.5 7:1.5\n0 2:2.0\n1 1:1.0 9:3.0\n")
+    fr = import_file(str(p))
+    assert fr.nrows == 3
+    assert fr.names[0] == "target"
+
+
+def test_parser_arff(tmp_path):
+    p = tmp_path / "a.arff"
+    p.write_text("@relation t\n@attribute x numeric\n"
+                 "@attribute k {u,v}\n@data\n1,u\n2,v\n3,u\n")
+    fr = import_file(str(p))
+    assert fr.nrows == 3
+    assert fr.vec("k").type == "enum"
+
+
+# ---- quantile oracle on bigger data ----------------------------------------
+@pytest.mark.parametrize("dist", ["normal", "exponential", "uniform"])
+def test_quantile_engine_vs_numpy(dist):
+    from h2o3_tpu.models.quantile import quantile as devq
+    rng = np.random.default_rng(11)
+    x = {"normal": rng.normal(0, 1, 20000),
+         "exponential": rng.exponential(1, 20000),
+         "uniform": rng.uniform(-3, 7, 20000)}[dist]
+    probs = [0.01, 0.1, 0.5, 0.9, 0.99]
+    got = devq(jnp.asarray(x, jnp.float32), probs)
+    want = np.quantile(x, probs)
+    np.testing.assert_allclose(np.asarray(got).ravel(), want,
+                               rtol=1e-3, atol=5e-3)

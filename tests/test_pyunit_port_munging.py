@@ -1,0 +1,275 @@
+"""Acceptance battery I: munging on REAL datasets with independent
+oracles (h2o-py/tests/testdir_munging behaviors re-authored; pandas/numpy
+as the oracle the way the reference pyunits compare against R/pandas).
+
+Data: canonical iris + wine (via scikit-learn's bundled copies — public
+datasets, ingested through OUR parser from CSV to exercise the real
+path), not synthetic frames."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu import client as h2o
+from h2o3_tpu.client import H2OFrame
+
+IRIS_COLS = ["sepal_len", "sepal_wid", "petal_len", "petal_wid"]
+
+
+def _iris_df():
+    from sklearn.datasets import load_iris
+    d = load_iris()
+    df = pd.DataFrame(d.data, columns=IRIS_COLS)
+    df["species"] = np.asarray(d.target_names, object)[d.target]
+    return df
+
+
+def _wine_df():
+    from sklearn.datasets import load_wine
+    d = load_wine()
+    cols = [c.replace("/", "_") for c in d.feature_names]
+    df = pd.DataFrame(d.data, columns=cols)
+    df["klass"] = np.asarray([f"c{t}" for t in d.target], object)
+    return df
+
+
+@pytest.fixture(scope="module")
+def iris_pd(tmp_path_factory):
+    return _iris_df()
+
+
+@pytest.fixture(scope="module")
+def iris(iris_pd, tmp_path_factory):
+    p = tmp_path_factory.mktemp("data") / "iris.csv"
+    iris_pd.to_csv(p, index=False)
+    return h2o.import_file(str(p))
+
+
+@pytest.fixture(scope="module")
+def wine_pd():
+    return _wine_df()
+
+
+@pytest.fixture(scope="module")
+def wine(wine_pd, tmp_path_factory):
+    p = tmp_path_factory.mktemp("data") / "wine.csv"
+    wine_pd.to_csv(p, index=False)
+    return h2o.import_file(str(p))
+
+
+# ---- ingest fidelity -------------------------------------------------------
+def test_iris_shape_and_types(iris, iris_pd):
+    assert iris.nrows == 150 and iris.ncols == 5
+    assert iris.names == list(iris_pd.columns)
+    assert iris.frame.vec("species").type == "enum"
+    assert sorted(iris.frame.vec("species").levels()) == [
+        "setosa", "versicolor", "virginica"]
+
+
+def test_wine_shape(wine, wine_pd):
+    assert wine.nrows == 178 and wine.ncols == 14
+
+
+@pytest.mark.parametrize("col", IRIS_COLS)
+def test_iris_column_values_exact(iris, iris_pd, col):
+    np.testing.assert_allclose(iris[col].frame.vecs[0].to_numpy(),
+                               iris_pd[col].to_numpy(), rtol=1e-6)
+
+
+# ---- reductions vs pandas --------------------------------------------------
+@pytest.mark.parametrize("col", IRIS_COLS)
+@pytest.mark.parametrize("op", ["mean", "min", "max", "sd", "median",
+                                "sum", "var"])
+def test_iris_reduce_matches_pandas(iris, iris_pd, col, op):
+    got = float(getattr(iris[col], op)())
+    want = {"mean": iris_pd[col].mean(), "min": iris_pd[col].min(),
+            "max": iris_pd[col].max(), "sd": iris_pd[col].std(),
+            "median": iris_pd[col].median(), "sum": iris_pd[col].sum(),
+            "var": iris_pd[col].var()}[op]
+    assert abs(got - float(want)) < 1e-4 * max(1.0, abs(want)), (op, col)
+
+
+# ---- element-wise math vs numpy -------------------------------------------
+@pytest.mark.parametrize("fn", ["log", "exp", "sqrt", "abs", "floor",
+                                "ceil"])
+@pytest.mark.parametrize("col", ["sepal_len", "petal_wid"])
+def test_iris_math_matches_numpy(iris, iris_pd, fn, col):
+    got = getattr(iris[col], fn)().frame.vecs[0].to_numpy()
+    npfn = {"log": np.log, "exp": np.exp, "sqrt": np.sqrt, "abs": np.abs,
+            "floor": np.floor, "ceil": np.ceil}[fn]
+    np.testing.assert_allclose(got, npfn(iris_pd[col].to_numpy()),
+                               rtol=2e-6)
+
+
+# ---- arithmetic vs pandas --------------------------------------------------
+@pytest.mark.parametrize("expr", ["a+b", "a-b", "a*b", "a/b", "a%b"])
+def test_iris_binop_matches_pandas(iris, iris_pd, expr):
+    a, b = iris["sepal_len"], iris["petal_len"]
+    pa, pb = iris_pd["sepal_len"], iris_pd["petal_len"]
+    got = {"a+b": a + b, "a-b": a - b, "a*b": a * b, "a/b": a / b,
+           "a%b": a % b}[expr].frame.vecs[0].to_numpy()
+    want = {"a+b": pa + pb, "a-b": pa - pb, "a*b": pa * pb,
+            "a/b": pa / pb, "a%b": pa % pb}[expr].to_numpy()
+    # f32 device math vs f64 pandas: absolute tolerance, plus the fmod
+    # representation boundary (x very close to a multiple of b wraps to 0
+    # in one precision and to ~b in the other — both are correct answers
+    # for their precision)
+    diff = np.abs(got - want)
+    ok = diff < 2e-5 + 1e-4 * np.abs(want)
+    if expr == "a%b":
+        ok |= np.abs(diff - np.abs(pb.to_numpy())) < 1e-4
+    assert ok.all(), (expr, np.nonzero(~ok))
+
+
+@pytest.mark.parametrize("cmp", [">", ">=", "<", "<=", "==", "!="])
+def test_iris_compare_matches_pandas(iris, iris_pd, cmp):
+    a = iris["sepal_len"]
+    got = {">": a > 5.8, ">=": a >= 5.8, "<": a < 5.8, "<=": a <= 5.8,
+           "==": a == 5.8, "!=": a != 5.8}[cmp].frame.vecs[0].to_numpy()
+    pa = iris_pd["sepal_len"]
+    want = {">": pa > 5.8, ">=": pa >= 5.8, "<": pa < 5.8,
+            "<=": pa <= 5.8, "==": pa == 5.8,
+            "!=": pa != 5.8}[cmp].to_numpy().astype(float)
+    np.testing.assert_allclose(got, want)
+
+
+# ---- slicing / filtering ---------------------------------------------------
+@pytest.mark.parametrize("thr", [4.9, 5.8, 6.7])
+def test_iris_filter_count_matches_pandas(iris, iris_pd, thr):
+    sub = iris[iris["sepal_len"] > thr]
+    assert sub.nrows == int((iris_pd["sepal_len"] > thr).sum())
+
+
+@pytest.mark.parametrize("cols", [["sepal_len"],
+                                  ["sepal_len", "petal_wid"],
+                                  IRIS_COLS])
+def test_iris_column_select(iris, cols):
+    sub = iris[cols]
+    assert sub.names == cols and sub.nrows == 150
+
+
+def test_iris_head_rows(iris, iris_pd):
+    h = iris.head(7)
+    assert len(h) == 7
+
+
+# ---- factors ---------------------------------------------------------------
+def test_iris_species_table_counts(iris, iris_pd):
+    tb = iris["species"].table().as_data_frame()
+    want = iris_pd["species"].value_counts()
+    got = dict(zip(tb.iloc[:, 0], tb.iloc[:, 1]))
+    for lvl, cnt in want.items():
+        assert got[lvl] == cnt
+
+
+def test_iris_unique_levels(iris):
+    u = iris["species"].unique()
+    assert u.nrows == 3
+
+
+def test_iris_asnumeric_roundtrip(iris):
+    zn = iris["species"].asnumeric()
+    v = zn.frame.vecs[0].to_numpy()
+    assert set(np.unique(v)) == {0.0, 1.0, 2.0}
+
+
+# ---- group_by vs pandas ----------------------------------------------------
+@pytest.mark.parametrize("agg", ["mean", "min", "max", "sum"])
+@pytest.mark.parametrize("col", ["sepal_len", "petal_len"])
+def test_iris_group_by_matches_pandas(iris, iris_pd, agg, col):
+    gb = getattr(iris.group_by("species"), agg)(col).get_frame()
+    pdf = gb.as_data_frame().sort_values(gb.names[0]).reset_index(drop=True)
+    want = getattr(iris_pd.groupby("species")[col], agg)().sort_index()
+    np.testing.assert_allclose(pdf.iloc[:, -1].to_numpy(),
+                               want.to_numpy(), rtol=1e-5)
+
+
+def test_iris_group_by_count(iris, iris_pd):
+    gb = iris.group_by("species").count().get_frame()
+    pdf = gb.as_data_frame()
+    assert sorted(pdf.iloc[:, -1]) == [50, 50, 50]
+
+
+# ---- sort vs pandas --------------------------------------------------------
+@pytest.mark.parametrize("col", ["sepal_len", "petal_wid"])
+def test_iris_sort_matches_pandas(iris, iris_pd, col):
+    s = iris.sort(col)
+    got = s[col].frame.vecs[0].to_numpy()
+    np.testing.assert_allclose(got, np.sort(iris_pd[col].to_numpy()),
+                               rtol=1e-6)
+
+
+# ---- quantiles vs numpy ----------------------------------------------------
+@pytest.mark.parametrize("col", IRIS_COLS)
+@pytest.mark.parametrize("prob", [0.1, 0.25, 0.5, 0.75, 0.9])
+def test_iris_quantile_matches_numpy(iris, iris_pd, col, prob):
+    out = iris[col]._x(
+        f'(quantile {iris[col]._fr.key} [{prob}] "interpolate")')
+    got = float(out.frame.vecs[-1].to_numpy()[0])
+    want = float(np.quantile(iris_pd[col].to_numpy(), prob))
+    assert abs(got - want) < 5e-2, (col, prob, got, want)
+
+
+# ---- scale / impute --------------------------------------------------------
+def test_iris_scale_standardizes(iris):
+    z = iris[IRIS_COLS].scale()
+    m = z.as_data_frame().mean()
+    s = z.as_data_frame().std()
+    assert np.all(np.abs(m.to_numpy()) < 1e-6)
+    assert np.all(np.abs(s.to_numpy() - 1.0) < 2e-2)
+
+
+def test_impute_fills_all_nas(iris_pd, tmp_path):
+    df = iris_pd.copy()
+    df.loc[df.index[:20], "sepal_len"] = np.nan
+    p = tmp_path / "iris_na.csv"
+    df.to_csv(p, index=False)
+    fr = h2o.import_file(str(p))
+    assert fr.frame.vec("sepal_len").na_cnt() == 20
+    fr2 = fr.impute("sepal_len", method="mean")
+    assert fr2.frame.vec("sepal_len").na_cnt() == 0
+
+
+# ---- cbind / rbind / merge -------------------------------------------------
+def test_iris_cbind_rbind(iris):
+    a = iris[["sepal_len"]]
+    b = iris[["petal_len"]]
+    cb = a.cbind(b)
+    assert cb.ncols == 2 and cb.nrows == 150
+    rb = a.rbind(a)
+    assert rb.nrows == 300
+
+
+def test_merge_on_group_keys(iris, iris_pd):
+    gb = iris.group_by("species").mean("sepal_len").get_frame()
+    m = iris.merge(gb)
+    assert m.nrows == 150 and m.ncols >= 6
+
+
+# ---- wine-side spot checks -------------------------------------------------
+@pytest.mark.parametrize("op", ["mean", "sd", "min", "max"])
+def test_wine_alcohol_stats(wine, wine_pd, op):
+    got = float(getattr(wine["alcohol"], op)())
+    want = {"mean": wine_pd["alcohol"].mean(),
+            "sd": wine_pd["alcohol"].std(),
+            "min": wine_pd["alcohol"].min(),
+            "max": wine_pd["alcohol"].max()}[op]
+    assert abs(got - float(want)) < 1e-4 * max(1.0, abs(want))
+
+
+def test_wine_filter_and_mean(wine, wine_pd):
+    sub = wine[wine["alcohol"] > 13.0]
+    assert sub.nrows == int((wine_pd["alcohol"] > 13.0).sum())
+    got = float(sub["malic_acid"].mean())
+    want = wine_pd.loc[wine_pd["alcohol"] > 13.0, "malic_acid"].mean()
+    assert abs(got - want) < 1e-4
+
+
+def test_wine_class_table(wine, wine_pd):
+    tb = wine["klass"].table().as_data_frame()
+    got = dict(zip(tb.iloc[:, 0], tb.iloc[:, 1]))
+    want = wine_pd["klass"].value_counts()
+    for lvl, cnt in want.items():
+        assert got[lvl] == cnt

@@ -1,0 +1,146 @@
+"""R-client munging surface contract (clients/r/h2o3tpu/R/munging.R).
+
+No R runtime ships in this image, so the contract splits into:
+  1. every Rapids prim name the R sources emit is registered server-side;
+  2. a REPLAY battery: the exact AST shapes each R operator sprintf-builds
+     are executed against a live server and must succeed with the right
+     result shape — the same ASTs the runit scripts
+     (clients/r/h2o3tpu/tests/) send when run under a real R.
+"""
+
+import json
+import os
+import re
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api.server import H2OServer
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RDIR = os.path.join(REPO, "clients", "r", "h2o3tpu")
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = H2OServer(port=0).start()
+    rng = np.random.default_rng(3)
+    n = 120
+    f = Frame.from_dict({
+        "x": rng.normal(0, 1, n), "y": rng.normal(0, 1, n),
+        "g": np.array(["a", "b", "c"], object)[rng.integers(0, 3, n)],
+        "s": np.asarray([f" Str{i} " for i in range(n)], object)},
+        key="rfr")
+    DKV.put("rfr", f)
+    yield s
+    DKV.remove("rfr")
+    s.stop()
+
+
+def _rapids(s, ast):
+    body = urllib.parse.urlencode({"ast": ast}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{s.port}/99/Rapids", data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    assert "error" not in out, (ast, out)
+    return out
+
+
+def test_all_emitted_prims_registered():
+    """Prim-name cross-language contract: extract `(name ...` heads from
+    every sprintf AST template in the R sources; each must be a
+    registered Rapids prim."""
+    from h2o3_tpu.rapids import rapids as _rap
+    src = ""
+    for fn in os.listdir(os.path.join(RDIR, "R")):
+        with open(os.path.join(RDIR, "R", fn)) as fh:
+            src += fh.read()
+    heads = set(re.findall(r'"\((tmp= %s )?([A-Za-z0-9_.]+) ', src))
+    names = {h[1] for h in heads} - {"s"}   # "%s" artifacts
+    assert len(names) >= 30, names
+    missing = sorted(n for n in names if n not in _rap.PRIMS)
+    assert not missing, f"R client emits unregistered prims: {missing}"
+
+
+# Each row: (R operator, the exact AST shape munging.R emits, checker)
+REPLAY = [
+    ("h2o.nrow", "(nrow rfr)", lambda r: r["scalar"] == 120),
+    ("h2o.ncol", "(ncol rfr)", lambda r: r["scalar"] == 4),
+    ("$ col", '(tmp= rx1 (cols rfr ["x"]))', None),
+    ("Ops +", "(tmp= rx2 (+ rx1 rx1))", None),
+    ("Ops >", "(tmp= rx3 (> rx1 0))", None),
+    ("Math abs", "(tmp= rx4 (abs rx1))", None),
+    ("[i,] rows", "(tmp= rx5 (rows rfr [0 1 2]))", None),
+    ("[fr] bool rows", "(tmp= rx6 (rows rfr rx3))", None),
+    ("h2o.mean", "(mean rx1)", lambda r: abs(r["scalar"]) < 0.5),
+    ("h2o.sum", "(sumNA rx3)", lambda r: 0 < r["scalar"] < 120),
+    ("h2o.min/max", "(min rx1)", lambda r: r["scalar"] < 0),
+    ("h2o.sd", "(sd rx1)", lambda r: r["scalar"] > 0.5),
+    ("h2o.median", "(median rx1)", lambda r: abs(r["scalar"]) < 0.6),
+    ("h2o.var", "(var rx1)", lambda r: r["scalar"] > 0.2),
+    ("h2o.quantile",
+     '(tmp= rq (quantile rfr [0.25 0.5 0.75] "interpolate"))', None),
+    ("h2o.asfactor", '(tmp= rg (cols rfr ["g"]))', None),
+    ("h2o.asfactor2", "(tmp= rg2 (as.factor rg))", None),
+    ("h2o.unique", "(tmp= ru (unique rg))",
+     lambda r: True),
+    ("h2o.table", "(tmp= rt (table rg))", None),
+    ("h2o.ifelse", "(tmp= ri (ifelse rx3 1 0))", None),
+    ("h2o.cut", "(tmp= rc (cut rx1 [-10 0 10]))", None),
+    ("h2o.isna", "(tmp= rn (is.na rx1))", None),
+    ("h2o.cbind", "(tmp= rcb (cbind rx1 rx2))", None),
+    ("h2o.rbind", "(tmp= rrb (rbind rx1 rx1))", None),
+    ("h2o.arrange", "(tmp= rs (sort rfr [0] [1]))", None),
+    ("h2o.group_by", '(tmp= rgb (GB rfr [2] "mean" 0 "all"))', None),
+    ("h2o.scale", "(tmp= rsc (scale rx1 TRUE TRUE))", None),
+    ("h2o.toupper", '(tmp= rst (cols rfr ["s"]))', None),
+    ("h2o.toupper2", "(tmp= rst2 (toupper (trim rst)))", None),
+    ("h2o.nchar", "(tmp= rnc (strlen rst2))", None),
+    ("h2o.gsub", '(tmp= rgs (replaceall rst "Str" "X" FALSE))', None),
+    ("h2o.sub", '(tmp= rsb (replacefirst rst "Str" "X" FALSE))', None),
+    ("h2o.strsplit", '(tmp= rsp (strsplit rst "t"))', None),
+    ("h2o.substring", "(tmp= rss (substring rst 0 3))", None),
+    ("$<- append", '(tmp= rap (append rfr rx2 "z"))', None),
+    ("h2o.impute", '(h2o.impute rfr 0 "mean")', None),
+]
+
+
+def test_replay_r_operator_asts(server):
+    """Execute every AST shape the R operators emit; shapes/results must
+    check out (this is what the runit scripts drive when R is present)."""
+    for name, ast, check in REPLAY:
+        out = _rapids(server, ast)
+        if check is not None:
+            assert check(out), (name, ast, out)
+
+
+def test_replayed_row_counts(server):
+    r = _rapids(server, "(nrow rx6)")       # boolean row filter
+    assert 0 < r["scalar"] < 120
+    r = _rapids(server, "(nrow rrb)")       # rbind doubled
+    assert r["scalar"] == 240
+    r = _rapids(server, "(ncol rcb)")       # cbind two cols
+    assert r["scalar"] == 2
+    r = _rapids(server, "(nrow rt)")        # 3 group levels
+    assert r["scalar"] == 3
+    r = _rapids(server, "(ncol rap)")       # appended col
+    assert r["scalar"] == 5
+
+
+def test_runit_scripts_exist_and_reference_harness():
+    """>=20 runit scripts exist and each sources the shared harness (the
+    structure check; execution needs an R runtime)."""
+    count = 0
+    for sub in ("testdir_munging", "testdir_algos"):
+        d = os.path.join(RDIR, "tests", sub)
+        for fn in os.listdir(d):
+            assert fn.startswith("runit_") and fn.endswith(".R")
+            src = open(os.path.join(d, fn)).read()
+            assert "runit_utils.R" in src, fn
+            count += 1
+    assert count >= 20, count
