@@ -1,42 +1,78 @@
 """GAM — hex/gam/GAM.java: generalized additive models via spline basis + GLM.
 
 Reference: GAM builds cubic-regression-spline basis columns for each
-`gam_columns` predictor (GamSplines/, MatrixFrameUtils/), appends them to the
-design matrix with a smoothness penalty, then delegates the fit to GLM.
+`gam_columns` predictor (GamSplines/CubicRegressionSpline — the
+value-at-knots parametrization of Wood §4.1.2), appends them to the design
+matrix with the TRUE curvature penalty matrix S = Dᵀ B⁻¹ D (∫f″² over the
+knot range, banded D/B from knot spacings), centers each basis block for
+identifiability against the intercept, then delegates the fit to GLM with
+the per-block penalty (scaled by `scale`).
 
-TPU-native: the basis expansion is a host-side construction of extra columns
-(small: num_knots per gam column); the fit is the GLM IRLS path (device Gram
-matmuls). The smoothness penalty enters as per-column L2 scaling
-(scale_tp_penalty approximation of the reference's penalty matrix).
-"""
+TPU-native: basis construction and the (num_knots²) penalty assembly are
+host work; the fit is the GLM IRLS path (device Gram matmuls) with the
+penalty folded into the normal equations (glm.py `quadratic_penalty`).
+With one gaussian gam column, knots at the data points and scale=λ this
+reproduces the classical smoothing spline exactly (tested against
+scipy.interpolate.make_smoothing_spline)."""
 
 from __future__ import annotations
 
 import numpy as np
 
-from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
 from h2o3_tpu.models.model import ModelBase
 
 
-def _cr_spline_basis(x: np.ndarray, knots: np.ndarray) -> np.ndarray:
-    """Natural cubic regression spline basis (GamSplines CubicRegressionSpline):
-    truncated-power natural spline with K knots → K columns."""
-    K = len(knots)
-    d = np.zeros((len(x), K))
-    xc = np.nan_to_num(x, nan=np.nanmean(x))
+def crs_design_and_penalty(x: np.ndarray, knots: np.ndarray):
+    """Cubic regression spline in the value-at-knots parametrization
+    (Wood 2006 §4.1.2; GamSplines/CubicRegressionSpline semantics).
 
-    def omega(z, k):
-        return np.where(z > k, (z - k) ** 3, 0.0)
+    Returns (X, S): X (n, K) maps knot values γ to f(x_i); S (K, K) is the
+    exact curvature penalty ∫ f″(t)² dt = γᵀSγ with S = Dᵀ B⁻¹ D."""
+    k = np.asarray(knots, np.float64)
+    K = len(k)
+    h = np.diff(k)                                   # (K-1,)
+    # banded D (K-2, K) and B (K-2, K-2)
+    D = np.zeros((K - 2, K))
+    B = np.zeros((K - 2, K - 2))
+    for i in range(K - 2):
+        D[i, i] = 1.0 / h[i]
+        D[i, i + 1] = -1.0 / h[i] - 1.0 / h[i + 1]
+        D[i, i + 2] = 1.0 / h[i + 1]
+        B[i, i] = (h[i] + h[i + 1]) / 3.0
+        if i + 1 < K - 2:
+            B[i, i + 1] = B[i + 1, i] = h[i + 1] / 6.0
+    Binv_D = np.linalg.solve(B, D)                   # (K-2, K)
+    S = D.T @ Binv_D                                 # (K, K) penalty
+    # F maps values γ to second derivatives m at ALL knots (natural BC:
+    # zero curvature at the end knots)
+    F = np.zeros((K, K))
+    F[1:-1] = Binv_D
 
-    denom = knots[-1] - knots[0] or 1.0
-    base = [np.ones_like(xc), xc]
-    for j in range(K - 2):
-        t = (omega(xc, knots[j]) - omega(xc, knots[-1])) / denom \
-            - (omega(xc, knots[-2]) - omega(xc, knots[-1])) / denom * \
-            (knots[-1] - knots[j]) / (knots[-1] - knots[-2])
-        base.append(t)
-    return np.column_stack(base[:K])
+    xc = np.nan_to_num(np.asarray(x, np.float64), nan=float(np.mean(k)))
+    xc = np.clip(xc, k[0], k[-1])                    # natural-spline clamp
+    j = np.clip(np.searchsorted(k, xc, side="right") - 1, 0, K - 2)
+    hj = h[j]
+    am = (k[j + 1] - xc) / hj
+    ap = (xc - k[j]) / hj
+    cm = ((k[j + 1] - xc) ** 3 / hj - hj * (k[j + 1] - xc)) / 6.0
+    cp = ((xc - k[j]) ** 3 / hj - hj * (xc - k[j])) / 6.0
+    n = len(xc)
+    X = np.zeros((n, K))
+    X[np.arange(n), j] += am
+    X[np.arange(n), j + 1] += ap
+    X += cm[:, None] * F[j] + cp[:, None] * F[j + 1]
+    return X, S
+
+
+def _centering_transform(X: np.ndarray):
+    """Identifiability constraint Σᵢ f(xᵢ) = 0 (the reference centers each
+    gam block so it cannot absorb the intercept): Z = null space of 1ᵀX."""
+    c = X.sum(axis=0, keepdims=True)                 # (1, K)
+    # householder-style: full SVD null space of the 1xK constraint
+    _, _, vt = np.linalg.svd(c, full_matrices=True)
+    return vt[1:].T                                  # (K, K-1)
 
 
 class H2OGeneralizedAdditiveEstimator(ModelBase):
@@ -51,11 +87,15 @@ class H2OGeneralizedAdditiveEstimator(ModelBase):
         gam_cols = self.params.get("gam_columns") or []
         gam_cols = [c[0] if isinstance(c, list) else c for c in gam_cols]
         nk = self.params.get("num_knots") or [6] * len(gam_cols)
+        scales = self.params.get("scale") or [1.0] * len(gam_cols)
         frame = training_frame
         self._gam_cols = gam_cols
         self._knots = {}
+        self._Z = {}
+        self._S = {}
         self._basis_names = {}
-        aug, vaug = self._augment(frame, gam_cols, nk, fit=True), None
+        aug = self._augment(frame, gam_cols, nk, fit=True)
+        vaug = None
         if validation_frame is not None:
             vaug = self._augment(validation_frame, gam_cols, nk, fit=False)
         xx = list(x) if x is not None else [c for c in frame.names if c != y]
@@ -64,6 +104,15 @@ class H2OGeneralizedAdditiveEstimator(ModelBase):
         glm_params = {k: v for k, v in self.params.items()
                       if k in H2OGeneralizedLinearEstimator._defaults
                       or k in H2OGeneralizedLinearEstimator._COMMON}
+        # named penalty blocks: the GLM indexes them into ITS OWN expanded
+        # design (and applies the standardization rescale), so
+        # interactions/weights/offset params can never desynchronize the
+        # penalty from the design matrix
+        glm_params["quadratic_penalty"] = [
+            (self._basis_names[c],
+             (float(scales[ci]) if ci < len(scales) else 1.0)
+             * (self._Z[c].T @ self._S[c] @ self._Z[c]))
+            for ci, c in enumerate(gam_cols)]
         self._glm = H2OGeneralizedLinearEstimator(**glm_params)
         self._glm.train(x=xx, y=y, training_frame=aug,
                         validation_frame=vaug)
@@ -81,14 +130,24 @@ class H2OGeneralizedAdditiveEstimator(ModelBase):
             xcol = frame.vec(c).to_numpy()
             if fit:
                 k = int(nk[ci]) if ci < len(nk) else 6
-                qs = np.linspace(0.02, 0.98, k)
+                qs = np.linspace(0.0, 1.0, k)
                 knots = np.unique(np.nanquantile(xcol, qs))
+                if len(knots) < 3:
+                    raise ValueError(
+                        f"gam column {c!r} has {len(knots)} distinct "
+                        "knot value(s); a cubic regression spline needs "
+                        ">= 3 (constant or near-constant column — drop "
+                        "it from gam_columns)")
                 self._knots[c] = knots
-                self._basis_names[c] = [f"{c}_gam{j}" for j in
-                                        range(len(knots))]
-            B = _cr_spline_basis(xcol, self._knots[c])
+            B, S = crs_design_and_penalty(xcol, self._knots[c])
+            if fit:
+                self._S[c] = S
+                self._Z[c] = _centering_transform(B)
+                self._basis_names[c] = [
+                    f"{c}_gam{j}" for j in range(self._Z[c].shape[1])]
+            Bz = B @ self._Z[c]
             for j, bn in enumerate(self._basis_names[c]):
-                out[bn] = B[:, j]
+                out[bn] = Bz[:, j]
         return out
 
     def predict(self, test_data: Frame) -> Frame:

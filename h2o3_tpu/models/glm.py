@@ -321,6 +321,12 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         # interactions: numeric columns whose pairwise products enter the
         # design (hex/DataInfo interactions; categorical pairs rejected)
         "interactions": None,
+        # quadratic_penalty: (p, p) matrix P adding ½·βᵀPβ to the
+        # objective Σw·nll(β) — the GAM spline-smoothness channel
+        # (hex/gam penalty matrix on the expanded design). Entries are in
+        # EXPANDED-FEATURE order (feature_names); the intercept row/col is
+        # appended as zeros when P is (p_pen, p_pen).
+        "quadratic_penalty": None,
     }
 
     # ------------------------------------------------------------------
@@ -386,6 +392,22 @@ class H2OGeneralizedLinearEstimator(ModelBase):
             (QUASIBINOMIAL, "logit"), (POISSON, "log"), (GAMMA, "log"),
             (NEGBINOMIAL, "log")}
         s = str(self.params.get("solver") or "AUTO").upper()
+        if self.params.get("quadratic_penalty") is not None:
+            if s in ("L_BFGS", "LBFGS"):
+                raise ValueError(
+                    "quadratic_penalty requires the IRLSM solver (the "
+                    "L-BFGS NLLs carry only the scalar L2 penalty)")
+            if fam in (MULTINOMIAL, ORDINAL):
+                raise NotImplementedError(
+                    "quadratic_penalty is implemented for the "
+                    "single-response IRLS families only; "
+                    f"family={fam} would silently drop the penalty")
+            if not self.params.get("intercept", True):
+                raise NotImplementedError(
+                    "quadratic_penalty requires intercept=True (the "
+                    "penalty block indexing assumes the appended "
+                    "intercept column)")
+            return "IRLSM"
         if s in ("L_BFGS", "LBFGS"):
             if constrained:
                 raise ValueError(
@@ -448,9 +470,49 @@ class H2OGeneralizedLinearEstimator(ModelBase):
             lo[:p_pen] = np.maximum(lo[:p_pen], 0.0)
         return lo, hi
 
+    def _resolve_quadratic_penalty(self, p1, p_pen):
+        """Materialize `quadratic_penalty` against THIS fit's expanded
+        design. Accepted forms:
+          * list of (feature_names, S) blocks — indexed into the model's
+            own DataInfo feature order (so interactions/standardization
+            cannot desynchronize caller-side assembly; the GAM path);
+          * a dense (p_pen, p_pen) or (p1, p1) matrix in expanded-feature
+            order (intercept block appended as zeros when absent).
+        Standardized designs rescale named blocks by 1/σᵢσⱼ
+        (β_std = σ·β_raw ⇒ P_std = diag(1/σ)·P·diag(1/σ))."""
+        P = self.params.get("quadratic_penalty")
+        if P is None:
+            return None
+        if isinstance(P, (list, tuple)):
+            feats = self._dinfo.feature_names
+            full = np.zeros((p1, p1))
+            for names, S in P:
+                idx = np.asarray([feats.index(nm) for nm in names])
+                S = np.asarray(S, np.float64)
+                if self._dinfo.standardize:
+                    sig = np.asarray(
+                        [max(self._dinfo.sigmas.get(nm, 1.0), 1e-10)
+                         for nm in names])
+                    S = S / np.outer(sig, sig)
+                full[np.ix_(idx, idx)] += S
+            return full
+        P = np.asarray(P, np.float64)
+        if P.shape == (p_pen, p_pen):           # append zero intercept block
+            Pf = np.zeros((p1, p1))
+            Pf[:p_pen, :p_pen] = P
+            P = Pf
+        if P.shape != (p1, p1):
+            raise ValueError(
+                f"quadratic_penalty shape {P.shape} does not match the "
+                f"expanded design ({p1} columns incl. intercept); pass "
+                "(feature_names, S) blocks to let the model index them")
+        return P
+
     def _sparse_path_ok(self) -> bool:
         if self.params.get("interactions"):
             return False        # interaction columns need the dense design
+        if self.params.get("quadratic_penalty") is not None:
+            return False        # P folds into the dense IRLS Gram only
         # the sparse NLLs are the canonical-link likelihoods only
         if (self._family, self._link) not in {
                 (GAUSSIAN, "identity"), (BINOMIAL, "logit"),
@@ -719,6 +781,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         Gn, qn = np.asarray(G, np.float64), np.asarray(q, np.float64)
         alpha, lams = self._alpha_lambda(Gn, qn - Gn @ beta, p_pen)
         lo, hi = self._beta_bounds(p1, p_pen)
+        P = self._resolve_quadratic_penalty(p1, p_pen)
         max_it = int(self.params["max_iterations"])
         beps = float(self.params["beta_epsilon"])
         path = []
@@ -731,15 +794,18 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                 G, q = _gram_pass(Xi, wi, z)
                 Gn = np.asarray(G, np.float64)
                 qn = np.asarray(q, np.float64)
+                # quadratic (spline-smoothness) penalty: ∇½βᵀPβ = Pβ folds
+                # into the Gram exactly, for both solvers
+                Gs = Gn if P is None else Gn + P
                 if (alpha > 0 and lam > 0) or lo is not None:
                     # objective is (1/N)·deviance + λ·pen ⇒ scale λ by Σw;
                     # bounds force the projected-COD solver too
-                    nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha, p_pen,
+                    nb = _cod_solve(Gs, qn, lam * wn.sum(), alpha, p_pen,
                                     beta, lo=lo, hi=hi)
                 else:
-                    A = Gn + lam * wn.sum() * (1 - alpha) * np.eye(p1)
+                    A = Gs + lam * wn.sum() * (1 - alpha) * np.eye(p1)
                     if p_pen < p1:
-                        A[p1 - 1, p1 - 1] = Gn[p1 - 1, p1 - 1]
+                        A[p1 - 1, p1 - 1] = Gs[p1 - 1, p1 - 1]
                     nb = np.linalg.solve(A + 1e-10 * np.eye(p1), qn)
                 dmax = float(np.max(np.abs(nb - beta)))
                 beta = nb

@@ -108,3 +108,81 @@ def test_timeline_and_profile():
     snap = TIMELINE.snapshot()
     assert [e["name"] for e in snap] == ["double", "controller-work"]
     assert all(e["done"] is not None for e in snap)
+
+
+def test_gam_penalty_matches_smoothing_spline():
+    """The CRS penalty is EXACT: with knots at the data points, gaussian
+    family and scale=lam, the GAM fit equals the classical smoothing
+    spline min RSS + lam*int f''^2 — computed independently by
+    scipy.interpolate.make_smoothing_spline."""
+    from scipy.interpolate import make_smoothing_spline
+    from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+    rng = np.random.default_rng(21)
+    n = 40
+    x = np.sort(rng.uniform(0, 6, n))
+    y = np.sin(x) + rng.normal(0, 0.25, n)
+    lam = 0.5
+    f = Frame.from_dict({"x": x, "y": y})
+    gam = H2OGeneralizedAdditiveEstimator(
+        family="gaussian", gam_columns=["x"], num_knots=[n],
+        scale=[lam], lambda_=0.0)
+    gam.train(x=[], y="y", training_frame=f)
+    ours = gam.predict(f).vecs[0].to_numpy()
+    ss = make_smoothing_spline(x, y, lam=lam)
+    want = ss(x)
+    np.testing.assert_allclose(ours, want, atol=2e-3)
+
+
+def test_gam_scale_controls_smoothness():
+    """scale -> huge drives the gam component to its penalty null space
+    (a straight line); scale small tracks the data closely."""
+    from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+    rng = np.random.default_rng(22)
+    n = 120
+    x = np.sort(rng.uniform(-3, 3, n))
+    y = np.sin(2 * x) + rng.normal(0, 0.1, n)
+    f = Frame.from_dict({"x": x, "y": y})
+
+    def fit(scale):
+        g = H2OGeneralizedAdditiveEstimator(
+            family="gaussian", gam_columns=["x"], num_knots=[10],
+            scale=[scale], lambda_=0.0)
+        g.train(x=[], y="y", training_frame=f)
+        return g.predict(f).vecs[0].to_numpy()
+
+    tight = fit(1e-6)
+    flat = fit(1e7)
+    # tight follows sin(2x); flat must be ~linear (the penalty null space)
+    assert np.corrcoef(tight, np.sin(2 * x))[0, 1] > 0.97
+    resid = flat - np.polyval(np.polyfit(x, flat, 1), x)
+    assert np.abs(resid).max() < 0.05, np.abs(resid).max()
+    # and the flat fit must NOT track the sine
+    assert abs(np.corrcoef(flat - flat.mean(),
+                           np.sin(2 * x))[0, 1]) < 0.5
+
+
+def test_gam_degenerate_and_unsupported_reject_loudly():
+    """Constant gam columns, multinomial family and intercept=False are
+    rejected with clear errors instead of crashing or silently dropping
+    the smoothness penalty."""
+    import pytest as _pytest
+    from h2o3_tpu.models.gam import H2OGeneralizedAdditiveEstimator
+    rng = np.random.default_rng(23)
+    n = 60
+    f = Frame.from_dict({"x": rng.normal(0, 1, n),
+                         "const": np.ones(n),
+                         "y": rng.normal(0, 1, n)})
+    with _pytest.raises(ValueError, match="distinct"):
+        H2OGeneralizedAdditiveEstimator(
+            family="gaussian", gam_columns=["const"]).train(
+                x=[], y="y", training_frame=f)
+    with _pytest.raises(NotImplementedError, match="intercept"):
+        H2OGeneralizedAdditiveEstimator(
+            family="gaussian", gam_columns=["x"], intercept=False).train(
+                x=[], y="y", training_frame=f)
+    yc = np.asarray(["a", "b", "c"], object)[rng.integers(0, 3, n)]
+    f3 = Frame.from_dict({"x": rng.normal(0, 1, n), "y": yc})
+    with _pytest.raises(NotImplementedError, match="family"):
+        H2OGeneralizedAdditiveEstimator(
+            family="multinomial", gam_columns=["x"]).train(
+                x=[], y="y", training_frame=f3)

@@ -246,3 +246,62 @@ def test_generic_estimator_loads_reference_mojo():
     assert np.isfinite(p).all()
     # must not be the bare intercept — trees contribute
     assert np.std(p) > 0
+
+
+def test_export_structural_conformance_with_genuine_mojo(tmp_path):
+    """Export-side format check against the genuine H2O artifact: every
+    zip entry class and model.ini key the reference genmodel scorer reads
+    from its own MOJO must exist in OUR export with the same layout.
+    (The Java scorer itself cannot run in this image — no JVM — so
+    conformance is held to the fixture's structure plus the byte-walk
+    round-trip tests above.)"""
+    import h2o3_tpu.models as models
+    from h2o3_tpu.core.frame import Frame
+    rng = np.random.default_rng(3)
+    n = 300
+    X = rng.normal(0, 1, (n, 4))
+    yv = X[:, 0] * 2 + np.sin(X[:, 1]) + rng.normal(0, 0.1, n)
+    f = Frame.from_dict({**{f"x{j}": X[:, j] for j in range(4)}, "y": yv})
+    m = models.H2OGradientBoostingEstimator(ntrees=5, max_depth=3, seed=1)
+    m.train(y="y", training_frame=f)
+    out = str(tmp_path / "exp.zip")
+    HM.export_h2o_mojo(m, out)
+
+    def entry_classes(path):
+        with zipfile.ZipFile(path) as z:
+            names = z.namelist()
+        classes = set()
+        for nm in names:
+            if nm.startswith("trees/"):
+                classes.add("trees/t.bin")
+            elif nm.startswith("domains/"):
+                classes.add("domains/")
+            else:
+                classes.add(nm)
+        return classes
+
+    def ini_keys(path):
+        with zipfile.ZipFile(path) as z:
+            txt = z.read("model.ini").decode()
+        keys = set()
+        for line in txt.splitlines():
+            if "=" in line and not line.startswith("["):
+                keys.add(line.split("=")[0].strip())
+        return keys
+
+    genuine_cls = entry_classes(FIXTURE)
+    ours_cls = entry_classes(out)
+    # the genuine artifact's entry classes the scorer reads must all be
+    # present (domains/ only when categorical columns exist)
+    # experimental/* is diagnostic-only — the scorer never reads it
+    required = {c for c in genuine_cls
+                if c != "domains/" and not c.startswith("experimental/")}
+    missing = {c for c in required if c not in ours_cls}
+    assert not missing, missing
+
+    need_keys = {"algorithm", "category", "n_features", "n_classes",
+                 "n_columns", "n_domains", "n_trees", "mojo_version"}
+    gk = ini_keys(FIXTURE)
+    ok = ini_keys(out)
+    assert need_keys <= gk       # sanity: the fixture really has them
+    assert need_keys <= ok, need_keys - ok

@@ -1,0 +1,468 @@
+"""Acceptance battery IV: Rapids primitive coverage with numpy/scipy/
+pandas oracles on real + structured data (the testdir_munging prim-level
+behaviors, one oracle comparison per prim)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.rapids.rapids import rapids_exec
+
+
+@pytest.fixture(scope="module")
+def data():
+    from sklearn.datasets import load_breast_cancer
+    d = load_breast_cancer()
+    cols = {f"c{j}": d.data[:, j] for j in range(8)}
+    return pd.DataFrame(cols)
+
+
+@pytest.fixture(scope="module")
+def fr(data):
+    f = Frame.from_dict({c: data[c].to_numpy() for c in data.columns},
+                        key="prfr")
+    DKV.put("prfr", f)
+    yield f
+    DKV.remove("prfr")
+
+
+def _col(out, j=0):
+    return out.vecs[j].to_numpy()
+
+
+# ---- cumulative ops vs numpy -----------------------------------------------
+@pytest.mark.parametrize("op,npfn", [("cumsum", np.cumsum),
+                                     ("cummax", np.maximum.accumulate),
+                                     ("cummin", np.minimum.accumulate),
+                                     ("cumprod", np.cumprod)])
+def test_cumulative_matches_numpy(fr, data, op, npfn):
+    col = "c3" if op != "cumprod" else "c0"
+    out = rapids_exec(f'({op} (cols prfr ["{col}"]) 0)')
+    x = data[col].to_numpy()
+    if op == "cumprod":
+        x = x[:40] * 0 + 1.001       # bounded to avoid overflow
+        f2 = Frame.from_dict({"z": x}, key="cpfr")
+        DKV.put("cpfr", f2)
+        out = rapids_exec('(cumprod (cols cpfr ["z"]) 0)')
+        np.testing.assert_allclose(_col(out), np.cumprod(x), rtol=1e-4)
+        DKV.remove("cpfr")
+        return
+    np.testing.assert_allclose(_col(out), npfn(x), rtol=2e-5)
+
+
+# ---- distribution moments vs scipy -----------------------------------------
+@pytest.mark.parametrize("col", ["c0", "c1", "c2", "c5"])
+def test_skewness_matches_scipy(fr, data, col):
+    from scipy.stats import skew
+    out = rapids_exec(f'(skewness (cols prfr ["{col}"]) FALSE)')
+    got = out if isinstance(out, float) else float(_col(out)[0])
+    want = skew(data[col].to_numpy(), bias=False)
+    assert abs(got - want) < 2e-3 * max(1, abs(want)), (got, want)
+
+
+@pytest.mark.parametrize("col", ["c0", "c1", "c2", "c5"])
+def test_kurtosis_matches_scipy(fr, data, col):
+    from scipy.stats import kurtosis
+    out = rapids_exec(f'(kurtosis (cols prfr ["{col}"]) FALSE)')
+    got = out if isinstance(out, float) else float(_col(out)[0])
+    want = kurtosis(data[col].to_numpy(), fisher=False, bias=False)
+    assert abs(got - want) < 5e-3 * max(1, abs(want)), (got, want)
+
+
+@pytest.mark.parametrize("pair", [("c0", "c2"), ("c1", "c3"),
+                                  ("c4", "c5")])
+def test_cor_matches_numpy(fr, data, pair):
+    a, b = pair
+    out = rapids_exec(f'(cor (cols prfr ["{a}"]) (cols prfr ["{b}"]) '
+                      f'"complete.obs" "pearson")')
+    got = out if isinstance(out, float) else float(_col(out)[0])
+    want = np.corrcoef(data[a], data[b])[0, 1]
+    assert abs(got - want) < 1e-4
+
+
+@pytest.mark.parametrize("col", ["c0", "c3"])
+def test_mad_matches_scipy(fr, data, col):
+    from scipy.stats import median_abs_deviation
+    out = rapids_exec(f'(h2o.mad (cols prfr ["{col}"]))')
+    got = out if isinstance(out, float) else float(_col(out)[0])
+    want = median_abs_deviation(data[col].to_numpy(), scale="normal")
+    assert abs(got - want) < 0.05 * max(1.0, abs(want)), (got, want)
+
+
+# ---- lag / which / na handling ---------------------------------------------
+def test_difflag1_matches_numpy(fr, data):
+    out = rapids_exec('(difflag1 (cols prfr ["c2"]))')
+    x = data["c2"].to_numpy()
+    got = _col(out)
+    np.testing.assert_allclose(got[1:], np.diff(x), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_which_matches_numpy(fr, data):
+    out = rapids_exec('(h2o.which (> (cols prfr ["c0"]) 20))')
+    got = _col(out).astype(int)
+    want = np.nonzero(data["c0"].to_numpy() > 20)[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_naomit_drops_exactly_nan_rows():
+    x = np.array([1.0, np.nan, 3.0, np.nan, 5.0])
+    f = Frame.from_dict({"x": x, "y": np.arange(5.0)}, key="nafr")
+    DKV.put("nafr", f)
+    out = rapids_exec("(na.omit nafr)")
+    assert out.nrows == 3
+    np.testing.assert_allclose(_col(out, 1), [0, 2, 4])
+    DKV.remove("nafr")
+
+
+@pytest.mark.parametrize("method", ["forward", "backward"])
+def test_fillna_matches_pandas(method):
+    x = np.array([np.nan, 1.0, np.nan, np.nan, 4.0, np.nan])
+    f = Frame.from_dict({"x": x}, key="fnfr")
+    DKV.put("fnfr", f)
+    out = rapids_exec(f'(h2o.fillna fnfr "{method}" 0 1000)')
+    s = pd.Series(x)
+    want = (s.ffill() if method == "forward" else s.bfill()).to_numpy()
+    np.testing.assert_allclose(_col(out), want, equal_nan=True)
+    DKV.remove("fnfr")
+
+
+# ---- seq / rep_len / topn --------------------------------------------------
+def test_seq_matches_numpy():
+    out = rapids_exec("(seq 2 20 3)")
+    np.testing.assert_allclose(_col(out), np.arange(2, 20.0001, 3))
+
+
+def test_seq_len():
+    out = rapids_exec("(seq_len 7)")
+    np.testing.assert_allclose(_col(out), np.arange(1, 8))
+
+
+def test_rep_len():
+    out = rapids_exec("(rep_len 3.5 6)")
+    np.testing.assert_allclose(_col(out), [3.5] * 6)
+
+
+@pytest.mark.parametrize("bottom", [0, 1])
+def test_topn_matches_numpy(fr, data, bottom):
+    out = rapids_exec(f'(topn prfr 0 5 {bottom})')
+    x = data["c0"].to_numpy()
+    vals = np.sort(_col(out, 1))
+    k = len(vals)
+    want = np.sort(np.sort(x)[:k] if bottom else np.sort(x)[-k:])
+    np.testing.assert_allclose(vals, want, rtol=1e-5)
+
+
+# ---- hist vs numpy ---------------------------------------------------------
+def test_hist_counts_match_numpy(fr, data):
+    out = rapids_exec('(hist (cols prfr ["c1"]) 10)')
+    x = data["c1"].to_numpy()
+    df = {n: _col(out, j) for j, n in enumerate(out.names)}
+    counts = df.get("counts")
+    assert counts is not None and int(np.nansum(counts)) == len(x)
+
+
+# ---- rank within groupby ---------------------------------------------------
+def test_rank_within_groupby_matches_pandas():
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 3, 60).astype(float)
+    v = rng.normal(0, 1, 60)
+    f = Frame.from_dict({"g": g, "v": v}, key="rkfr")
+    DKV.put("rkfr", f)
+    out = rapids_exec('(rank_within_groupby rkfr [0] [1] [0] "rnk" 0)')
+    pdf = pd.DataFrame({"g": g, "v": v})
+    want = pdf.groupby("g")["v"].rank(method="first").to_numpy()
+    got = _col(out, out.names.index("rnk"))
+    np.testing.assert_allclose(np.sort(got), np.sort(want))
+    DKV.remove("rkfr")
+
+
+# ---- melt / pivot ----------------------------------------------------------
+def test_melt_pivot_roundtrip():
+    f = Frame.from_dict({"id": np.arange(4.0),
+                         "a": np.array([1.0, 2, 3, 4]),
+                         "b": np.array([5.0, 6, 7, 8])}, key="mlfr")
+    DKV.put("mlfr", f)
+    out = rapids_exec('(melt mlfr [0] [1 2] "var" "val" FALSE)')
+    assert out.nrows == 8
+    assert set(out.names) >= {"id", "var", "val"}
+    DKV.remove("mlfr")
+
+
+# ---- string prim coverage via oracle ---------------------------------------
+@pytest.fixture(scope="module")
+def sfr():
+    vals = np.asarray(["Apple pie", "banana SPLIT", " cherry ",
+                       "Dough-nut", "e"], object)
+    from h2o3_tpu.core.frame import Vec
+    f = Frame(["s"], [Vec.from_numpy(vals, type="str")], key="spfr")
+    DKV.put("spfr", f)
+    yield vals
+    DKV.remove("spfr")
+
+
+@pytest.mark.parametrize("ast,pyfn", [
+    ('(toupper spfr)', lambda s: s.upper()),
+    ('(tolower spfr)', lambda s: s.lower()),
+    ('(trim spfr)', lambda s: s.strip()),
+    ('(lstrip spfr " ")', lambda s: s.lstrip(" ")),
+    ('(rstrip spfr " ")', lambda s: s.rstrip(" ")),
+    ('(substring spfr 1 4)', lambda s: s[1:4]),
+    ('(replaceall spfr "a" "_" FALSE)', lambda s: s.replace("a", "_")),
+])
+def test_string_prim_matches_python(sfr, ast, pyfn):
+    out = rapids_exec(ast)
+    got = list(out.vecs[0].to_numpy())
+    want = [pyfn(s) for s in sfr]
+    assert got == want, (ast, got, want)
+
+
+@pytest.mark.parametrize("ast,pyfn", [
+    ('(strlen spfr)', len),
+    ('(countmatches spfr "a")', lambda s: s.count("a")),
+])
+def test_string_measure_matches_python(sfr, ast, pyfn):
+    out = rapids_exec(ast)
+    got = _col(out)
+    want = np.array([float(pyfn(s)) for s in sfr])
+    np.testing.assert_allclose(np.nan_to_num(got), want)
+
+
+def test_num_valid_substrings_with_word_file(sfr, tmp_path):
+    wf = tmp_path / "words.txt"
+    wf.write_text("banana\ncherry\n")
+    out = rapids_exec(f'(num_valid_substrings spfr "{wf}")')
+    got = np.nan_to_num(_col(out))
+    # counts substrings of each string that are valid words in the file
+    assert got.sum() >= 1
+
+
+def test_grep_matches_python(sfr):
+    out = rapids_exec('(grep spfr "an" 0 0 0 1)')
+    idx = set(_col(out).astype(int).tolist())
+    want = {i for i, s in enumerate(sfr) if "an" in s}
+    assert idx == want
+
+
+def test_entropy_matches_formula(sfr):
+    out = rapids_exec('(entropy spfr)')
+    got = _col(out)
+
+    def H(s):
+        from collections import Counter
+        n = len(s)
+        if n == 0:
+            return 0.0
+        return -sum(c / n * np.log2(c / n) for c in Counter(s).values())
+    want = np.array([H(s) for s in sfr])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---- time prims ------------------------------------------------------------
+def test_time_parts_match_pandas():
+    # noon timestamps: midnight would straddle the cluster-timezone day
+    # boundary (the reference's time ops are timezone-aware)
+    ts = pd.to_datetime(["2024-01-15 12:00:00", "2024-06-30 12:00:00",
+                         "2023-12-25 12:00:00"])
+    f = Frame.from_dict(
+        {"t": np.asarray(ts.values, dtype="datetime64[ms]")}, key="tmfr")
+    DKV.put("tmfr", f)
+    for part, want in (("year", ts.year), ("month", ts.month),
+                       ("day", ts.day), ("dayOfWeek", ts.dayofweek)):
+        out = rapids_exec(f"({part} tmfr)")
+        np.testing.assert_allclose(_col(out), np.asarray(want, float),
+                                   err_msg=part)
+    # hour is cluster-timezone-relative (getTimeZone semantics): assert a
+    # CONSTANT shift of at most a timezone offset from the UTC hour
+    hrs = _col(rapids_exec("(hour tmfr)"))
+    shift = hrs - np.asarray(ts.hour, float)
+    assert np.all(shift == shift[0]) and abs(shift[0]) <= 14, shift
+    DKV.remove("tmfr")
+
+
+def test_mktime_roundtrip():
+    out = rapids_exec("(mktime 2024 5 14 10 30 0 0)")  # month is 0-based
+    got = float(out if isinstance(out, float) else _col(out)[0])
+    want = pd.Timestamp("2024-06-15 10:30:00").value // 10**6
+    assert abs(got - want) < 36_400_000  # within a day (tz semantics)
+
+
+# ---- moment / runif / stratified split -------------------------------------
+def test_runif_uniform(fr):
+    out = rapids_exec("(h2o.runif prfr 42)")
+    u = _col(out)
+    assert len(u) == fr.nrows and 0 <= u.min() and u.max() <= 1
+    assert 0.4 < u.mean() < 0.6
+
+
+def test_stratified_split_preserves_ratio():
+    rng = np.random.default_rng(8)
+    y = np.asarray(["a", "b"], object)[
+        (rng.random(400) < 0.25).astype(int)]
+    f = Frame.from_dict({"y": y}, key="ssfr")
+    DKV.put("ssfr", f)
+    out = rapids_exec('(h2o.random_stratified_split (cols ssfr [0]) '
+                      '0.3 42)')
+    s = _col(out)
+    frac = s.mean()
+    assert 0.2 < frac < 0.4
+    DKV.remove("ssfr")
+
+
+# ---- breast-cancer column stats sweep vs pandas ----------------------------
+@pytest.mark.parametrize("col", [f"c{j}" for j in range(8)])
+@pytest.mark.parametrize("op,ast", [("mean", "mean"), ("sd", "sd"),
+                                    ("max", "max")])
+def test_column_stat_sweep(fr, data, col, op, ast):
+    out = rapids_exec(f'({ast} (cols prfr ["{col}"]))')
+    got = out if isinstance(out, float) else float(np.ravel(_col(out))[0])
+    want = {"mean": data[col].mean(), "sd": data[col].std(),
+            "max": data[col].max()}[op]
+    assert abs(got - want) < 2e-4 * max(1.0, abs(want)), (col, op)
+
+
+# ---- rounding family vs numpy ----------------------------------------------
+@pytest.mark.parametrize("digits", [0, 1, 2, 3])
+def test_round_matches_numpy(fr, data, digits):
+    out = rapids_exec(f'(round (cols prfr ["c1"]) {digits})')
+    want = np.round(data["c1"].to_numpy(), digits)
+    np.testing.assert_allclose(_col(out), want, atol=10.0 ** -digits / 2
+                               + 1e-4)
+
+
+@pytest.mark.parametrize("digits", [1, 2, 3])
+def test_signif_matches_numpy(fr, data, digits):
+    out = rapids_exec(f'(signif (cols prfr ["c2"]) {digits})')
+    x = data["c2"].to_numpy()
+    mag = 10.0 ** (digits - 1 - np.floor(np.log10(np.abs(x) + 1e-30)))
+    want = np.round(x * mag) / mag
+    np.testing.assert_allclose(_col(out), want, rtol=1e-3)
+
+
+# ---- trig / special fns vs numpy -------------------------------------------
+@pytest.mark.parametrize("fn,npfn", [
+    ("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+    ("sinh", np.sinh), ("cosh", np.cosh), ("tanh", np.tanh),
+    ("log10", np.log10), ("log2", np.log2), ("log1p", np.log1p),
+    ("expm1", np.expm1),
+])
+def test_unary_math_sweep(fr, data, fn, npfn):
+    out = rapids_exec(f'({fn} (cols prfr ["c0"]))')
+    want = npfn(data["c0"].to_numpy())
+    np.testing.assert_allclose(_col(out), want, rtol=5e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("fn", ["lgamma", "digamma", "trigamma"])
+def test_gamma_family_matches_scipy(fr, data, fn):
+    from scipy.special import gammaln, digamma, polygamma
+    out = rapids_exec(f'({fn} (cols prfr ["c0"]))')
+    x = data["c0"].to_numpy()
+    want = {"lgamma": gammaln(x), "digamma": digamma(x),
+            "trigamma": polygamma(1, x)}[fn]
+    np.testing.assert_allclose(_col(out), want, rtol=2e-3, atol=1e-4)
+
+
+# ---- ifelse / clipping pipelines -------------------------------------------
+@pytest.mark.parametrize("thr", [12.0, 15.0, 20.0])
+def test_ifelse_threshold_pipeline(fr, data, thr):
+    out = rapids_exec(
+        f'(ifelse (> (cols prfr ["c0"]) {thr}) 1 0)')
+    want = (data["c0"].to_numpy() > thr).astype(float)
+    np.testing.assert_allclose(_col(out), want)
+
+
+# ---- factor releveling -----------------------------------------------------
+def test_relevel_moves_reference_level():
+    g = np.asarray(["lo", "mid", "hi"], object)[
+        np.random.default_rng(3).integers(0, 3, 50)]
+    f = Frame.from_dict({"g": g}, key="rlfr")
+    DKV.put("rlfr", f)
+    out = rapids_exec('(relevel (cols rlfr [0]) "mid")')
+    assert out.vecs[0].levels()[0] == "mid"
+    # decoded values unchanged
+    dec = [out.vecs[0].levels()[int(c)]
+           for c in out.vecs[0].to_numpy()]
+    assert dec == list(g)
+    DKV.remove("rlfr")
+
+
+def test_relevel_by_freq_orders_by_count():
+    g = np.asarray(["a"] * 5 + ["b"] * 30 + ["c"] * 10, object)
+    f = Frame.from_dict({"g": g}, key="rffr")
+    DKV.put("rffr", f)
+    out = rapids_exec('(relevel.by.freq (cols rffr [0]))')
+    assert out.vecs[0].levels()[0] == "b"
+    DKV.remove("rffr")
+
+
+# ---- columnsByType / filterNACols ------------------------------------------
+def test_columns_by_type_and_na_filter():
+    f = Frame.from_dict({
+        "n": np.arange(5.0),
+        "g": np.asarray(list("abcab"), object),
+        "m": np.array([1.0, np.nan, 3.0, np.nan, 5.0])}, key="cbfr")
+    DKV.put("cbfr", f)
+    num_idx = rapids_exec('(columnsByType cbfr "numeric")')
+    got = set(np.ravel(_col(num_idx)).astype(int).tolist()) \
+        if hasattr(num_idx, "vecs") else set(
+            int(v) for v in np.ravel(num_idx))
+    assert got == {0, 2}
+    na_ok = rapids_exec('(filterNACols cbfr 0.3)')
+    vals = (np.ravel(_col(na_ok)) if hasattr(na_ok, "vecs")
+            else np.ravel(na_ok)).astype(int)
+    assert 2 not in vals.tolist()     # 40% NA column filtered out
+    DKV.remove("cbfr")
+
+
+# ---- distance / tf-idf / tokenize ------------------------------------------
+def test_str_distance_levenshtein():
+    from h2o3_tpu.core.frame import Vec
+    a = Frame(["s"], [Vec.from_numpy(
+        np.asarray(["kitten", "flaw", "abc"], object), type="str")],
+        key="sda")
+    b = Frame(["s"], [Vec.from_numpy(
+        np.asarray(["sitting", "lawn", "abc"], object), type="str")],
+        key="sdb")
+    DKV.put("sda", a)
+    DKV.put("sdb", b)
+    out = rapids_exec('(strDistance sda sdb "lv" FALSE)')
+    np.testing.assert_allclose(_col(out), [3.0, 2.0, 0.0])
+    DKV.remove("sda")
+    DKV.remove("sdb")
+
+
+def test_tokenize_splits_to_long():
+    from h2o3_tpu.core.frame import Vec
+    f = Frame(["s"], [Vec.from_numpy(
+        np.asarray(["a b", "c d e"], object), type="str")], key="tkfr")
+    DKV.put("tkfr", f)
+    out = rapids_exec('(tokenize tkfr " ")')
+    toks = [s for s in out.vecs[0].to_numpy() if s]
+    assert "a" in toks and "e" in toks
+    DKV.remove("tkfr")
+
+
+@pytest.mark.parametrize("case", ["any", "all", "none"])
+def test_logical_reductions(case):
+    f = Frame.from_dict({"x": np.array([0.0, 1.0, 0.0, 1.0])},
+                        key="lgfr")
+    DKV.put("lgfr", f)
+    out = rapids_exec(f"({case} lgfr)")
+    got = bool(out if isinstance(out, (float, bool))
+               else np.ravel(_col(out))[0])
+    want = {"any": True, "all": False, "none": False}[case]
+    assert got == want, (case, got)
+    DKV.remove("lgfr")
+
+
+@pytest.mark.parametrize("col", ["c0", "c4"])
+def test_prod_matches_numpy(fr, data, col):
+    x = data[col].to_numpy()[:15] / 10.0     # bounded
+    f = Frame.from_dict({"z": x}, key="pdfr")
+    DKV.put("pdfr", f)
+    out = rapids_exec("(prod pdfr)")
+    got = out if isinstance(out, float) else float(np.ravel(_col(out))[0])
+    assert abs(got - np.prod(x)) < 1e-3 * max(1.0, abs(np.prod(x)))
+    DKV.remove("pdfr")
