@@ -86,10 +86,156 @@ loadAlgos(); refresh(); setInterval(refresh, 5000);
 """
 
 
-def h_flow(h):
-    body = FLOW_HTML.encode()
+def _send_html(h, body: bytes):
     h.send_response(200)
     h.send_header("Content-Type", "text/html; charset=utf-8")
     h.send_header("Content-Length", str(len(body)))
     h.end_headers()
     h.wfile.write(body)
+
+
+def h_flow(h):
+    _send_html(h, FLOW_HTML.encode())
+
+
+# ---------------------------------------------------------------------------
+# Flow notebook (the h2o-web Flow cell model): an ordered list of cells —
+# markdown | rapids | import | build | predict — executed top-to-bottom
+# against the same REST surface, persisted as named documents through
+# /3/NodePersistentStorage/notebooks/<name> (exactly where the reference
+# Flow keeps its .flow documents).
+NOTEBOOK_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>h2o3-tpu Flow notebook</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f6f8;color:#1d2733}
+ header{background:#123b57;color:#fff;padding:10px 18px;font-size:18px;display:flex;gap:14px;align-items:center}
+ header input{font:inherit;padding:3px 6px;border-radius:4px;border:0}
+ header a{color:#9fc3dd;font-size:12px}
+ #cells{max-width:980px;margin:16px auto;display:flex;flex-direction:column;gap:10px}
+ .cell{background:#fff;border-radius:8px;box-shadow:0 1px 3px rgba(0,0,0,.12);padding:10px 12px}
+ .cell .bar{display:flex;gap:6px;align-items:center;font-size:11px;color:#678}
+ .cell textarea{width:100%;font:12px/1.4 ui-monospace,monospace;border:1px solid #dde;border-radius:4px;margin-top:6px;padding:6px;box-sizing:border-box}
+ .cell pre{background:#0e1726;color:#d7e3f4;padding:8px;border-radius:6px;font-size:11px;overflow:auto;max-height:220px;margin:6px 0 0}
+ .cell .md{padding:4px 2px}
+ button{background:#1b6ca8;color:#fff;border:0;border-radius:4px;cursor:pointer;font-size:12px;padding:3px 8px}
+ button.ghost{background:#e4ecf2;color:#246}
+ select{font-size:12px}
+</style></head><body>
+<header>h2o3-tpu &mdash; Flow notebook
+ <input id="nbname" value="notebook1" size="14">
+ <button onclick="saveNb()">Save</button>
+ <button onclick="loadNb()">Load</button>
+ <button class="ghost" onclick="runAll()">Run all</button>
+ <span id="status" style="font-size:12px"></span>
+ <a href="/">ops dashboard</a>
+</header>
+<div id="cells"></div>
+<div style="text-align:center;margin:12px">
+ <select id="newtype"><option>rapids</option><option>markdown</option>
+  <option>import</option><option>build</option><option>predict</option></select>
+ <button onclick="addCell()">+ cell</button>
+</div>
+<script>
+const J = async (p, o) => (await fetch(p, o)).json();
+let cells = [
+ {type:'markdown', src:'# New Flow\\nCells run top-to-bottom against the cloud.'},
+ {type:'rapids', src:'(+ 1 2)'}];
+const PLACEHOLDER = {
+ rapids:'(rapids expression)',
+ markdown:'# heading\\ntext',
+ import:'source_frames=/data/train.csv&destination_frame=train',
+ build:'algo=gbm&training_frame=train&response_column=y&ntrees=20',
+ predict:'model=gbm_1&frame=train&predictions_frame=preds'};
+function render(){
+ const host = document.getElementById('cells');
+ host.innerHTML='';
+ cells.forEach((c,i)=>{
+  const d = document.createElement('div'); d.className='cell';
+  const md = c.type==='markdown';
+  d.innerHTML = `<div class="bar"><b>[${i}] ${c.type}</b>
+    <button onclick="runCell(${i})">Run</button>
+    <button class="ghost" onclick="moveCell(${i},-1)">&uarr;</button>
+    <button class="ghost" onclick="moveCell(${i},1)">&darr;</button>
+    <button class="ghost" onclick="delCell(${i})">&times;</button></div>` +
+   (md ? `<div class="md" id="md${i}"></div>` : '') +
+   `<textarea id="src${i}" rows="${md?3:2}"
+      placeholder="${PLACEHOLDER[c.type]}"
+      oninput="cells[${i}].src=this.value${md?';mdRender('+i+')':''}"></textarea>` +
+   `<pre id="out${i}" style="display:none"></pre>`;
+  host.appendChild(d);
+  document.getElementById('src'+i).value = c.src || '';
+  if (md) mdRender(i);
+ });
+}
+function mdRender(i){
+ const src = cells[i].src || '';
+ const esc = src.replace(/&/g,'&amp;').replace(/</g,'&lt;');
+ document.getElementById('md'+i).innerHTML = esc
+  .replace(/^### (.*)$/gm,'<h3>$1</h3>').replace(/^## (.*)$/gm,'<h2>$1</h2>')
+  .replace(/^# (.*)$/gm,'<h1>$1</h1>')
+  .replace(/\\*\\*([^*]+)\\*\\*/g,'<b>$1</b>').replace(/`([^`]+)`/g,'<code>$1</code>')
+  .replace(/\\n/g,'<br>');
+}
+function addCell(){cells.push({type:document.getElementById('newtype').value, src:''}); render();}
+function delCell(i){cells.splice(i,1); render();}
+function moveCell(i,d){const j=i+d; if(j<0||j>=cells.length)return;
+ [cells[i],cells[j]]=[cells[j],cells[i]]; render();}
+async function runCell(i){
+ const c = cells[i];
+ c.src = document.getElementById('src'+i).value;
+ const out = document.getElementById('out'+i);
+ if (c.type==='markdown'){ mdRender(i); return; }
+ out.style.display='block'; out.textContent='...';
+ try {
+  let r;
+  if (c.type==='rapids'){
+   const p=new URLSearchParams(); p.set('ast', c.src);
+   r = await J('/99/Rapids',{method:'POST',body:p});
+  } else if (c.type==='import'){
+   const p=new URLSearchParams(c.src);
+   const s=await J('/3/Parse',{method:'POST',body:p});
+   r = await waitJob(s.job && s.job.key) || s;
+  } else if (c.type==='build'){
+   const p=new URLSearchParams(c.src);
+   const algo=p.get('algo'); p.delete('algo');
+   const s=await J('/3/ModelBuilders/'+algo,{method:'POST',body:p});
+   r = await waitJob(s.job && s.job.key) || s;
+  } else if (c.type==='predict'){
+   const p=new URLSearchParams(c.src);
+   r = await J(`/3/Predictions/models/${p.get('model')}/frames/${p.get('frame')}`,
+     {method:'POST', body:new URLSearchParams({predictions_frame:p.get('predictions_frame')||'preds'})});
+  }
+  out.textContent = JSON.stringify(r, null, 1).slice(0, 4000);
+ } catch(e){ out.textContent = 'ERROR ' + e; }
+}
+async function waitJob(key){
+ if(!key) return null;
+ for(let i=0;i<600;i++){
+  const j=(await J('/3/Jobs/'+key)).jobs[0];
+  if(['DONE','FAILED','CANCELLED'].includes(j.status)) return j;
+  await new Promise(r=>setTimeout(r,400));
+ }
+ return {status:'TIMEOUT'};
+}
+async function runAll(){for(let i=0;i<cells.length;i++) await runCell(i);}
+async function saveNb(){
+ const name=document.getElementById('nbname').value||'notebook1';
+ const p=new URLSearchParams(); p.set('value', JSON.stringify(cells));
+ await J('/3/NodePersistentStorage/notebooks/'+encodeURIComponent(name),{method:'POST',body:p});
+ document.getElementById('status').textContent='saved '+new Date().toLocaleTimeString();
+}
+async function loadNb(){
+ const name=document.getElementById('nbname').value||'notebook1';
+ try{
+  const r=await J('/3/NodePersistentStorage/notebooks/'+encodeURIComponent(name));
+  cells=JSON.parse(r.value); render();
+  document.getElementById('status').textContent='loaded';
+ }catch(e){document.getElementById('status').textContent='not found';}
+}
+render();
+</script></body></html>
+"""
+
+
+def h_notebook(h):
+    _send_html(h, NOTEBOOK_HTML.encode())

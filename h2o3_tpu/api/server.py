@@ -576,6 +576,7 @@ from h2o3_tpu.api import flow as _flow  # noqa: E402
 ROUTES += [
     (re.compile(r"/"), "GET", _flow.h_flow),
     (re.compile(r"/flow/index\.html"), "GET", _flow.h_flow),
+    (re.compile(r"/flow/notebook\.html"), "GET", _flow.h_notebook),
 ]
 
 

@@ -108,17 +108,29 @@ class H2OWord2vecEstimator(ModelBase):
                 vneg = s1[neg_idx]                   # (B, neg, d)
                 pos = jax.nn.log_sigmoid((vc * vpos).sum(-1))
                 negs = jax.nn.log_sigmoid(-(vc[:, None, :] * vneg).sum(-1))
-                return -(pos.sum() + negs.sum()) / c_idx.shape[0]
+                # SUM over pairs: a batch of row-sparse per-pair grads is
+                # (approximately) the same as word2vec's sequential SGD
+                # updates — the MEAN formulation moved vectors ~1/B as far
+                # per epoch and left embeddings untrained at any sane
+                # epoch count
+                return -(pos.sum() + negs.sum())
 
-            l, g = jax.value_and_grad(loss)(( syn0, syn1))
-            return syn0 - lr * g[0], syn1 - lr * g[1], l
+            l, g = jax.value_and_grad(loss)((syn0, syn1))
+            # clip per-element: small vocabularies collide many pairs on
+            # the same row inside a batch; unclipped sum-updates diverge
+            g0 = jnp.clip(g[0], -1.0, 1.0)
+            g1 = jnp.clip(g[1], -1.0, 1.0)
+            return syn0 - lr * g0, syn1 - lr * g1, l
 
-        B = min(8192, len(centers))
+        B = min(1024, len(centers))
         nsteps = max(1, epochs * len(centers) // B)
+        # init_learning_rate is the reference's PER-PAIR rate; the summed
+        # batch step applies ~B pair-updates at once, so scale down
+        step_lr = lr * 0.1
         for s in range(nsteps):
             idx = rng.integers(0, len(centers), B)
             negs = rng.choice(V, size=(B, neg), p=freq)
-            cur_lr = lr * max(0.1, 1 - s / nsteps)
+            cur_lr = step_lr * max(0.1, 1 - s / nsteps)
             syn0, syn1, l = step(syn0, syn1,
                                  jnp.asarray(centers[idx]),
                                  jnp.asarray(contexts[idx]),

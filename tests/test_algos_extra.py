@@ -221,3 +221,60 @@ def test_coxph_strata_requires_categorical():
         stop_column="time", stratify_by="z")
     with _pytest.raises(Exception, match="categorical"):
         m.train(x=["x"], y="event", training_frame=f)
+
+
+def test_word2vec_similarity_margin():
+    """Quantitative embedding quality (the WordVectorTrainer parity
+    check): mean intra-topic cosine similarity must beat inter-topic by
+    a clear margin on a 12-word two-topic corpus, and transform()
+    AVERAGE must place topic-pure documents on their topic centroid."""
+    rng = np.random.default_rng(9)
+    topic_a = ["cat", "dog", "pet", "fur", "paw", "tail"]
+    topic_b = ["car", "truck", "road", "fuel", "tire", "gear"]
+    sents = []
+    for _ in range(300):
+        t = topic_a if rng.random() < 0.5 else topic_b
+        sents += list(rng.choice(t, 4)) + [None]
+    f = Frame.from_dict({"words": np.array(sents, object)},
+                        column_types={"words": "str"})
+    w2v = H2OWord2vecEstimator(vec_size=24, epochs=60, min_word_freq=5,
+                               window_size=3, seed=1)
+    w2v.train(training_frame=f)
+    vf = w2v.to_frame()
+    wv = vf.vecs[0]
+    if wv.type == "enum":       # word column encodes through the domain
+        dom = wv.levels()
+        words = [dom[int(c)] for c in wv.to_numpy()]
+    else:
+        words = [str(s) for s in wv.to_numpy()]
+    V = np.stack([vf.vecs[j + 1].to_numpy() for j in range(24)], axis=1)
+    Vmean = V.mean(axis=0)     # shared drift direction
+    V = V - Vmean
+    V = V / np.linalg.norm(V, axis=1, keepdims=True)
+    emb = {w: V[i] for i, w in enumerate(words)}
+
+    def mean_sim(ws1, ws2):
+        sims = [emb[a] @ emb[b] for a in ws1 for b in ws2 if a != b
+                and a in emb and b in emb]
+        return float(np.mean(sims))
+
+    intra = 0.5 * (mean_sim(topic_a, topic_a) + mean_sim(topic_b, topic_b))
+    inter = mean_sim(topic_a, topic_b)
+    assert intra > inter + 0.15, (intra, inter)
+
+    # transform(AVERAGE): topic-pure docs must be closer to their own
+    # topic centroid than to the other
+    doc = Frame.from_dict(
+        {"words": np.array(["cat", "dog", "fur", None,
+                            "car", "road", "tire", None], object)},
+        column_types={"words": "str"})
+    tv = w2v.transform(doc, aggregate_method="AVERAGE")
+    D = np.stack([tv.vecs[j].to_numpy() for j in range(tv.ncols)], axis=1)
+    D = D - Vmean              # same centering as the word vectors
+    ca = np.mean([emb[w] for w in topic_a if w in emb], axis=0)
+    cb = np.mean([emb[w] for w in topic_b if w in emb], axis=0)
+    d0 = D[0] / max(np.linalg.norm(D[0]), 1e-9)
+    d1 = D[1] / max(np.linalg.norm(D[1]), 1e-9)
+    assert d0 @ ca > d0 @ cb
+    assert d1 @ cb > d1 @ ca
+    h2o3_tpu.remove(f.key)

@@ -201,3 +201,17 @@ def test_delete_all_models_and_frames(server):
     r = _delete(server, "/3/Frames")
     assert r["deleted"] >= 1
     assert DKV.get("delf") is None
+
+
+def test_flow_notebook_page_and_persistence(server):
+    """The Flow notebook page serves, and its save/load path (NPS under
+    notebooks/) round-trips a cell document."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/flow/notebook.html") as r:
+        html = r.read().decode()
+    assert "Flow notebook" in html and "runCell" in html
+    doc = json.dumps([{"type": "rapids", "src": "(+ 1 2)"}])
+    _post(server, "/3/NodePersistentStorage/notebooks/nb_t", value=doc)
+    got = _get(server, "/3/NodePersistentStorage/notebooks/nb_t")
+    assert json.loads(got["value"])[0]["src"] == "(+ 1 2)"
+    _delete(server, "/3/NodePersistentStorage/notebooks/nb_t")
