@@ -54,13 +54,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- security (water/H2OSecurityManager.java + webserver auth) ------
     def _check_auth(self) -> bool:
-        """HTTP Basic auth when the server was started with credentials
-        (-hash_login/-basic_auth analog). Constant-time compare."""
-        creds = getattr(self.server, "auth_creds", None)
-        if not creds:
+        """HTTP Basic credentials checked against the configured
+        authenticator (utils/auth: basic file, LDAP simple bind, custom
+        LoginModule — the -basic_auth/-ldap_login surface)."""
+        authn = getattr(self.server, "authenticator", None)
+        if authn is None:
             return True
         import base64
-        import hmac
         hdr = self.headers.get("Authorization", "")
         if hdr.startswith("Basic "):
             try:
@@ -68,13 +68,13 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 got = ""
             user, _, pwd = got.partition(":")
-            # compare BYTES: compare_digest raises on non-ASCII str, which
-            # would let a crafted header crash the handler pre-auth
-            ub, pb = user.encode(), pwd.encode()
-            for u, p in creds.items():
-                if hmac.compare_digest(ub, u.encode()) and \
-                        hmac.compare_digest(pb, p.encode()):
+            try:
+                # a crafted pre-auth header must yield 401, never a
+                # handler crash — custom LoginModules may raise
+                if authn.authenticate(user, pwd):
                     return True
+            except Exception:
+                pass
         self.send_response(401)
         self.send_header("WWW-Authenticate",
                          'Basic realm="h2o3-tpu"')
@@ -615,7 +615,12 @@ class H2OServer:
                         u, _, p = line.partition(":")
                         creds[u] = p
             auth = creds
-        self.httpd.auth_creds = auth or None
+        from h2o3_tpu.utils import auth as _auth
+        if auth:
+            # explicit caller credentials win over the configured method
+            self.httpd.authenticator = _auth.BasicAuthenticator(auth)
+        else:
+            self.httpd.authenticator = _auth.resolve_authenticator(None)
         ssl_cert = ssl_cert or _cfg.get_property("api.ssl_cert", None)
         ssl_key = ssl_key or _cfg.get_property("api.ssl_key", None)
         if ssl_cert and ssl_key:

@@ -283,11 +283,14 @@ def serve(port: int = 54321):
         # Binding 0.0.0.0 without credentials exposes the whole modeling
         # surface to the pod network; require auth unless explicitly waived
         # (mirrors the reference's -disable_web/-hash_login posture).
-        if (not _cfg.get_property("api.auth_file", None)
-                and os.environ.get("H2O3_INSECURE_BIND_ALL") != "1"):
+        has_auth = (_cfg.get_property("api.auth_file", None)
+                    or str(_cfg.get_property("api.auth_method", "")
+                           or "").lower() in ("ldap", "custom"))
+        if not has_auth and os.environ.get("H2O3_INSECURE_BIND_ALL") != "1":
             raise RuntimeError(
                 "serve() binds 0.0.0.0: configure ai.h2o.api.auth_file "
-                "(Basic auth) or set H2O3_INSECURE_BIND_ALL=1 to waive")
+                "(Basic auth) / api.auth_method=ldap|custom, or set "
+                "H2O3_INSECURE_BIND_ALL=1 to waive")
         srv = H2OServer(port)
         if nproc > 1:
             srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)

@@ -115,8 +115,19 @@ def parse_avro(path: str, key: Optional[str] = None) -> Frame:
     return Frame.from_dict(np_cols, key)
 
 
+def _parse_xlsx(path, destination_frame=None):
+    from h2o3_tpu.io.xlsx import parse_xlsx
+    return parse_xlsx(path, destination_frame)
+
+
+def _reject_xls(path, destination_frame=None):
+    from h2o3_tpu.io.xlsx import reject_legacy_xls
+    return reject_legacy_xls(path, destination_frame)
+
+
 _EXT = {".parquet": parse_parquet, ".pqt": parse_parquet,
-        ".orc": parse_orc, ".feather": parse_feather, ".avro": parse_avro}
+        ".orc": parse_orc, ".feather": parse_feather, ".avro": parse_avro,
+        ".xlsx": _parse_xlsx, ".xls": _reject_xls}
 
 _MAGIC = [(b"PAR1", parse_parquet), (b"ORC", parse_orc),
           (b"Obj\x01", parse_avro), (b"ARROW1", parse_feather)]

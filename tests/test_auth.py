@@ -1,0 +1,208 @@
+"""Pluggable REST auth (utils/auth) — the h2o-security login-module
+surface: basic file creds, REAL LDAP simple bind (BER over a socket,
+tested against an in-process fake LDAP server), custom LoginModule SPI,
+loud-rejected kerberos/spnego/pam."""
+
+import base64
+import socket
+import sys
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.utils import auth as A
+from h2o3_tpu.utils import config as _cfg
+
+
+# ---------------------------------------------------------------------------
+class FakeLdap:
+    """Accepts LDAPv3 simple binds; success iff (dn, password) matches."""
+
+    def __init__(self, dn: str, password: str):
+        self.dn, self.password = dn, password
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.port = self.srv.getsockname()[1]
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            with conn:
+                data = conn.recv(4096)
+                if not data:
+                    continue
+                try:
+                    dn, pw, msg_id = self._parse_bind(data)
+                    code = 0 if (dn == self.dn and pw == self.password) \
+                        else 49          # invalidCredentials
+                except Exception:
+                    code = 2             # protocolError
+                    msg_id = 1
+                conn.sendall(self._bind_response(msg_id, code))
+
+    @staticmethod
+    def _parse_bind(data):
+        _t, msg, _ = A._read_tlv(data, 0)
+        _t, mid, off = A._read_tlv(msg, 0)
+        tag, bind, _ = A._read_tlv(msg, off)
+        assert tag == 0x60, hex(tag)
+        _t, _ver, off2 = A._read_tlv(bind, 0)
+        _t, dn, off2 = A._read_tlv(bind, off2)
+        tag, pw, _ = A._read_tlv(bind, off2)
+        assert tag == 0x80               # simple auth
+        return dn.decode(), pw.decode(), int.from_bytes(mid, "big")
+
+    def _bind_response(self, msg_id, code):
+        inner = (A._tlv(0x0A, bytes([code]))     # resultCode ENUMERATED
+                 + A._tlv(0x04, b"") + A._tlv(0x04, b""))
+        return A._tlv(0x30, A._ber_int(msg_id) + A._tlv(0x61, inner))
+
+    def close(self):
+        self.srv.close()
+
+
+@pytest.fixture()
+def ldap_server():
+    s = FakeLdap("uid=alice,ou=people,dc=ex,dc=com", "s3cret")
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+def test_ldap_simple_bind(ldap_server):
+    a = A.LdapAuthenticator(
+        "127.0.0.1", ldap_server.port,
+        bind_template="uid={user},ou=people,dc=ex,dc=com")
+    assert a.authenticate("alice", "s3cret")
+    assert not a.authenticate("alice", "wrong")
+    assert not a.authenticate("bob", "s3cret")
+    assert not a.authenticate("alice", "")     # no unauthenticated bind
+
+
+def test_ldap_unreachable_denies():
+    a = A.LdapAuthenticator("127.0.0.1", 1, timeout=0.3)
+    assert not a.authenticate("alice", "pw")
+
+
+def test_basic_authenticator_constant_surface():
+    a = A.BasicAuthenticator({"u1": "p1", "u2": "p2"})
+    assert a.authenticate("u2", "p2")
+    assert not a.authenticate("u2", "p1")
+    assert not a.authenticate("", "")
+
+
+def test_custom_module_spi():
+    mod = types.ModuleType("fake_auth_mod")
+    mod.authenticate = lambda u, p: u == "svc" and p == "tok"
+    sys.modules["fake_auth_mod"] = mod
+    try:
+        a = A.CustomAuthenticator("fake_auth_mod")
+        assert a.authenticate("svc", "tok")
+        assert not a.authenticate("svc", "no")
+    finally:
+        del sys.modules["fake_auth_mod"]
+
+
+def test_kerberos_pam_spnego_loud_reject(monkeypatch):
+    for method in ("kerberos", "pam", "spnego"):
+        monkeypatch.setenv("H2O3_TPU_API_AUTH_METHOD", method)
+        with pytest.raises(NotImplementedError, match=method):
+            A.resolve_authenticator()
+    monkeypatch.setenv("H2O3_TPU_API_AUTH_METHOD", "nope")
+    with pytest.raises(ValueError, match="unknown"):
+        A.resolve_authenticator()
+
+
+def test_rest_server_with_ldap_auth(ldap_server, monkeypatch):
+    """End-to-end: REST requests authenticate through the LDAP bind."""
+    monkeypatch.setenv("H2O3_TPU_API_AUTH_METHOD", "ldap")
+    monkeypatch.setenv("H2O3_TPU_API_LDAP_HOST", "127.0.0.1")
+    monkeypatch.setenv("H2O3_TPU_API_LDAP_PORT", str(ldap_server.port))
+    monkeypatch.setenv("H2O3_TPU_API_LDAP_BIND_TEMPLATE",
+                       "uid={user},ou=people,dc=ex,dc=com")
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    try:
+        url = f"http://127.0.0.1:{s.port}/3/Cloud"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 401
+        req = urllib.request.Request(url, headers={
+            "Authorization": "Basic "
+            + base64.b64encode(b"alice:s3cret").decode()})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        bad = urllib.request.Request(url, headers={
+            "Authorization": "Basic "
+            + base64.b64encode(b"alice:wrong").decode()})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad)
+        assert ei.value.code == 401
+    finally:
+        s.stop()
+
+
+def test_ldap_failures_not_cached(ldap_server):
+    """A transient wrong-or-unreachable outcome must not poison later
+    correct logins; successes expire by TTL."""
+    a = A.LdapAuthenticator(
+        "127.0.0.1", ldap_server.port,
+        bind_template="uid={user},ou=people,dc=ex,dc=com",
+        cache_ttl=0.2)
+    assert not a.authenticate("alice", "wrong")
+    assert a.authenticate("alice", "s3cret")     # not blocked by failure
+    import time
+    time.sleep(0.25)
+    assert ("alice" not in {k[0] for k, e in a._cache.items()
+                            if e > time.monotonic()})
+    assert a.authenticate("alice", "s3cret")     # re-binds after expiry
+
+
+def test_crashing_custom_module_yields_401(monkeypatch):
+    mod = types.ModuleType("boom_auth_mod")
+
+    def boom(u, p):
+        raise RuntimeError("crafted input")
+    mod.authenticate = boom
+    sys.modules["boom_auth_mod"] = mod
+    monkeypatch.setenv("H2O3_TPU_API_AUTH_METHOD", "custom")
+    monkeypatch.setenv("H2O3_TPU_API_AUTH_MODULE", "boom_auth_mod")
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/3/Cloud", headers={
+                "Authorization": "Basic "
+                + base64.b64encode(b"x:y").decode()})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 401      # crash became a clean 401
+    finally:
+        s.stop()
+        del sys.modules["boom_auth_mod"]
+
+
+def test_explicit_creds_beat_configured_method(ldap_server, monkeypatch):
+    monkeypatch.setenv("H2O3_TPU_API_AUTH_METHOD", "ldap")
+    monkeypatch.setenv("H2O3_TPU_API_LDAP_HOST", "127.0.0.1")
+    monkeypatch.setenv("H2O3_TPU_API_LDAP_PORT", str(ldap_server.port))
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0, auth={"local": "pw"}).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/3/Cloud", headers={
+                "Authorization": "Basic "
+                + base64.b64encode(b"local:pw").decode()})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200       # basic creds honored, not LDAP
+    finally:
+        s.stop()

@@ -70,3 +70,69 @@ def test_avro_gated(tmp_path):
         fh.write(b"Obj\x01rest")
     with pytest.raises(RuntimeError, match="fastavro"):
         h2o3_tpu.import_file(p)
+
+
+def _write_xlsx(path, header, rows):
+    """Hand-roll a minimal xlsx (zip of XML parts) — no spreadsheet lib
+    ships in this image, which is exactly why the parser is stdlib-only."""
+    import zipfile as _zf
+
+    def ref(r, c):
+        s = ""
+        c += 1
+        while c:
+            c, rem = divmod(c - 1, 26)
+            s = chr(65 + rem) + s
+        return f"{s}{r + 1}"
+
+    strings = []
+
+    def cell(r, c, v):
+        if isinstance(v, str):
+            if v not in strings:
+                strings.append(v)
+            return (f'<c r="{ref(r, c)}" t="s">'
+                    f"<v>{strings.index(v)}</v></c>")
+        if v is None:
+            return f'<c r="{ref(r, c)}"/>'
+        return f'<c r="{ref(r, c)}"><v>{v}</v></c>'
+
+    body = []
+    for i, row in enumerate([header] + rows):
+        body.append(f'<row r="{i + 1}">' +
+                    "".join(cell(i, j, v) for j, v in enumerate(row)) +
+                    "</row>")
+    ns = 'xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main"'
+    sheet = (f'<?xml version="1.0"?><worksheet {ns}><sheetData>'
+             + "".join(body) + "</sheetData></worksheet>")
+    sst = (f'<?xml version="1.0"?><sst {ns}>'
+           + "".join(f"<si><t>{s}</t></si>" for s in strings) + "</sst>")
+    with _zf.ZipFile(path, "w") as z:
+        z.writestr("[Content_Types].xml", "<Types/>")
+        z.writestr("xl/workbook.xml", f"<workbook {ns}/>")
+        z.writestr("xl/worksheets/sheet1.xml", sheet)
+        z.writestr("xl/sharedStrings.xml", sst)
+
+
+def test_xlsx_parse(tmp_path):
+    """XLSX ingest (the reference's POI XlsParser capability, stdlib
+    rebuild): header, shared strings, numerics, blank → NA."""
+    p = str(tmp_path / "t.xlsx")
+    _write_xlsx(p, ["name", "score", "grade"],
+                [["alice", 1.5, "a"], ["bob", 2.5, "b"],
+                 ["cara", None, "a"]])
+    from h2o3_tpu.io.parser import import_file
+    fr = import_file(p)
+    assert list(fr.names) == ["name", "score", "grade"]
+    assert fr.nrows == 3
+    np.testing.assert_allclose(fr.vec("score").to_numpy(),
+                               [1.5, 2.5, np.nan], equal_nan=True)
+    assert sorted(fr.vec("grade").levels()) == ["a", "b"]
+
+
+def test_legacy_xls_rejected(tmp_path):
+    p = str(tmp_path / "t.xls")
+    open(p, "wb").write(b"\xd0\xcf\x11\xe0junk")
+    from h2o3_tpu.io.parser import import_file
+    with pytest.raises(NotImplementedError, match="xlsx"):
+        import_file(p)
