@@ -103,7 +103,9 @@ class _Handler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         ln = int(self.headers.get("Content-Length") or 0)
         if ln:
-            body = self.rfile.read(ln).decode()
+            # errors="replace": a stray binary body must yield a clean
+            # 4xx from the route, not an escaping UnicodeDecodeError
+            body = self.rfile.read(ln).decode(errors="replace")
             ctype = self.headers.get("Content-Type", "")
             if "json" in ctype:
                 q.update(json.loads(body))
@@ -137,7 +139,10 @@ class _Handler(BaseHTTPRequestHandler):
         # multi-controller runtime those launches must be collective too;
         # replaying an idempotent GET is free, deadlocking the cloud isn't.
         bc = getattr(self.server, "broadcaster", None)
-        if bc is not None and not _is_static_path(path):
+        if bc is not None and not _is_static_path(path) \
+                and not path.startswith("/3/PostFile"):
+            # PostFile is excluded: its body is raw (often binary) bytes
+            # that neither parse as params nor replay through the channel
             params = self._params()
             self._cached_params = params
             bc.broadcast(method, path, params)
@@ -209,11 +214,22 @@ def _h_parse(h: _Handler):
     if isinstance(src, str):
         src = json.loads(src) if src.startswith("[") else [src]
     path = src[0].strip('"')
+    # PostFile-staged uploads resolve their pseudo-key to the temp file,
+    # consumed (deleted) once the parse finishes
+    from h2o3_tpu.api import routes_ext3 as _up
+    upload_key = None
+    staged = _up.staged_upload_path(path)
+    if staged:
+        upload_key, path = path, staged
     dest = p.get("destination_frame") or None
     job = Job(description=f"Parse {path}", dest=dest or "parsed")
 
     def work(job):
-        f = io_parser.import_file(path, destination_frame=dest)
+        try:
+            f = io_parser.import_file(path, destination_frame=dest)
+        finally:
+            if upload_key is not None:
+                _up.consume_upload(upload_key)
         job.dest = f.key
         return f
 
@@ -569,6 +585,10 @@ ROUTES += _ext.build_routes()
 from h2o3_tpu.api import routes_ext2 as _ext2  # noqa: E402
 
 ROUTES += _ext2.build_routes()
+
+from h2o3_tpu.api import routes_ext3 as _ext3  # noqa: E402
+
+ROUTES += _ext3.build_routes()
 
 # Flow-lite UI (h2o-web analog) at / and /flow/index.html
 from h2o3_tpu.api import flow as _flow  # noqa: E402

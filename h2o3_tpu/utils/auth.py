@@ -136,6 +136,7 @@ class LdapAuthenticator:
                  bind_template: str = "uid={user}",
                  use_ssl: bool = False, timeout: float = 5.0,
                  cache_ttl: float = 300.0):
+        import threading
         self.host = host
         self.port = int(port)
         self.bind_template = bind_template
@@ -143,17 +144,34 @@ class LdapAuthenticator:
         self.timeout = timeout
         self.cache_ttl = float(cache_ttl)
         self._cache: dict = {}      # key -> expiry monotonic time
+        self._lock = threading.Lock()   # handlers run on server threads
+
+    @staticmethod
+    def _escape_dn(value: str) -> str:
+        """RFC 4514 attribute-value escaping: without it a username like
+        'x,ou=admins' would inject extra RDNs into the templated DN."""
+        out = []
+        for i, ch in enumerate(value):
+            if ch in ',+"\\<>;=' or (ch == "#" and i == 0) or \
+                    (ch == " " and i in (0, len(value) - 1)):
+                out.append("\\" + ch)
+            elif ord(ch) < 0x20:
+                out.append("\\%02x" % ord(ch))
+            else:
+                out.append(ch)
+        return "".join(out)
 
     def authenticate(self, user: str, password: str) -> bool:
         import time
         if not password:
             return False            # RFC 4513 §5.1.2: no unauthenticated bind
         key = (user, hashlib.sha256(password.encode()).hexdigest())
-        exp = self._cache.get(key)
         now = time.monotonic()
+        with self._lock:
+            exp = self._cache.get(key)
         if exp is not None and now < exp:
             return True
-        dn = self.bind_template.format(user=user)
+        dn = self.bind_template.format(user=self._escape_dn(user))
         try:
             sock = socket.create_connection((self.host, self.port),
                                             timeout=self.timeout)
@@ -169,12 +187,13 @@ class LdapAuthenticator:
         except (OSError, ValueError, IndexError):
             ok = False
         if ok:
-            if len(self._cache) >= self.CACHE_MAX:
-                self._cache = {k: e for k, e in self._cache.items()
-                               if e > now} or {}
-                while len(self._cache) >= self.CACHE_MAX:
-                    self._cache.pop(next(iter(self._cache)))
-            self._cache[key] = now + self.cache_ttl
+            with self._lock:
+                if len(self._cache) >= self.CACHE_MAX:
+                    self._cache = {k: e for k, e in self._cache.items()
+                                   if e > now}
+                    while len(self._cache) >= self.CACHE_MAX:
+                        self._cache.pop(next(iter(self._cache)))
+                self._cache[key] = now + self.cache_ttl
         return ok
 
 

@@ -206,3 +206,15 @@ def test_explicit_creds_beat_configured_method(ldap_server, monkeypatch):
             assert r.status == 200       # basic creds honored, not LDAP
     finally:
         s.stop()
+
+
+def test_ldap_dn_injection_escaped(ldap_server):
+    """A username carrying DN metacharacters must not splice extra RDNs
+    into the bind DN (RFC 4514 escaping)."""
+    a = A.LdapAuthenticator(
+        "127.0.0.1", ldap_server.port,
+        bind_template="uid={user},ou=people,dc=ex,dc=com")
+    # would bind as uid=alice + injected RDN without escaping; the fake
+    # directory only accepts the exact canonical DN, so this must FAIL
+    assert not a.authenticate("alice,ou=people,dc=ex,dc=com\\0", "s3cret")
+    assert a._escape_dn("a,b+c\"d") == 'a\\,b\\+c\\"d'

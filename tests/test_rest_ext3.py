@@ -1,0 +1,170 @@
+"""REST long-tail part 3 (api/routes_ext3.py): PostFile upload →
+parse, DCT transform, feature interactions, fairness metrics, Assembly
+pipelines, builder parameter schemas, aliases and loud-rejects."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api.server import H2OServer, ROUTES
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get(s, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{s.port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(s, path, **data):
+    body = urllib.parse.urlencode(data).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{s.port}{path}",
+                                 data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _wait(s, key, timeout=120):
+    import time
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        j = _get(s, f"/3/Jobs/{key}")["jobs"][0]
+        if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+            assert j["status"] == "DONE", j
+            return j
+        time.sleep(0.2)
+    raise TimeoutError
+
+
+def test_route_count_now_above_130(server):
+    assert len(ROUTES) >= 130, len(ROUTES)
+
+
+def test_postfile_upload_then_parse(server):
+    """The h2o.upload_file flow: raw body → staged key → /3/Parse."""
+    csv = b"x,y\n1,a\n2,b\n3,a\n"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/3/PostFile"
+        "?destination_frame=up1.csv",
+        data=csv, method="POST",
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    assert out["total_bytes"] == len(csv)
+    r = _post(server, "/3/Parse", source_frames="up1.csv",
+              destination_frame="up1")
+    _wait(server, r["job"]["key"])
+    f = DKV.get("up1")
+    assert f.nrows == 3 and sorted(f.vec("y").levels()) == ["a", "b"]
+    DKV.remove("up1")
+
+
+def test_postfile_multipart(server):
+    body = (b"--BOUND\r\nContent-Disposition: form-data; name=\"file\"; "
+            b"filename=\"t.csv\"\r\nContent-Type: text/csv\r\n\r\n"
+            b"a,b\n1,2\n" b"\r\n--BOUND--\r\n")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/3/PostFile?destination_frame=mp1",
+        data=body, method="POST",
+        headers={"Content-Type": "multipart/form-data; boundary=BOUND"})
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    from h2o3_tpu.api.routes_ext3 import staged_upload_path
+    staged = staged_upload_path("mp1")
+    assert open(staged, "rb").read() == b"a,b\n1,2\n"
+
+
+def test_dct_transform(server):
+    from scipy.fft import dct
+    rng = np.random.default_rng(4)
+    X = rng.normal(0, 1, (50, 8))
+    f = Frame.from_dict({f"c{j}": X[:, j] for j in range(8)}, key="dctf")
+    DKV.put("dctf", f)
+    r = _post(server, "/3/DCTTransformer", dataset="dctf", destination_frame="dcto")
+    out = DKV.get("dcto")
+    got = np.column_stack([out.vec(c).to_numpy() for c in out.names])
+    np.testing.assert_allclose(got, dct(X, axis=1, norm="ortho"),
+                               rtol=1e-4, atol=1e-5)
+    DKV.remove("dctf")
+    DKV.remove("dcto")
+
+
+@pytest.fixture()
+def gbm_model(server):
+    rng = np.random.default_rng(6)
+    n = 300
+    X = rng.normal(0, 1, (n, 4))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(int)   # interaction signal
+    g = np.asarray(["m", "f"], object)[rng.integers(0, 2, n)]
+    f = Frame.from_dict({**{f"x{j}": X[:, j] for j in range(4)},
+                         "g": g,
+                         "y": np.asarray(["n", "p"], object)[y]},
+                        key="fi_f")
+    DKV.put("fi_f", f)
+    import h2o3_tpu.models as M
+    m = M.H2OGradientBoostingEstimator(ntrees=10, max_depth=4, seed=1,
+                                       model_id="fi_m")
+    m.train(x=[f"x{j}" for j in range(4)], y="y", training_frame=f)
+    yield m
+    DKV.remove("fi_f")
+    DKV.remove("fi_m")
+
+
+def test_feature_interaction(server, gbm_model):
+    r = _post(server, "/3/FeatureInteraction", model="fi_m")
+    rows = r["feature_interaction"]
+    assert rows and all("|" in row["feature_pair"] for row in rows)
+    # the XOR signal makes x0|x1 (either order) a top pair
+    top = {row["feature_pair"] for row in rows[:4]}
+    assert top & {"x0|x1", "x1|x0"}, rows[:4]
+
+
+def test_fairness_metrics(server, gbm_model):
+    r = _post(server, "/99/FairnessMetrics", model="fi_m", frame="fi_f",
+              protected_columns=json.dumps(["g"]))
+    gs = r["groups"]
+    assert set(gs) == {"g.m", "g.f"}
+    for row in gs.values():
+        assert 0.0 <= row["selection_rate"] <= 1.0
+        assert row["n"] > 50
+    assert r["reference_group"] in gs
+    assert any(abs(row["air"] - 1.0) < 1.0 for row in gs.values())
+
+
+def test_assembly_pipeline(server):
+    f = Frame.from_dict({"a": np.arange(6.0)}, key="asmf")
+    DKV.put("asmf", f)
+    steps = ["(tmp= asm_t1 (* {frame} 2))",
+             "(tmp= asm_t2 (+ {frame} 1))"]
+    r = _post(server, "/99/Assembly", frame="asmf",
+              steps=json.dumps(steps), dest="asm_out")
+    out = DKV.get("asm_out")
+    np.testing.assert_allclose(out.vecs[0].to_numpy(),
+                               np.arange(6.0) * 2 + 1)
+    DKV.remove("asmf")
+    DKV.remove("asm_out")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/99/Assembly.java/x/y")
+    assert ei.value.code == 501
+
+
+def test_builder_params_schema_and_aliases(server):
+    ps = _get(server, "/3/ModelBuilders/gbm/parameters")["parameters"]
+    names = {p["name"] for p in ps}
+    assert {"ntrees", "max_depth", "learn_rate"} <= names
+    assert _get(server, "/99/Ping")["status"] == "running"
+    assert _get(server, "/3/SteamMetrics")["healthy"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server, "/3/scalaint", code="1+1")
+    assert ei.value.code == 501
