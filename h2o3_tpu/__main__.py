@@ -1,0 +1,74 @@
+"""`python -m h2o3_tpu` — the `java -jar h2o.jar` analog.
+
+Parses the OptArgs-style CLI (water/H2O.java:327: -port, -name, -ip,
+-basic_auth/-hash_login file, -ssl, -nthreads …), forms the cloud (one
+host or a jax.distributed multi-host launch via deploy/multihost env
+vars), and serves REST + Flow until interrupted."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="h2o3-tpu",
+        description="Start an h2o3-tpu node (REST + Flow on one host; "
+                    "multi-host when H2O3_COORDINATOR_ADDRESS is set)")
+    ap.add_argument("-port", "--port", type=int, default=54321)
+    ap.add_argument("-ip", "--ip", default=None,
+                    help="bind address (default loopback; 0.0.0.0 when "
+                         "-bind_all)")
+    ap.add_argument("-name", "--name", default=None,
+                    help="cloud name (water.H2O -name)")
+    ap.add_argument("-bind_all", action="store_true",
+                    help="listen on every interface (requires auth or "
+                         "H2O3_INSECURE_BIND_ALL=1)")
+    ap.add_argument("-basic_auth", "--auth_file", default=None,
+                    help="user:password lines file (-hash_login analog)")
+    ap.add_argument("-ssl_cert", default=None)
+    ap.add_argument("-ssl_key", default=None)
+    ap.add_argument("-n_rows_shards", type=int, default=None,
+                    help="mesh rows axis (default: all devices)")
+    ap.add_argument("-n_model_shards", type=int, default=1)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from h2o3_tpu.utils import config as _cfg
+    if args.name:
+        _cfg.set_property("cloud.name", args.name)
+    if args.bind_all:
+        _cfg.set_property("api.bind_all", True)
+    if args.auth_file:
+        _cfg.set_property("api.auth_file", args.auth_file)
+    if args.ssl_cert:
+        _cfg.set_property("api.ssl_cert", args.ssl_cert)
+    if args.ssl_key:
+        _cfg.set_property("api.ssl_key", args.ssl_key)
+
+    from h2o3_tpu.deploy import multihost
+    if multihost.is_multihost():
+        multihost.serve(args.port, n_rows_shards=args.n_rows_shards,
+                        n_model_shards=args.n_model_shards)
+        return 0
+
+    import h2o3_tpu
+    cloud = h2o3_tpu.init(n_rows_shards=args.n_rows_shards,
+                          n_model_shards=args.n_model_shards)
+    from h2o3_tpu.api.server import H2OServer
+    srv = H2OServer(args.port, host=args.ip)
+    print(f"h2o3-tpu cloud up: {cloud.n_devices} device shard(s); "
+          f"REST + Flow on :{srv.port}")
+    try:
+        srv.start(background=False)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

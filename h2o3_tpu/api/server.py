@@ -623,6 +623,22 @@ class H2OServer:
         if host is None:
             host = "0.0.0.0" if _cfg.get_bool("api.bind_all") \
                 else "127.0.0.1"
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            # binding beyond loopback without credentials exposes the
+            # whole modeling surface; require auth unless explicitly
+            # waived (the reference's -hash_login posture)
+            import os as _os
+            has_auth = (auth
+                        or _cfg.get_property("api.auth_file", None)
+                        or str(_cfg.get_property("api.auth_method", "")
+                               or "").lower() in ("ldap", "custom"))
+            if not has_auth and \
+                    _os.environ.get("H2O3_INSECURE_BIND_ALL") != "1":
+                raise RuntimeError(
+                    f"refusing to bind {host} without authentication: "
+                    "configure -basic_auth/ai.h2o.api.auth_file, "
+                    "api.auth_method=ldap|custom, or set "
+                    "H2O3_INSECURE_BIND_ALL=1 to waive")
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         auth = auth if auth is not None else \
             _cfg.get_property("api.auth_file", None)

@@ -268,29 +268,20 @@ def worker_loop(coordinator_host: str, port: int):
             traceback.print_exc()
 
 
-def serve(port: int = 54321):
+def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
     """Container entrypoint: bootstrap the (possibly multi-host) cloud;
     process 0 serves REST and broadcasts mutating requests, workers replay
     them so every host issues the same device programs."""
     import jax
-    cloud = bootstrap()
+    cloud = bootstrap(n_rows_shards=n_rows_shards,
+                      n_model_shards=n_model_shards)
     nproc = jax.process_count()
     bport = port + _BCAST_PORT_OFFSET
     if jax.process_index() == 0:
         from h2o3_tpu.api.server import H2OServer
         from h2o3_tpu.utils import config as _cfg
         _cfg.set_property("api.bind_all", True)
-        # Binding 0.0.0.0 without credentials exposes the whole modeling
-        # surface to the pod network; require auth unless explicitly waived
-        # (mirrors the reference's -disable_web/-hash_login posture).
-        has_auth = (_cfg.get_property("api.auth_file", None)
-                    or str(_cfg.get_property("api.auth_method", "")
-                           or "").lower() in ("ldap", "custom"))
-        if not has_auth and os.environ.get("H2O3_INSECURE_BIND_ALL") != "1":
-            raise RuntimeError(
-                "serve() binds 0.0.0.0: configure ai.h2o.api.auth_file "
-                "(Basic auth) / api.auth_method=ldap|custom, or set "
-                "H2O3_INSECURE_BIND_ALL=1 to waive")
+        # H2OServer enforces the bind-all-requires-auth posture itself
         srv = H2OServer(port)
         if nproc > 1:
             srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)

@@ -90,9 +90,14 @@ class Cloud:
 
 
 def init(n_rows_shards: int | None = None, n_model_shards: int = 1,
-         devices=None, name: str = "h2o3-tpu") -> Cloud:
-    """Form the cloud (h2o.init analog). Idempotent unless shape changes."""
+         devices=None, name: str | None = None) -> Cloud:
+    """Form the cloud (h2o.init analog). Idempotent unless shape changes.
+    Name: explicit arg > ai.h2o.cloud.name property (-name flag) >
+    default."""
     global _CLOUD
+    if name is None:
+        from h2o3_tpu.utils import config as _cfg
+        name = str(_cfg.get_property("cloud.name", None) or "h2o3-tpu")
     with _lock:
         devices = list(devices if devices is not None else jax.devices())
         total = len(devices)
