@@ -1,0 +1,248 @@
+"""Acceptance battery V: training-parameter semantics on real data
+(testdir_algos parameter behaviors: seeds, weights, offsets, folds,
+runtime caps, missing handling, shrinkage, families)."""
+
+import numpy as np
+import pytest
+
+import h2o3_tpu.models as models
+from h2o3_tpu.core.frame import Frame
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def bc_xy():
+    from sklearn.datasets import load_breast_cancer
+    d = load_breast_cancer()
+    X = d.data[:, :8]
+    cols = {f"x{j}": X[:, j] for j in range(X.shape[1])}
+    cols["y"] = np.asarray(["m", "b"], object)[d.target]
+    return Frame.from_dict(cols), [f"x{j}" for j in range(X.shape[1])]
+
+
+@pytest.fixture(scope="module")
+def diab_xy():
+    from sklearn.datasets import load_diabetes
+    d = load_diabetes()
+    cols = {f"x{j}": d.data[:, j] for j in range(d.data.shape[1])}
+    cols["y"] = d.target
+    return Frame.from_dict(cols), [f"x{j}" for j in range(d.data.shape[1])]
+
+
+# ---- seed reproducibility ---------------------------------------------------
+@pytest.mark.parametrize("cls,kw", [
+    (lambda: models.H2OGradientBoostingEstimator, dict(ntrees=8, max_depth=3)),
+    (lambda: models.H2ORandomForestEstimator, dict(ntrees=8, max_depth=4)),
+    (lambda: models.H2OXGBoostEstimator, dict(ntrees=8, max_depth=3)),
+])
+def test_seed_reproducibility(bc_xy, cls, kw):
+    f, xs = bc_xy
+    p = []
+    for seed in (7, 7, 8):
+        m = cls()(seed=seed, **kw)
+        m.train(x=xs, y="y", training_frame=f)
+        p.append(m.predict(f).vecs[-1].to_numpy())
+    np.testing.assert_allclose(p[0], p[1])           # same seed: identical
+    assert not np.allclose(p[0], p[2])               # different seed: differs
+
+
+# ---- weights ---------------------------------------------------------------
+def test_glm_zero_weights_exclude_rows(diab_xy):
+    f, xs = diab_xy
+    n = f.nrows
+    w = np.ones(n)
+    w[n // 2:] = 0.0
+    fw = Frame.from_dict({**{c: f.vec(c).to_numpy() for c in f.names},
+                          "w": w})
+    half = Frame.from_dict({c: f.vec(c).to_numpy()[: n // 2]
+                            for c in f.names})
+    m1 = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_=0.0, standardize=False,
+        weights_column="w")
+    m1.train(x=xs, y="y", training_frame=fw)
+    m2 = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_=0.0, standardize=False)
+    m2.train(x=xs, y="y", training_frame=half)
+    for c in xs:
+        assert abs(m1.coef()[c] - m2.coef()[c]) < 1e-2 * max(
+            1.0, abs(m2.coef()[c])), c
+
+
+def test_gbm_weights_tilt_predictions(bc_xy):
+    f, xs = bc_xy
+    yv = f.vec("y").to_numpy()
+    w = np.where(yv == 1.0, 10.0, 1.0)   # upweight one class heavily
+    fw = Frame.from_dict({**{c: (f.vec(c).to_numpy() if f.vec(c).type
+                                 != "enum" else np.asarray(
+                                     f.vec(c).levels(), object)[
+                                     f.vec(c).to_numpy().astype(int)])
+                             for c in f.names}, "w": w})
+    plain = models.H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                                seed=1)
+    plain.train(x=xs, y="y", training_frame=f)
+    tilt = models.H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                               seed=1, weights_column="w")
+    tilt.train(x=xs, y="y", training_frame=fw)
+    p0 = plain.predict(f).vecs[-1].to_numpy().mean()
+    p1 = tilt.predict(fw).vecs[-1].to_numpy().mean()
+    assert p1 > p0 + 0.02                # upweighted class raises base rate
+
+
+# ---- offset ----------------------------------------------------------------
+def test_glm_offset_shifts_intercept(diab_xy):
+    f, xs = diab_xy
+    off = np.full(f.nrows, 25.0)
+    fo = Frame.from_dict({**{c: f.vec(c).to_numpy() for c in f.names},
+                          "off": off})
+    m0 = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_=0.0, standardize=False)
+    m0.train(x=xs, y="y", training_frame=f)
+    m1 = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_=0.0, standardize=False,
+        offset_column="off")
+    m1.train(x=xs, y="y", training_frame=fo)
+    # identity link: fixed offset is absorbed entirely by the intercept
+    assert abs((m0.coef()["Intercept"] - m1.coef()["Intercept"]) - 25.0) \
+        < 0.5
+    for c in xs[:3]:
+        assert abs(m0.coef()[c] - m1.coef()[c]) < 1e-2 * max(
+            1.0, abs(m0.coef()[c]))
+
+
+# ---- folds / CV ------------------------------------------------------------
+def test_fold_column_respected(bc_xy):
+    f, xs = bc_xy
+    rng = np.random.default_rng(3)
+    folds = rng.integers(0, 3, f.nrows).astype(float)
+    ff = Frame.from_dict({**{c: (f.vec(c).to_numpy() if f.vec(c).type
+                                 != "enum" else np.asarray(
+                                     f.vec(c).levels(), object)[
+                                     f.vec(c).to_numpy().astype(int)])
+                             for c in f.names}, "fold": folds})
+    m = models.H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=1,
+                                            fold_column="fold")
+    m.train(x=xs, y="y", training_frame=ff)
+    cv = m._output.cross_validation_metrics
+    assert cv is not None and 0.5 < cv.auc <= 1.0
+
+
+def test_nfolds_cv_metrics(diab_xy):
+    f, xs = diab_xy
+    m = models.H2OGradientBoostingEstimator(ntrees=8, max_depth=3, seed=1,
+                                            nfolds=3)
+    m.train(x=xs, y="y", training_frame=f)
+    cv = m._output.cross_validation_metrics
+    tr = m._output.training_metrics
+    assert cv is not None and cv.rmse >= tr.rmse * 0.9
+
+
+# ---- runtime cap -----------------------------------------------------------
+def test_max_runtime_secs_stops_early(bc_xy):
+    f, xs = bc_xy
+    m = models.H2OGradientBoostingEstimator(ntrees=5000, max_depth=5,
+                                            seed=1, max_runtime_secs=3.0)
+    m.train(x=xs, y="y", training_frame=f)
+    assert m._trees.ntrees < 5000
+
+
+# ---- missing values --------------------------------------------------------
+@pytest.mark.parametrize("mode", ["MeanImputation", "Skip"])
+def test_glm_missing_handling(diab_xy, mode):
+    f, xs = diab_xy
+    cols = {c: f.vec(c).to_numpy().copy() for c in f.names}
+    cols["x0"][:40] = np.nan
+    fm = Frame.from_dict(cols)
+    m = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_=0.0,
+        missing_values_handling=mode)
+    m.train(x=xs, y="y", training_frame=fm)
+    assert np.isfinite(m.coef()["x0"])
+
+
+# ---- shrinkage / structure --------------------------------------------------
+def test_gbm_learn_rate_shrinks_step(bc_xy):
+    f, xs = bc_xy
+    aucs = {}
+    for lr in (0.02, 0.3):
+        m = models.H2OGradientBoostingEstimator(ntrees=5, max_depth=3,
+                                                seed=1, learn_rate=lr)
+        m.train(x=xs, y="y", training_frame=f)
+        aucs[lr] = m._output.training_metrics.auc
+    # at few trees the big step fits train data harder
+    assert aucs[0.3] > aucs[0.02]
+
+
+def test_drf_mtries_changes_forest(bc_xy):
+    f, xs = bc_xy
+    preds = {}
+    for mt in (1, len(xs)):
+        m = models.H2ORandomForestEstimator(ntrees=8, max_depth=4, seed=1,
+                                            mtries=mt)
+        m.train(x=xs, y="y", training_frame=f)
+        preds[mt] = m.predict(f).vecs[-1].to_numpy()
+    assert not np.allclose(preds[1], preds[len(xs)])
+
+
+def test_glm_lambda_search_path_monotone(diab_xy):
+    f, xs = diab_xy
+    m = models.H2OGeneralizedLinearEstimator(
+        family="gaussian", lambda_search=True, nlambdas=12, alpha=1.0)
+    m.train(x=xs, y="y", training_frame=f)
+    lams = [lam for lam, _ in m._lambda_path]
+    assert all(lams[i] >= lams[i + 1] for i in range(len(lams) - 1))
+    nz = [int((np.abs(beta[:-1]) > 1e-8).sum())
+          for _, beta in m._lambda_path]
+    assert nz[0] <= nz[-1]             # support grows as lambda shrinks
+
+
+# ---- GLM families on real/structured data ----------------------------------
+@pytest.mark.parametrize("family,link", [
+    ("gaussian", "identity"), ("poisson", "log"),
+    ("gamma", "log"), ("tweedie", None)])
+def test_glm_families_fit_finite(family, link):
+    rng = np.random.default_rng(13)
+    n = 400
+    x = rng.normal(0, 0.5, n)
+    mu = np.exp(0.4 * x + 1.0)
+    y = {"gaussian": mu + rng.normal(0, 0.3, n),
+         "poisson": rng.poisson(mu).astype(float),
+         "gamma": rng.gamma(2.0, mu / 2.0),
+         "tweedie": np.where(rng.random(n) < 0.3, 0.0,
+                             rng.gamma(2.0, mu / 2.0))}[family]
+    f = Frame.from_dict({"x": x, "y": y})
+    kw = dict(family=family, lambda_=0.0)
+    if link:
+        kw["link"] = link
+    if family == "tweedie":
+        kw["tweedie_variance_power"] = 1.5
+    m = models.H2OGeneralizedLinearEstimator(**kw)
+    m.train(x=["x"], y="y", training_frame=f)
+    c = m.coef()
+    assert np.isfinite(c["x"]) and np.isfinite(c["Intercept"])
+    if family != "gaussian":
+        assert 0.2 < c["x"] < 0.7      # recovers the log-scale slope
+
+
+# ---- GBM distributions ------------------------------------------------------
+@pytest.mark.parametrize("dist", ["gaussian", "poisson", "gamma",
+                                  "tweedie"])
+def test_gbm_distributions_train(dist):
+    rng = np.random.default_rng(17)
+    n = 400
+    x = rng.normal(0, 1, n)
+    mu = np.exp(0.5 * x)
+    y = {"gaussian": mu + rng.normal(0, 0.2, n),
+         "poisson": rng.poisson(mu).astype(float),
+         "gamma": rng.gamma(2.0, mu / 2.0),
+         "tweedie": np.where(rng.random(n) < 0.4, 0.0,
+                             rng.gamma(2.0, mu / 2.0))}[dist]
+    f = Frame.from_dict({"x": x, "y": y})
+    m = models.H2OGradientBoostingEstimator(ntrees=10, max_depth=3,
+                                            seed=1, distribution=dist)
+    m.train(x=["x"], y="y", training_frame=f)
+    pred = m.predict(f).vecs[-1].to_numpy()
+    assert np.all(np.isfinite(pred))
+    if dist != "gaussian":
+        assert np.all(pred >= 0)       # log-link predictions
+    assert np.corrcoef(pred, mu)[0, 1] > 0.7
