@@ -22,7 +22,9 @@ def _h_post_file(h):
     """POST /3/PostFile (PostFileHandler): upload a file body and stage it
     server-side; h2o.upload_file then parses the staged key. Accepts raw
     bodies and single-part multipart/form-data."""
-    if getattr(h, "_cached_params", None) is not None:
+    if getattr(getattr(h, "server", None), "broadcaster", None) is not None:
+        # multi-host cloud: the body would stage on this process only and
+        # the later broadcast /3/Parse would diverge across workers
         return h._error(
             "PostFile bodies cannot ride the SPMD replay channel; "
             "stage files on shared storage and use ImportFiles", 501)
@@ -217,10 +219,15 @@ def _h_assembly(h):
             inter.append(cur.key)     # superseded intermediate
         cur = out                     # rapids already registered its key
     dest = p.get("dest") or DKV.make_key("assembly")
-    if cur is not f:
+    if cur is f:
+        # identity pipeline: register a fresh handle under dest instead of
+        # stealing the source frame's key (the old DKV binding would still
+        # point at the re-keyed object)
+        cur = Frame(list(f.names), list(f.vecs), key=dest)
+    else:
         DKV.remove(cur.key)           # re-key the final frame cleanly
-    cur.key = dest
-    DKV.put(dest, cur)
+        cur.key = dest
+        DKV.put(dest, cur)
     for k in inter:                   # drop step intermediates
         DKV.remove(k)
     aid = p.get("assembly_id") or DKV.make_key("assembly_def")

@@ -199,13 +199,27 @@ def _h_parse_setup(h: _Handler):
     if isinstance(src, str):
         src = json.loads(src) if src.startswith("[") else [src]
     path = src[0].strip('"')
-    s = io_parser.parse_setup(path)
+    # PostFile-staged uploads: the h2o-py upload flow calls ParseSetup on
+    # the pseudo-key returned by /3/PostFile before /3/Parse
+    from h2o3_tpu.api import routes_ext3 as _up
+    staged = _up.staged_upload_path(path)
+    probe = staged or path
+    s = io_parser.parse_setup(probe)
     h._send({"__meta": {"schema_type": "ParseSetupV3"},
              "source_frames": src,
              "separator": ord(s.separator), "check_header": 1 if s.header else -1,
              "column_names": s.column_names, "column_types": s.column_types,
              "parse_type": s.parse_type,
              "destination_frame": path.split("/")[-1] + ".hex"})
+
+
+def _canon_col_types(ct: dict) -> dict:
+    """Map ParseV3 type names (Vec.java TYPE_STR values) to internal codes."""
+    alias = {"numeric": "num", "real": "num", "int": "num", "float": "num",
+             "enum": "enum", "categorical": "enum", "factor": "enum",
+             "string": "str", "str": "str", "time": "time",
+             "uuid": "uuid", "num": "num"}
+    return {k: alias.get(str(v).lower(), v) for k, v in ct.items()}
 
 
 def _h_parse(h: _Handler):
@@ -222,11 +236,25 @@ def _h_parse(h: _Handler):
     if staged:
         upload_key, path = path, staged
     dest = p.get("destination_frame") or None
+    # ParseV3 column_types: either a dict {name: type} or the reference's
+    # list aligned with ParseSetup's column order
+    ctypes = p.get("column_types")
+    if isinstance(ctypes, str) and ctypes:
+        ctypes = json.loads(ctypes)
+    if isinstance(ctypes, list):
+        names = p.get("column_names")
+        if isinstance(names, str) and names:
+            names = json.loads(names)
+        if not names:
+            names = io_parser.parse_setup(path).column_names
+        ctypes = {n: t for n, t in zip(names, ctypes) if t}
+    ctypes = _canon_col_types(ctypes) if ctypes else None
     job = Job(description=f"Parse {path}", dest=dest or "parsed")
 
     def work(job):
         try:
-            f = io_parser.import_file(path, destination_frame=dest)
+            f = io_parser.import_file(path, destination_frame=dest,
+                                      col_types=ctypes)
         finally:
             if upload_key is not None:
                 _up.consume_upload(upload_key)

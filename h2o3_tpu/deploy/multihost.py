@@ -15,6 +15,7 @@ host it is a no-op, so the same entrypoint serves laptops and v5p-32 pods.
 
 from __future__ import annotations
 
+import io
 import os
 
 
@@ -140,12 +141,17 @@ def _session_key(secret: bytes, nonce_c: str, nonce_w: str) -> bytes:
 
 
 class _ReplayHandler:
-    """Duck-typed stand-in for the HTTP handler: routes need only
-    _params/_send/_error (+ raw send for byte routes, unused in replay)."""
+    """Duck-typed stand-in for the HTTP handler. Routes need
+    _params/_send/_error; byte-streaming routes (DownloadDataset, mojo /
+    POJO downloads) additionally drive the raw http.server surface, so
+    those are no-ops writing to a sink — on workers the device readback
+    is the collective part, the bytes only matter on process 0."""
 
     def __init__(self, params):
         self._p = dict(params)
         self.out = None
+        self.wfile = io.BytesIO()
+        self.headers: dict = {}
 
     def _params(self):
         return dict(self._p)
@@ -155,6 +161,15 @@ class _ReplayHandler:
 
     def _error(self, msg, code=400):
         self.out = {"error": str(msg), "code": code}
+
+    def send_response(self, code):
+        pass
+
+    def send_header(self, k, v):
+        pass
+
+    def end_headers(self):
+        pass
 
 
 def replay_request(method: str, path: str, params: dict):

@@ -40,8 +40,8 @@ from typing import Optional, Sequence
 import numpy as np
 
 from h2o3_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
-from h2o3_tpu.io.parser import (NA_TOKENS, ParseSetup, _parse_time_ms,
-                                parse_setup)
+from h2o3_tpu.io.parser import (NA_TOKENS, ParseSetup, _num_token,
+                                _parse_time_ms, parse_setup)
 
 DEFAULT_CHUNK_BYTES = 64 << 20
 
@@ -145,8 +145,8 @@ def _chunk_tokens(num: np.ndarray, smap: dict) -> np.ndarray:
     (numeric-looking tokens came through as doubles)."""
     toks = np.empty(len(num), object)
     nn = ~np.isnan(num)
-    # %g matches the tokenizer's strtod round-trip for numeric-looking cats
-    toks[nn] = [("%g" % v) for v in num[nn]]
+    # shortest round-trip reconstruction — '%g' truncated long numeric IDs
+    toks[nn] = [_num_token(v) for v in num[nn]]
     for i, s in smap.items():
         toks[i] = s
     return toks

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import gzip
 import io
+import math
 import os
 import zipfile
 from dataclasses import dataclass, field
@@ -53,6 +54,19 @@ def _open_text(path: str) -> io.TextIOBase:
         inner = zf.namelist()[0]
         return io.TextIOWrapper(zf.open(inner), encoding="utf-8", newline="")
     return open(path, "r", encoding="utf-8", newline="")
+
+
+def _num_token(v: float) -> str:
+    """Reconstruct the source token of a numeric-looking cat/str value.
+    Shortest round-trip formatting: integral doubles print without a
+    trailing '.0' (matching tokens like '1234567' or zip+4 codes) and
+    distinct doubles never collide — unlike '%g', whose 6-sig-digit
+    truncation folded '1234567' and '1234567.4' into one level."""
+    v = float(v)
+    if math.isfinite(v) and v == int(v) and abs(v) < 2 ** 53 \
+            and not (v == 0.0 and math.copysign(1.0, v) < 0):
+        return str(int(v))
+    return repr(v)
 
 
 def _is_num(tok: str) -> bool:
@@ -222,7 +236,7 @@ def _native_parse(path: str, setup: ParseSetup, dest, col_types):
             toks = np.empty(len(num), object)
             isnan = np.isnan(num)
             for i in range(len(num)):
-                toks[i] = None if isnan[i] else ("%g" % num[i])
+                toks[i] = None if isnan[i] else _num_token(num[i])
             for i, s in smap.items():
                 toks[i] = s
             vecs.append(Vec.from_numpy(toks, type=T_STR if t == T_STR else None))

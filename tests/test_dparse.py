@@ -107,6 +107,27 @@ def test_python_fallback_range_contract(tmp_path):
     np.testing.assert_allclose(got, np.arange(100))
 
 
+def test_long_numeric_tokens_survive_cat_reconstruction(tmp_path):
+    """Long numeric IDs / zip+4 codes in a categorical column must keep
+    their exact digits: '%g' 6-sig-digit reconstruction folded '1234567'
+    and '1234567.4' into one '1.23457e+06' level (ADVICE r4)."""
+    p = str(tmp_path / "ids.csv")
+    with open(p, "w") as f:
+        f.write("id,tag\n")
+        for i in range(30):
+            f.write(f"{1234560 + i},x\n")
+        f.write("1234567.4,x\n")
+        f.write("Infinity,x\n")          # float()-accepted, not an NA token
+    for fr in (dparse.parse_files([p], chunk_bytes=64,
+                                  col_types={"id": T_CAT}),
+               parse(p, col_types={"id": T_CAT})):
+        lv = set(fr.vec("id").levels())
+        assert "1234567" in lv and "1234567.4" in lv, sorted(lv)[:5]
+        assert "1.23457e+06" not in lv
+        assert "inf" in lv
+        assert len(lv) == 32
+
+
 @pytest.mark.slow
 def test_ingest_throughput_multichunk(tmp_path):
     """Honest throughput record: chunked parse of a larger file; the 10x

@@ -70,6 +70,44 @@ def test_postfile_upload_then_parse(server):
     DKV.remove("up1")
 
 
+def test_postfile_parsesetup_then_parse(server):
+    """The FULL h2o-py upload protocol: PostFile → ParseSetup on the
+    staged pseudo-key → Parse (ParseSetup must resolve the staged temp
+    file, not 500 on the unresolvable key)."""
+    csv = b"x,y\n1,a\n2,b\n3,a\n"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/3/PostFile"
+        "?destination_frame=up2.csv",
+        data=csv, method="POST",
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req) as r:
+        json.loads(r.read())
+    s = _post(server, "/3/ParseSetup", source_frames='["up2.csv"]')
+    assert s["column_names"] == ["x", "y"]
+    r = _post(server, "/3/Parse", source_frames="up2.csv",
+              destination_frame="up2")
+    _wait(server, r["job"]["key"])
+    f = DKV.get("up2")
+    assert f.nrows == 3
+    DKV.remove("up2")
+
+
+def test_assembly_identity_steps_no_key_alias(server):
+    """An empty steps list must register a FRESH frame under dest, not
+    steal the source frame's key (routes_ext3 aliasing fix)."""
+    f = Frame.from_dict({"a": np.arange(4.0)}, key="asmid")
+    DKV.put("asmid", f)
+    _post(server, "/99/Assembly", frame="asmid", steps="[]",
+          dest="asmid_out")
+    src = DKV.get("asmid")
+    out = DKV.get("asmid_out")
+    assert src is not None and src.key == "asmid"
+    assert out is not None and out.key == "asmid_out" and out is not src
+    np.testing.assert_allclose(out.vecs[0].to_numpy(), np.arange(4.0))
+    DKV.remove("asmid")
+    DKV.remove("asmid_out")
+
+
 def test_postfile_multipart(server):
     body = (b"--BOUND\r\nContent-Disposition: form-data; name=\"file\"; "
             b"filename=\"t.csv\"\r\nContent-Type: text/csv\r\n\r\n"
