@@ -25,6 +25,9 @@ from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
 from h2o3_tpu.models.tree.xgboost import H2OXGBoostEstimator
 from h2o3_tpu.models.infogram import H2OInfogram
 
+# generated parameter docs (h2o-bindings gen_python.py docstring surface)
+from h2o3_tpu.models.param_docs import document as _document
+
 ESTIMATORS = {
     "kmeans": H2OKMeansEstimator,
     "glm": H2OGeneralizedLinearEstimator,
@@ -48,3 +51,6 @@ ESTIMATORS = {
     "psvm": H2OSupportVectorMachineEstimator,
     "xgboost": H2OXGBoostEstimator,
 }
+
+for _cls in set(ESTIMATORS.values()):
+    _document(_cls)

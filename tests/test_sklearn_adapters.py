@@ -1,0 +1,131 @@
+"""sklearn adapter layer (h2o3_tpu/sklearn) — the reference exposes every
+algo as sklearn-compatible Classifier/Regressor/Estimator wrappers
+(h2o-py/h2o/sklearn/__init__.py) usable inside Pipeline / GridSearchCV.
+These tests drive exactly that contract against the native estimators."""
+
+import numpy as np
+import pytest
+from sklearn.base import clone
+from sklearn.datasets import make_classification, make_regression
+from sklearn.model_selection import GridSearchCV, cross_val_score
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import StandardScaler
+
+import h2o3_tpu.sklearn as hsk
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    return make_classification(n_samples=200, n_features=6, n_informative=4,
+                               random_state=7)
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    return make_regression(n_samples=200, n_features=6, noise=5.0,
+                           random_state=7)
+
+
+def test_classifier_fit_predict_proba(clf_data):
+    X, y = clf_data
+    clf = hsk.H2OGradientBoostingClassifier(ntrees=10, max_depth=3, seed=42)
+    clf.fit(X, y)
+    pred = clf.predict(X)
+    assert pred.shape == (200,)
+    assert set(np.unique(pred)) <= set(clf.classes_)
+    assert (pred == y).mean() > 0.85
+    proba = clf.predict_proba(X)
+    assert proba.shape == (200, 2)
+    np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+    # proba column order matches classes_: argmax must reproduce predict
+    assert (clf.classes_[np.argmax(proba, 1)] == pred).mean() > 0.99
+
+
+def test_classifier_nonnumeric_labels(clf_data):
+    X, y = clf_data
+    labels = np.array(["neg", "pos"])[y]
+    clf = hsk.H2ORandomForestClassifier(ntrees=10, max_depth=4, seed=1)
+    clf.fit(X, labels)
+    assert set(clf.classes_) == {"neg", "pos"}
+    assert set(np.unique(clf.predict(X))) <= {"neg", "pos"}
+
+
+def test_regressor_score_r2(reg_data):
+    X, y = reg_data
+    reg = hsk.H2OGradientBoostingRegressor(ntrees=20, max_depth=4, seed=3)
+    reg.fit(X, y)
+    assert reg.score(X, y) > 0.7        # RegressorMixin r2
+
+
+def test_clone_and_params(clf_data):
+    clf = hsk.H2OGradientBoostingClassifier(ntrees=7, max_depth=2)
+    assert clf.get_params()["ntrees"] == 7
+    c2 = clone(clf)
+    assert c2.get_params()["ntrees"] == 7
+    c2.set_params(max_depth=5)
+    assert c2.get_params()["max_depth"] == 5
+    with pytest.raises(ValueError):
+        c2.set_params(not_a_param=1)
+
+
+def test_pipeline_gridsearch(clf_data):
+    X, y = clf_data
+    pipe = Pipeline([
+        ("scale", StandardScaler()),
+        ("gbm", hsk.H2OGradientBoostingClassifier(ntrees=5, seed=11)),
+    ])
+    gs = GridSearchCV(pipe, {"gbm__max_depth": [2, 4]}, cv=2, n_jobs=1)
+    gs.fit(X, y)
+    assert gs.best_params_["gbm__max_depth"] in (2, 4)
+    assert gs.best_score_ > 0.7
+    assert gs.predict(X).shape == (200,)
+
+
+def test_cross_val_glm(reg_data):
+    X, y = reg_data
+    reg = hsk.H2OGeneralizedLinearRegressor(family="gaussian", lambda_=0.0)
+    scores = cross_val_score(reg, X, y, cv=3, n_jobs=1)
+    assert scores.mean() > 0.9          # linear data, linear model
+
+
+def test_kmeans_transformer(clf_data):
+    X, _ = clf_data
+    km = hsk.H2OKMeansEstimator(k=3, seed=5)
+    labels = km.fit(X).predict(X)
+    assert labels.shape == (200,)
+    assert set(np.unique(labels)) <= {0, 1, 2}
+
+
+def test_pca_in_pipeline(clf_data):
+    X, y = clf_data
+    pipe = Pipeline([
+        ("pca", hsk.H2OPrincipalComponentAnalysisEstimator(k=3, seed=2)),
+        ("gbm", hsk.H2OGradientBoostingClassifier(ntrees=5, seed=2)),
+    ])
+    pipe.fit(X, y)
+    assert pipe.predict(X).shape == (200,)
+
+
+def test_pandas_input(clf_data):
+    pd = pytest.importorskip("pandas")
+    X, y = clf_data
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(X.shape[1])])
+    clf = hsk.H2OGeneralizedLinearClassifier(family="binomial")
+    clf.fit(df, y)
+    assert clf.predict(df).shape == (200,)
+
+
+def test_surface_complete():
+    """Reference gen_models triples: every supervised stem has the
+    Classifier+Regressor pair, NaiveBayes/SVM classify-only."""
+    for stem in ("H2OGradientBoosting", "H2ORandomForest",
+                 "H2OGeneralizedLinear", "H2ODeepLearning", "H2OXGBoost",
+                 "H2ORuleFit", "H2OGeneralizedAdditive"):
+        assert hasattr(hsk, stem + "Classifier"), stem
+        assert hasattr(hsk, stem + "Regressor"), stem
+    assert hasattr(hsk, "H2ONaiveBayesClassifier")
+    assert not hasattr(hsk, "H2ONaiveBayesRegressor")
+    assert hasattr(hsk, "H2OKMeansEstimator")
+    assert hasattr(hsk, "H2OTargetEncoderTransformer")
+    assert hasattr(hsk, "H2OAutoMLClassifier")
+    assert len(hsk.__all__) >= 35
