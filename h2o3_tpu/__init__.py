@@ -20,7 +20,20 @@ from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.io.parser import import_file, parse_setup, upload_frame
 from h2o3_tpu.core.jobs import Job
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
+
+
+def explain(models, frame, columns: int = 3, render: bool = False):
+    """h2o.explain: figure bundle (SHAP summary, varimp, PDP, learning
+    curve; cross-model heatmaps for lists) — see explain_plots.py."""
+    from h2o3_tpu import explain_plots as EP
+    return EP.explain(models, frame, columns=columns, render=render)
+
+
+def explain_row(models, frame, row_index: int, columns: int = 3):
+    """h2o.explain_row: per-row SHAP bars + ICE curves."""
+    from h2o3_tpu import explain_plots as EP
+    return EP.explain_row(models, frame, row_index, columns=columns)
 
 
 def get_frame(key):

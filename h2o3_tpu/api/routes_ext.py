@@ -395,7 +395,8 @@ def _send_bytes(h, body: bytes, ctype="application/octet-stream",
                       f'attachment; filename="{filename}"')
     h.send_header("Content-Length", str(len(body)))
     h.end_headers()
-    h.wfile.write(body)
+    if getattr(h, "command", "") != "HEAD":      # RFC 9110: no body
+        h.wfile.write(body)
 
 
 def _h_download_dataset(h):
@@ -648,7 +649,7 @@ def _h_pdp_build(h):
     job = Job(description="PartialDependence", dest=dest)
 
     def work(job):
-        from h2o3_tpu.explain import partial_dependence
+        from h2o3_tpu.explain_data import partial_dependence
         out = []
         for c in cols:
             pd = partial_dependence(m, f, c, nbins=nbins)
@@ -797,7 +798,10 @@ def _h_model_metrics_list(h):
     ms = [DKV.get(k) for k in DKV.keys()]
     out = []
     for m in ms:
-        if isinstance(m, ModelBase) and m._output.training_metrics:
+        # registry may hold constructed-but-untrained builders
+        # (_output is None) — list only scored models
+        if isinstance(m, ModelBase) and m._output is not None \
+                and m._output.training_metrics:
             out.append(dict(m._output.training_metrics.to_dict(),
                             model={"name": m.key}))
     h._send({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},

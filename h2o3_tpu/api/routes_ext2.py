@@ -562,10 +562,19 @@ def _h_metadata_schemas(h, name=None):
 
 
 def _h_metadata_endpoint(h, idx):
+    """GET /3/Metadata/endpoints/{num-or-name}: by list index or by the
+    handler name (the reference also resolves by route name)."""
     from h2o3_tpu.api import server as _srv
-    i = int(idx)
-    if not (0 <= i < len(_srv.ROUTES)):
-        return h._error(f"endpoint {i} out of range", 404)
+    if idx.isdigit():
+        i = int(idx)
+        if not (0 <= i < len(_srv.ROUTES)):
+            return h._error(f"endpoint {i} out of range", 404)
+    else:
+        hits = [k for k, (p0, m0, f0) in enumerate(_srv.ROUTES)
+                if f0.__name__.lstrip("_") == idx.lstrip("_")]
+        if not hits:
+            return h._error(f"endpoint {idx} not found", 404)
+        i = hits[0]
     pat, m, fn = _srv.ROUTES[i]
     h._send({"__meta": {"schema_type": "EndpointV3"},
              "url_pattern": pat.pattern, "http_method": m,
@@ -676,7 +685,7 @@ def _h_permutation_varimp(h):
     f = DKV.get(p.get("frame"))
     if not isinstance(m, ModelBase) or not isinstance(f, Frame):
         return h._error("model/frame not found", 404)
-    from h2o3_tpu.explain import permutation_varimp
+    from h2o3_tpu.explain_data import permutation_varimp
     rows = permutation_varimp(m, f,
                               metric=p.get("metric", "AUTO"),
                               n_repeats=int(p.get("n_repeats") or 1),
@@ -729,7 +738,7 @@ def build_routes():
         (R(r"/3/KillMinus3"), "GET", _h_kill_minus3),
         (R(r"/3/Metadata/schemas"), "GET", _h_metadata_schemas),
         (R(r"/3/Metadata/schemas/([^/]+)"), "GET", _h_metadata_schemas),
-        (R(r"/3/Metadata/endpoints/([0-9]+)"), "GET", _h_metadata_endpoint),
+        (R(r"/3/Metadata/endpoints/([^/]+)"), "GET", _h_metadata_endpoint),
         (R(r"/99/Rapids/help"), "GET", _h_rapids_help),
         (R(r"/4/sessions/([^/]+)"), "GET", _h_session_get),
         (R(r"/4/modelsinfo"), "GET", _h_models_info_v4),

@@ -946,6 +946,21 @@ class H2OGeneralizedLinearEstimator(ModelBase):
             self._coefficients = raw
         else:
             self._coefficients = coefs
+        # variable importances = |standardized coefficient| magnitudes
+        # (hex/glm GLMModel.GLMOutput getVariableImportances: abs of the
+        # standardized betas, multinomial takes the per-class max)
+        mags = {}
+        for j, n in enumerate(di.feature_names):
+            b = st.beta[:, j] if st.family == MULTINOMIAL else st.beta[j]
+            mags[n] = float(np.max(np.abs(b)))
+        order = sorted(mags, key=mags.get, reverse=True)
+        top = mags[order[0]] if order else 0.0
+        tot = sum(mags.values()) or 1.0
+        self._output.variable_importances = [
+            {"variable": n, "relative_importance": mags[n],
+             "scaled_importance": mags[n] / (top or 1.0),
+             "percentage": mags[n] / tot}
+            for n in order]
         self._output.model_summary = {
             "family": st.family, "link": st.link,
             "number_of_predictors_total": len(names) - 1,

@@ -635,7 +635,7 @@ class ModelBase:
     # ---- explanation surface (h2o-py explain module) ---------------------
     def partial_plot(self, frame, cols=None, nbins: int = 20, plot=False):
         """h2o model.partial_plot: PDP tables for the given columns."""
-        from h2o3_tpu import explain as EX
+        from h2o3_tpu import explain_data as EX
         cols = cols or [r["variable"] for r in (self.varimp() or [])[:2]] \
             or self._dinfo.predictors[:2]
         return [EX.partial_dependence(self, frame, c, nbins=nbins)
@@ -644,21 +644,39 @@ class ModelBase:
     def permutation_importance(self, frame, metric="AUTO", n_repeats=1,
                                seed=42):
         """h2o model.permutation_importance (PermutationVarImp.java)."""
-        from h2o3_tpu import explain as EX
+        from h2o3_tpu import explain_data as EX
         return EX.permutation_varimp(self, frame, metric=metric,
                                      n_repeats=n_repeats, seed=seed)
 
     def ice_plot(self, frame, column, nbins: int = 20):
-        from h2o3_tpu import explain as EX
-        return EX.ice(self, frame, column, nbins=nbins)
+        """ICE figure (h2o-py model.ice_plot renders matplotlib)."""
+        from h2o3_tpu import explain_plots as EP
+        return EP.ice_plot(self, frame, column, nbins=nbins)
+
+    def pd_plot(self, frame, column, nbins: int = 20):
+        from h2o3_tpu import explain_plots as EP
+        return EP.pd_plot(self, frame, column, nbins=nbins)
+
+    def varimp_plot(self, num_of_features: int = 10):
+        from h2o3_tpu import explain_plots as EP
+        return EP.varimp_plot(self, num_of_features=num_of_features)
+
+    def shap_summary_plot(self, frame, top_n: int = 20):
+        from h2o3_tpu import explain_plots as EP
+        return EP.shap_summary_plot(self, frame, top_n=top_n)
+
+    def shap_explain_row_plot(self, frame, row_index: int, top_n: int = 10):
+        from h2o3_tpu import explain_plots as EP
+        return EP.shap_explain_row_plot(self, frame, row_index,
+                                        top_n=top_n)
 
     def learning_curve_plot(self):
-        from h2o3_tpu import explain as EX
-        return EX.learning_curve(self)
+        from h2o3_tpu import explain_plots as EP
+        return EP.learning_curve_plot(self)
 
     def explain(self, frame, columns: int = 3):
-        from h2o3_tpu import explain as EX
-        return EX.explain(self, frame, columns=columns)
+        from h2o3_tpu import explain_plots as EP
+        return EP.explain(self, frame, columns=columns)
 
     # ---- export (h2o-genmodel surface) -----------------------------------
     def download_mojo(self, path: str, format: str = "native") -> str:
@@ -688,13 +706,30 @@ class ModelBase:
 
     def to_dict(self):
         o = self._output
-        return {
+        d = {
             "model_id": self.key, "algo": self.algo,
             "params": {k: v for k, v in self.params.items() if v is not None},
             "training_metrics": o.training_metrics.to_dict() if o and o.training_metrics else None,
             "validation_metrics": o.validation_metrics.to_dict() if o and o.validation_metrics else None,
             "model_summary": o.model_summary if o else {},
         }
+        # ModelOutputSchemaV3 extras the clients read off the model JSON:
+        # varimp table, GLM coefficients, KMeans centers
+        if o and o.variable_importances:
+            d["variable_importances"] = o.variable_importances
+        if o and o.scoring_history:
+            d["scoring_history"] = o.scoring_history
+        out = {}
+        if getattr(self, "_coefficients", None):
+            out["coefficients_table"] = self._coefficients
+            out["coefficients_std"] = getattr(self, "_coefficients_std",
+                                              None)
+        if getattr(self, "_centroids", None) is not None:
+            out["centers"] = np.asarray(self._centroids,
+                                        np.float64).tolist()
+        if out:
+            d["output"] = out
+        return d
 
 
 def _subframe(frame: Frame, col_data, cat_doms, idx: np.ndarray) -> Frame:

@@ -1201,7 +1201,7 @@ def _segment_models_as_frame(a, e):
 @prim("PermutationVarImp")
 def _perm_varimp(a, e):
     """AstPermutationVarImp (models/AstPermutationVarImp.java)."""
-    from h2o3_tpu.explain import permutation_varimp
+    from h2o3_tpu.explain_data import permutation_varimp
     m = _eval(a[0], e)
     fr = _eval(a[1], e)
     metric = str(_eval(a[2], e)) if len(a) > 2 else "AUTO"

@@ -32,7 +32,7 @@ def test_partial_dependence_monotone_in_strong_feature():
 
 def test_partial_dependence_categorical():
     m, f = _model_and_frame()
-    from h2o3_tpu import explain as EX
+    from h2o3_tpu import explain_data as EX
     pdp = EX.partial_dependence(m, f, "g")
     assert set(pdp["grid"]) == {"lo", "hi"}
     d = dict(zip(pdp["grid"], pdp["mean_response"]))
@@ -41,10 +41,10 @@ def test_partial_dependence_categorical():
 
 def test_ice_curves_shape():
     m, f = _model_and_frame()
-    grid, C = m.ice_plot(f, "a", nbins=7)
+    from h2o3_tpu import explain_data as EX
+    grid, C = EX.ice(m, f, "a", nbins=7)
     assert len(grid) == 7 and C.shape == (400, 7)
     # mean of ICE curves == PDP
-    from h2o3_tpu import explain as EX
     pdp = EX.partial_dependence(m, f, "a", nbins=7)
     assert np.allclose(C.mean(axis=0), pdp["mean_response"], atol=1e-4)
 
@@ -63,15 +63,13 @@ def test_heatmaps_and_learning_curve():
     from h2o3_tpu.models import H2ORandomForestEstimator
     m2 = H2ORandomForestEstimator(ntrees=10, max_depth=5, seed=1)
     m2.train(y="y", training_frame=f)
-    from h2o3_tpu import explain as EX
+    from h2o3_tpu import explain_data as EX
     feats, names, mat = EX.varimp_heatmap([m, m2])
     assert mat.shape == (len(feats), 2)
     mnames, corr = EX.model_correlation([m, m2], f)
     assert corr.shape == (2, 2) and corr[0, 1] > 0.8
-    lc = m.learning_curve_plot()
+    lc = EX.learning_curve(m)
     assert "training_rmse" in lc["series"]
-    ex = m.explain(f)
-    assert "partial_dependence" in ex and ex["variable_importances"]
 
 
 def test_pdp_standardized_model_sweeps_raw_units():
@@ -83,7 +81,7 @@ def test_pdp_standardized_model_sweeps_raw_units():
     import numpy as np
     from h2o3_tpu.core.frame import Frame
     from h2o3_tpu.core.kvstore import DKV
-    from h2o3_tpu import explain as EX
+    from h2o3_tpu import explain_data as EX
     from h2o3_tpu.models import H2OGeneralizedLinearEstimator
 
     rng = np.random.default_rng(3)
@@ -110,7 +108,7 @@ def test_pdp_tree_model_label_mode_not_standardized():
     import numpy as np
     from h2o3_tpu.core.frame import Frame
     from h2o3_tpu.core.kvstore import DKV
-    from h2o3_tpu import explain as EX
+    from h2o3_tpu import explain_data as EX
     from h2o3_tpu.models import H2OGradientBoostingEstimator
 
     rng = np.random.default_rng(5)

@@ -1,0 +1,107 @@
+"""Explanation figure set (h2o3_tpu/explain_plots.py) — the reference's
+h2o-py/h2o/explanation/_explain.py renders matplotlib figures for SHAP
+summary/row plots, PDP/ICE, varimp, learning curves and cross-model
+heatmaps, bundled by h2o.explain / h2o.explain_row."""
+
+import numpy as np
+import pytest
+from matplotlib.figure import Figure
+
+import h2o3_tpu
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.models import (H2OGradientBoostingEstimator,
+                             H2OGeneralizedLinearEstimator)
+
+
+@pytest.fixture(scope="module")
+def model_frame():
+    rng = np.random.default_rng(3)
+    n = 300
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    c = rng.normal(size=n)                       # noise
+    y = (a + 0.5 * b + rng.normal(scale=0.3, size=n) > 0)
+    f = Frame.from_dict({
+        "a": a, "b": b, "c": c,
+        "y": np.array(["yes" if t else "no" for t in y], object)})
+    m = H2OGradientBoostingEstimator(ntrees=15, max_depth=4, seed=5)
+    m.train(y="y", training_frame=f)
+    return m, f
+
+
+def _save_ok(fig, tmp_path, name):
+    """Figures must actually rasterize (catches bad artists/limits)."""
+    p = tmp_path / f"{name}.png"
+    fig.savefig(p, dpi=60)
+    assert p.stat().st_size > 2000
+
+
+def test_shap_summary_plot(model_frame, tmp_path):
+    m, f = model_frame
+    fig = m.shap_summary_plot(f)
+    assert isinstance(fig, Figure)
+    # the beeswarm ranks |contribution|: the signal feature must lead
+    labels = [t.get_text() for t in fig.axes[0].get_yticklabels()]
+    assert labels[-1] == "a"                     # top strip = strongest
+    _save_ok(fig, tmp_path, "shap_summary")
+
+
+def test_shap_row_plot(model_frame, tmp_path):
+    m, f = model_frame
+    fig = m.shap_explain_row_plot(f, 7)
+    assert isinstance(fig, Figure)
+    labels = [t.get_text() for t in fig.axes[0].get_yticklabels()]
+    assert any(lbl.startswith("a = ") for lbl in labels)
+    _save_ok(fig, tmp_path, "shap_row")
+
+
+def test_pd_and_ice_plots(model_frame, tmp_path):
+    m, f = model_frame
+    _save_ok(m.pd_plot(f, "a"), tmp_path, "pd")
+    _save_ok(m.ice_plot(f, "a"), tmp_path, "ice")
+
+
+def test_varimp_and_learning_curve(model_frame, tmp_path):
+    m, f = model_frame
+    fig = m.varimp_plot()
+    labels = [t.get_text() for t in fig.axes[0].get_yticklabels()]
+    assert labels[-1] == "a"                     # top bar = strongest
+    _save_ok(fig, tmp_path, "varimp")
+    _save_ok(m.learning_curve_plot(), tmp_path, "lc")
+
+
+def test_explain_bundle(model_frame, tmp_path):
+    m, f = model_frame
+    out = h2o3_tpu.explain(m, f)
+    assert {"varimp_plot", "shap_summary_plot", "pd_plots"} <= set(out)
+    assert "a" in out["pd_plots"]
+    for name, fig in out.items():
+        if isinstance(fig, Figure):
+            _save_ok(fig, tmp_path, f"bundle_{name}")
+
+
+def test_explain_multi_model(model_frame, tmp_path):
+    m, f = model_frame
+    g = H2OGeneralizedLinearEstimator(family="binomial")
+    g.train(y="y", training_frame=f)
+    out = h2o3_tpu.explain([m, g], f)
+    assert "model_correlation_heatmap" in out
+    assert "varimp_heatmap" in out
+    _save_ok(out["model_correlation_heatmap"], tmp_path, "corr")
+
+
+def test_explain_row_bundle(model_frame, tmp_path):
+    m, f = model_frame
+    out = h2o3_tpu.explain_row(m, f, 3)
+    assert "shap_explain_row_plot" in out
+    assert "a" in out["ice_plots"]
+
+
+def test_glm_no_shap_graceful(model_frame):
+    """Non-tree models: explain() skips SHAP instead of raising."""
+    _, f = model_frame
+    g = H2OGeneralizedLinearEstimator(family="binomial")
+    g.train(y="y", training_frame=f)
+    out = h2o3_tpu.explain(g, f)
+    assert "shap_summary_plot" not in out
+    assert "varimp_plot" in out
