@@ -111,7 +111,8 @@ class H2OAutoML:
                  balance_classes: bool = False,
                  keep_cross_validation_predictions: bool = True,
                  max_runtime_secs_per_model: float = 0.0,
-                 recovery_dir: str | None = None):
+                 recovery_dir: str | None = None,
+                 preprocessing=None):
         self.max_models = max_models
         self.max_runtime_secs = max_runtime_secs
         self.max_runtime_secs_per_model = max_runtime_secs_per_model
@@ -123,6 +124,12 @@ class H2OAutoML:
                               if include_algos else None)
         self.project_name = project_name or DKV.make_key("automl")
         self.recovery_dir = recovery_dir
+        # ai.h2o.automl.preprocessing.TargetEncoding: preprocessing=
+        # ["target_encoding"] target-encodes high-cardinality categoricals
+        # (cardinality >= 25, the reference's threshold) with CV-safe
+        # kfold leakage handling before any model step runs
+        self.preprocessing = [p.lower() for p in (preprocessing or [])]
+        self.te_model = None
         DKV.put(self.project_name, self)
         self.leaderboard_obj = None
         self.event_log: list = []
@@ -141,6 +148,20 @@ class H2OAutoML:
             metric = ("auc" if ncls == 2 else
                       "mean_per_class_error" if is_cls else "rmse")
         decreasing = metric in ("auc", "pr_auc", "accuracy", "f1")
+
+        # ---- preprocessing: CV-safe target encoding ----------------------
+        # (TargetEncoding.java: kfold strategy on the training frame with
+        # the SAME fold column the model CVs on; plain strategy elsewhere)
+        te_fold_col = None
+        if "target_encoding" in self.preprocessing:
+            x = x or [c for c in training_frame.names if c != y]
+            (x, training_frame, validation_frame, leaderboard_frame,
+             te_fold_col) = self._apply_target_encoding(
+                x, y, training_frame, validation_frame, leaderboard_frame)
+        # reset per-train state: a second train() on a frame without
+        # high-card categoricals must not inherit run 1's fold column
+        self._te_fold_col = te_fold_col
+
         lb = Leaderboard(metric.lower(), decreasing,
                          leaderboard_frame=leaderboard_frame)
         self.leaderboard_obj = lb
@@ -167,7 +188,12 @@ class H2OAutoML:
         def run_step(name, cls, params):
             nonlocal built
             p = dict(params)
-            p["nfolds"] = self.nfolds
+            if te_fold_col is not None:
+                # fold-consistent CV: models fold on the same assignment the
+                # target encoder used for its out-of-fold encodings
+                p["fold_column"] = te_fold_col
+            else:
+                p["nfolds"] = self.nfolds
             p["keep_cross_validation_predictions"] = True
             p["model_id"] = f"{self.project_name}_{name}"
             # per-model budget (AutoML.java time allocation): the smaller of
@@ -261,6 +287,55 @@ class H2OAutoML:
         return self
 
     # ------------------------------------------------------------------
+    # TargetEncoding.java: DEFAULT_CARDINALITY_THRESHOLD — only columns at
+    # or above this many levels are worth encoding (low-card categoricals
+    # one-hot fine)
+    TE_CARDINALITY_THRESHOLD = 25
+
+    def _apply_target_encoding(self, x, y, training_frame,
+                               validation_frame, leaderboard_frame):
+        """ai/h2o/automl/preprocessing/TargetEncoding.java: encode
+        high-cardinality categorical predictors out-of-fold on the training
+        frame (kfold strategy over a dedicated fold column, blended, with
+        noise) and with the plain global encodings on validation /
+        leaderboard frames. Returns the rewritten
+        (x, train, valid, lb_frame, fold_column)."""
+        from h2o3_tpu.core.frame import Vec
+        from h2o3_tpu.models.target_encoder import H2OTargetEncoderEstimator
+        te_cols = [c for c in x
+                   if training_frame.vec(c).type == "enum"
+                   and training_frame.vec(c).cardinality
+                   >= self.TE_CARDINALITY_THRESHOLD]
+        if not te_cols:
+            self._log("target_encoding: no high-cardinality columns; skipped")
+            return x, training_frame, validation_frame, leaderboard_frame, None
+        fold_col = "__automl_te_fold__"
+        n = training_frame.nrows
+        rng = np.random.default_rng(self.seed if self.seed > 0 else 0)
+        folds = rng.permutation(n) % max(2, self.nfolds)
+        train2 = Frame(list(training_frame.names),
+                       list(training_frame.vecs),
+                       key=DKV.make_key("te_train"))
+        train2[fold_col] = Vec.from_numpy(folds.astype(np.float64))
+        te = H2OTargetEncoderEstimator(
+            data_leakage_handling="kfold", blending=True,
+            inflection_point=10.0, smoothing=20.0, noise=0.01,
+            seed=self.seed if self.seed > 0 else 1,
+            fold_column=fold_col, columns_to_encode=te_cols)
+        te.train(x=x, y=y, training_frame=train2)
+        self.te_model = te
+        train_enc = te.transform(train2, as_training=True)
+        valid_enc = (te.transform(validation_frame)
+                     if validation_frame is not None else None)
+        lb_enc = (te.transform(leaderboard_frame)
+                  if leaderboard_frame is not None else None)
+        # models see the encodings INSTEAD of the raw high-card columns
+        x_enc = [c for c in x if c not in te_cols] \
+            + [f"{c}_te" for c in te_cols]
+        self._log(f"target_encoding: encoded {te_cols} "
+                  f"(cardinalities {[training_frame.vec(c).cardinality for c in te_cols]})")
+        return x_enc, train_enc, valid_enc, lb_enc, fold_col
+
     def _run_grid_steps(self, lb, se_candidates, x, y, training_frame,
                         validation_frame, t0, recovery):
         """The AutoML plan's grid steps: a RandomDiscrete GBM grid under
@@ -288,11 +363,14 @@ class H2OAutoML:
                                  "max_runtime_secs": budget_left,
                                  "seed": self.seed},
                 recovery_dir=self.recovery_dir)
+            cv_kw = ({"fold_column": self._te_fold_col}
+                     if getattr(self, "_te_fold_col", None)
+                     else {"nfolds": self.nfolds})
             grid.train(x=x, y=y, training_frame=training_frame,
                        validation_frame=validation_frame,
-                       nfolds=self.nfolds,
                        keep_cross_validation_predictions=True,
-                       ntrees=40, seed=self.seed if self.seed > 0 else 1)
+                       ntrees=40, seed=self.seed if self.seed > 0 else 1,
+                       **cv_kw)
             for i, m in enumerate(grid.models):
                 lb.add(f"GBM_grid_1_model_{i}", m)
                 se_candidates.append(m)
@@ -348,4 +426,8 @@ class H2OAutoML:
         return pd.DataFrame(self.leaderboard_obj.as_list())
 
     def predict(self, test_data: Frame) -> Frame:
+        if self.te_model is not None:
+            # leader was trained on target-encoded columns: apply the same
+            # (plain-strategy) encodings before scoring
+            test_data = self.te_model.transform(test_data)
         return self.leader.predict(test_data)

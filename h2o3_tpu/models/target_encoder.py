@@ -46,17 +46,34 @@ class H2OTargetEncoderEstimator:
             c for c in (x or f.names)
             if c != y and f.vec(c).type == T_CAT]
         self._cols = [c if isinstance(c, str) else f.names[c] for c in cols]
+        fold_col = self.params["fold_column"]
+        folds = None
+        if fold_col and fold_col in f.names and \
+                self.params["data_leakage_handling"] == "kfold":
+            folds = f.vec(fold_col).to_numpy().astype(int)
+            self._nfolds = int(folds.max()) + 1
         for c in self._cols:
             v = f.vec(c)
             codes = v.to_numpy()
             dom = v.levels()
-            sums = np.zeros(len(dom))
-            cnts = np.zeros(len(dom))
-            for lvl in range(len(dom)):
-                sel = (codes == lvl) & ok
-                sums[lvl] = yn[sel].sum()
-                cnts[lvl] = sel.sum()
-            self._encodings[c] = {"domain": dom, "sums": sums, "counts": cnts}
+            nd = len(dom)
+            sel = ok & ~np.isnan(codes)
+            ci = codes[sel].astype(np.int64)
+            sums = np.bincount(ci, weights=yn[sel], minlength=nd)
+            cnts = np.bincount(ci, minlength=nd).astype(np.float64)
+            enc = {"domain": dom, "sums": sums, "counts": cnts}
+            if folds is not None:
+                # per-fold sums/counts in one bincount pass over the
+                # joint (fold, level) key: the kfold encoding of a row
+                # is total minus its own fold's contribution
+                key = folds[sel] * nd + ci
+                fs = np.bincount(key, weights=yn[sel],
+                                 minlength=self._nfolds * nd)
+                fc = np.bincount(key, minlength=self._nfolds * nd)
+                enc["fold_sums"] = fs.reshape(self._nfolds, nd)
+                enc["fold_counts"] = fc.reshape(self._nfolds,
+                                                nd).astype(np.float64)
+            self._encodings[c] = enc
         return self
 
     def _encode_col(self, c, codes, yn=None, folds=None):
@@ -77,6 +94,7 @@ class H2OTargetEncoderEstimator:
             lam = 1.0 / (1.0 + np.exp(-(n - k) / fsm))
             return lam * mean + (1 - lam) * self._prior
 
+        fold_s = enc.get("fold_sums")
         for i, code in enumerate(codes):
             if np.isnan(code):
                 continue
@@ -85,6 +103,10 @@ class H2OTargetEncoderEstimator:
             if mode == "leave_one_out" or mode == "loo":
                 if yn is not None and not np.isnan(yn[i]):
                     s, n = s - yn[i], n - 1
+            elif mode == "kfold" and folds is not None and fold_s is not None:
+                fo = folds[i]
+                s = s - fold_s[fo, lvl]
+                n = n - enc["fold_counts"][fo, lvl]
             out[i] = blended(s, n)
         noise = self.params["noise"]
         if noise and yn is not None:
@@ -97,11 +119,16 @@ class H2OTargetEncoderEstimator:
         names, vecs = list(frame.names), list(frame.vecs)
         yn = frame.vec(self._y).to_numpy() if (
             as_training and self._y in frame.names) else None
+        fold_col = self.params["fold_column"]
+        folds = None
+        if as_training and fold_col and fold_col in frame.names and \
+                self.params["data_leakage_handling"] == "kfold":
+            folds = frame.vec(fold_col).to_numpy().astype(int)
         out = Frame(names, vecs)
         for c in self._cols:
             if c not in frame.names:
                 continue
             codes = frame.vec(c).to_numpy()
-            enc_col = self._encode_col(c, codes, yn=yn)
+            enc_col = self._encode_col(c, codes, yn=yn, folds=folds)
             out[f"{c}_te"] = enc_col
         return out

@@ -1,0 +1,123 @@
+"""AutoML target-encoding preprocessing —
+ai/h2o/automl/preprocessing/TargetEncoding.java: high-cardinality
+categoricals are encoded out-of-fold (kfold strategy over a dedicated
+fold column) before any model step, models CV on the SAME folds, and
+scoring frames get the plain global encodings."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.automl.automl import H2OAutoML
+from h2o3_tpu.core.frame import Frame, Vec
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.models.target_encoder import H2OTargetEncoderEstimator
+
+
+def _hicard_frame(n=400, levels=40, seed=0):
+    rng = np.random.default_rng(seed)
+    lvl_effect = rng.normal(size=levels)
+    g = rng.integers(0, levels, n)
+    x1 = rng.normal(size=n)
+    logit = 1.5 * lvl_effect[g] + 0.5 * x1
+    y = rng.random(n) < 1 / (1 + np.exp(-logit))
+    return Frame.from_dict({
+        "cat": np.array([f"lvl{i:03d}" for i in g], object),
+        "x1": x1,
+        "y": np.array(["yes" if t else "no" for t in y], object)})
+
+
+def test_kfold_encoding_is_out_of_fold():
+    """For a row in fold f, the kfold encoding must equal the mean response
+    of same-level rows in the OTHER folds (no blending, no noise)."""
+    rng = np.random.default_rng(1)
+    n = 120
+    g = rng.integers(0, 4, n)
+    y = rng.random(n)
+    folds = np.arange(n) % 3
+    f = Frame.from_dict({"cat": np.array([f"L{i}" for i in g], object),
+                         "y": y})
+    f["fold"] = Vec.from_numpy(folds.astype(np.float64))
+    te = H2OTargetEncoderEstimator(data_leakage_handling="kfold",
+                                   blending=False, noise=0.0,
+                                   fold_column="fold",
+                                   columns_to_encode=["cat"])
+    te.train(x=["cat"], y="y", training_frame=f)
+    out = te.transform(f, as_training=True)
+    enc = out.vec("cat_te").to_numpy()
+    dom = f.vec("cat").levels()
+    codes = f.vec("cat").to_numpy()
+    for i in range(n):
+        lvl = dom[int(codes[i])]
+        mask = (np.array([dom[int(c)] for c in codes]) == lvl) \
+            & (folds != folds[i])
+        expect = y[mask].mean() if mask.any() else te._prior
+        assert abs(enc[i] - expect) < 1e-6, (i, enc[i], expect)  # f32 Vec
+    DKV.remove(f.key)
+    DKV.remove(out.key)
+
+
+def test_plain_transform_uses_global_means():
+    rng = np.random.default_rng(2)
+    g = rng.integers(0, 3, 60)
+    y = rng.random(60)
+    f = Frame.from_dict({"cat": np.array([f"L{i}" for i in g], object),
+                         "y": y})
+    te = H2OTargetEncoderEstimator(blending=False, noise=0.0,
+                                   columns_to_encode=["cat"])
+    te.train(x=["cat"], y="y", training_frame=f)
+    out = te.transform(f)
+    enc = out.vec("cat_te").to_numpy()
+    codes = f.vec("cat").to_numpy().astype(int)
+    for lvl in range(3):
+        expect = y[codes == lvl].mean()
+        got = enc[codes == lvl]
+        assert np.allclose(got, expect)
+    DKV.remove(f.key)
+    DKV.remove(out.key)
+
+
+@pytest.mark.slow
+def test_automl_with_target_encoding_preprocessing():
+    f = _hicard_frame()
+    aml = H2OAutoML(max_models=2, nfolds=3, seed=7,
+                    include_algos=["glm", "gbm"],
+                    preprocessing=["target_encoding"])
+    aml.train(y="y", training_frame=f)
+    # the TE step ran and the leaderboard holds TE'd models
+    assert aml.te_model is not None
+    assert "cat" in aml.te_model._cols
+    assert len(aml.leaderboard_obj.rows) >= 2
+    leader = aml.leader
+    # every base model on the leaderboard trained on the ENCODED column
+    # instead of the raw high-card one (SE wrappers aggregate base preds,
+    # so check the algo models)
+    base = [DKV.get(r["model_id"]) for r in aml.leaderboard_obj.as_list()]
+    base = [m for m in base if m is not None
+            and m.algo in ("gbm", "glm", "drf", "xgboost")]
+    assert base, "no base models on the leaderboard"
+    for m in base:
+        assert "cat_te" in m._dinfo.predictors, m.key
+        assert "cat" not in m._dinfo.predictors, m.key
+    # scoring a RAW frame applies the stored encodings transparently
+    test = _hicard_frame(n=100, seed=9)
+    pred = aml.predict(test)
+    assert pred.nrows == 100
+    # the TE'd AutoML must carry the level signal: encoding preserves what
+    # dropping (or one-hotting 40 levels on 400 rows noisily) would lose
+    auc = base[0]._output.cross_validation_metrics.auc
+    assert auc > 0.62, auc
+
+
+@pytest.mark.slow
+def test_automl_te_skips_when_low_cardinality():
+    rng = np.random.default_rng(3)
+    f = Frame.from_dict({
+        "cat": np.array(["a", "b"], object)[rng.integers(0, 2, 200)],
+        "x1": rng.normal(size=200),
+        "y": np.array(["n", "p"], object)[rng.integers(0, 2, 200)]})
+    aml = H2OAutoML(max_models=1, nfolds=2, seed=1,
+                    include_algos=["glm"],
+                    preprocessing=["target_encoding"])
+    aml.train(y="y", training_frame=f)
+    assert aml.te_model is None          # below the cardinality threshold
+    assert aml.leader is not None
