@@ -60,6 +60,39 @@ def probe_backend() -> dict | None:
     return None
 
 
+def ingest_bench(mb: int = 50) -> dict:
+    """Distributed-parse throughput (VERDICT r4: fold an ingest number
+    into the chip bench): synthesize a ~`mb` MB CSV, time the byte-range
+    parallel parse (io/dparse + native tokenizer)."""
+    import tempfile
+    import numpy as np
+    from h2o3_tpu.io import dparse
+    rng = np.random.default_rng(0)
+    rows_per_mb = 18000          # ~56 B/row at 5 numeric cols
+    n = mb * rows_per_mb
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write("a,b,c,d,e\n")
+            for i in range(0, n, 10000):
+                blk = rng.normal(size=(min(10000, n - i), 5))
+                fh.write("\n".join(
+                    ",".join(f"{v:.6f}" for v in row) for row in blk))
+                fh.write("\n")
+        size_mb = os.path.getsize(path) / 1e6
+        t0 = time.time()
+        fr = dparse.parse_files([path], chunk_bytes=8 << 20)
+        dt = time.time() - t0
+        assert fr.nrows == n
+        from h2o3_tpu.core.kvstore import DKV
+        DKV.remove(fr.key)
+        return {"mb": round(size_mb, 1), "seconds": round(dt, 2),
+                "mb_per_sec": round(size_mb / dt, 1),
+                "cores": os.cpu_count()}
+    finally:
+        os.unlink(path)
+
+
 def main():
     rec = probe_backend()
     if rec is not None:
@@ -74,9 +107,11 @@ def main():
 
     from h2o3_tpu.models.tree import binned as BN
 
-    N, C = 11_000_000, 28
+    N, C = int(os.environ.get("BENCH_N", 11_000_000)), 28
     DEPTH, NBINS = 8, 255
     WARM, CHUNK, NCHUNK = 10, 10, 4          # 10 warmup + 40 timed trees
+    if N < 1_000_000:                        # CPU smoke mode: logic check only
+        CHUNK, NCHUNK = 2, 2
 
     # generate HIGGS-like data ON DEVICE (host->device of 1.2GB through the
     # remote relay would dominate; the benchmark measures training, not IO)
@@ -128,9 +163,36 @@ def main():
     p0 = float(jnp.mean(y))
     f0 = float(np.log(p0 / (1 - p0)))
 
+    def roofline_model(c_pad, np_rows, int8: bool):
+        """Analytic MXU-MAC and HBM-byte counts per tree for the binned
+        engine's executed program (mirrors grow()'s level loop: full hist
+        at d=0, sibling-subtraction half windows after; windows of
+        GW leaves x S_STATS sublanes; codes re-streamed per pass and per
+        route). Counts the dot as written — lane padding below 128 counts
+        AGAINST utilization, as it should."""
+        from h2o3_tpu.ops import hist_pallas as _hp
+        S, GW, nb = _hp.S_STATS, _hp.GW, NBINS + 1
+        macs = b = 0
+        stat_b = 1 if int8 else 4
+        for d in range(DEPTH):
+            l_eff = 1 if d == 0 else (1 << d) >> 1
+            gwe = min(l_eff, GW)
+            npass = -(-l_eff // gwe)
+            macs += npass * c_pad * (gwe * S) * nb * np_rows
+            b += npass * (c_pad * np_rows * 4          # codes re-stream
+                          + S * np_rows * stat_b + np_rows * 4)
+            b += l_eff * c_pad * S * nb * 4            # hist writeback
+            if d >= 1:                                 # route stream
+                b += c_pad * np_rows * 4 + 3 * np_rows * 4
+        return macs, b
+
+    # v5e peaks (ops/PERF_NOTES.md): bf16 197 TFLOP/s (int8 2x), HBM 819 GB/s
+    PEAK_FLOPS = {"f32": 197e12, "int8": 394e12}
+    PEAK_HBM = 819e9
+
     def run_mode(int8: bool):
         """Train WARM warmup + CHUNK*NCHUNK timed trees; returns
-        (row*trees/s, auc)."""
+        (row*trees/s, auc, mfu, hbm_frac)."""
         grower = BN.BinnedGrower(spec, max_depth=DEPTH, min_rows=1.0,
                                  min_split_improvement=0.0,
                                  int8_stats=int8)
@@ -150,33 +212,52 @@ def main():
             F, _ = trainer(codes, y1, w1, F, kc)
         float(F[0])
         dt = time.time() - t0
-        return N * CHUNK * NCHUNK / dt, float(auc_dev(F, y))
+        ntrees = CHUNK * NCHUNK
+        macs, hbm_b = roofline_model(codes.shape[0], codes.shape[1], int8)
+        mode = "int8" if int8 else "f32"
+        mfu = 2 * macs * ntrees / dt / PEAK_FLOPS[mode]
+        hbm_frac = hbm_b * ntrees / dt / PEAK_HBM
+        return N * ntrees / dt, float(auc_dev(F, y)), mfu, hbm_frac
 
-    tp_f32, auc_f32 = run_mode(False)
+    tp_f32, auc_f32, mfu_f32, hbm_f32 = run_mode(False)
     assert auc_f32 > 0.72, \
         f"AUC gate failed: {auc_f32:.4f} — kernels mis-trained"
-    print(f"f32: {tp_f32/1e6:.2f}M row*trees/s auc={auc_f32:.4f}",
-          file=sys.stderr)
+    print(f"f32: {tp_f32/1e6:.2f}M row*trees/s auc={auc_f32:.4f} "
+          f"mfu={mfu_f32:.3f} hbm={hbm_f32:.3f}", file=sys.stderr)
     paths = {"f32": {"row_trees_per_sec": round(tp_f32),
-                     "train_auc": round(auc_f32, 4)}}
+                     "train_auc": round(auc_f32, 4),
+                     "mfu": round(mfu_f32, 4),
+                     "hbm_frac": round(hbm_f32, 4)}}
 
     # int8 stats path: report as headline ONLY if it both trains at parity
     # (AUC within 2e-3 of f32 on the identical run — the end-to-end
     # accuracy gate ADVICE r3 asked for) and is actually faster.
     throughput, auc, mode = tp_f32, auc_f32, "f32"
+    mfu, hbm_frac = mfu_f32, hbm_f32
     if HP.i8_supported():
         try:
-            tp_i8, auc_i8 = run_mode(True)
+            tp_i8, auc_i8, mfu_i8, hbm_i8 = run_mode(True)
             paths["int8"] = {"row_trees_per_sec": round(tp_i8),
                              "train_auc": round(auc_i8, 4),
-                             "auc_delta_vs_f32": round(auc_i8 - auc_f32, 5)}
-            print(f"int8: {tp_i8/1e6:.2f}M row*trees/s auc={auc_i8:.4f}",
-                  file=sys.stderr)
+                             "auc_delta_vs_f32": round(auc_i8 - auc_f32, 5),
+                             "mfu": round(mfu_i8, 4),
+                             "hbm_frac": round(hbm_i8, 4)}
+            print(f"int8: {tp_i8/1e6:.2f}M row*trees/s auc={auc_i8:.4f} "
+                  f"mfu={mfu_i8:.3f} hbm={hbm_i8:.3f}", file=sys.stderr)
             if auc_i8 >= auc_f32 - 2e-3 and tp_i8 > tp_f32:
                 throughput, auc, mode = tp_i8, auc_i8, "int8"
+                mfu, hbm_frac = mfu_i8, hbm_i8
         except Exception:
             traceback.print_exc()
             paths["int8"] = {"error": traceback.format_exc()[-500:]}
+
+    ingest = None
+    try:
+        ingest = ingest_bench()
+        print(f"ingest: {ingest['mb_per_sec']:.1f} MB/s "
+              f"({ingest['cores']} cores)", file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
 
     baseline = 157e6  # H100 gpu_hist row*trees/s reference point (header)
     print(json.dumps({
@@ -186,8 +267,11 @@ def main():
         "vs_baseline": round(throughput / baseline, 4),
         "train_auc": round(auc, 4),
         "stats_mode": mode,
+        "mfu": round(mfu, 4),
+        "hbm_frac": round(hbm_frac, 4),
         "radix_shallow": bool(HP.radix_supported()),
         "paths": paths,
+        "ingest": ingest,
     }))
 
 
