@@ -89,7 +89,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if getattr(self, "command", "") != "HEAD":   # RFC 9110: no body
+            self.wfile.write(body)
 
     def _error(self, msg, code=400):
         self._send({"__meta": {"schema_type": "H2OError"},
@@ -126,6 +127,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         self._route("DELETE")
+
+    def do_HEAD(self):
+        # HEAD mirrors GET headers with the body suppressed in _send;
+        # paths with only a GET route still resolve
+        path = urllib.parse.urlparse(self.path).path
+        if any(m == "HEAD" and pat.fullmatch(path)
+               for pat, m, fn in ROUTES):
+            self._route("HEAD")
+        else:
+            self._route("GET")
 
     def _route(self, method):
         if not self._check_auth():
@@ -617,6 +628,10 @@ ROUTES += _ext2.build_routes()
 from h2o3_tpu.api import routes_ext3 as _ext3  # noqa: E402
 
 ROUTES += _ext3.build_routes()
+
+from h2o3_tpu.api import routes_ext4 as _ext4  # noqa: E402
+
+ROUTES += _ext4.build_routes()
 
 # Flow-lite UI (h2o-web analog) at / and /flow/index.html
 from h2o3_tpu.api import flow as _flow  # noqa: E402

@@ -1,0 +1,191 @@
+"""REST long-tail part 4 (api/routes_ext4.py): the final route-diff
+closure vs water/api/RegisterV3Api.java — ModelMetrics frame scoping +
+DELETE, frame save/load, model fetch/upload.bin, NPS existence, Profiler,
+WaterMeterIo, CloudLock, v4 endpoints, TargetEncoderTransform,
+FriedmansPopescusH, Grid.bin round trip, XGBoostExecutor loud-rejects."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api.server import H2OServer, ROUTES
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _open(req):
+    try:
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # order-dependent failures in the full suite need the body to
+        # diagnose — re-raise with the server's error payload attached
+        raise AssertionError(
+            f"{e.code} on {e.url}: {e.read()[:500]!r}") from e
+
+
+def _get(s, path):
+    return _open(f"http://127.0.0.1:{s.port}{path}")
+
+
+def _post(s, path, **data):
+    body = urllib.parse.urlencode(data).encode()
+    return _open(urllib.request.Request(
+        f"http://127.0.0.1:{s.port}{path}", data=body, method="POST"))
+
+
+def _delete(s, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{s.port}{path}",
+                                 method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def gbm(server):
+    rng = np.random.default_rng(0)
+    f = Frame.from_dict({"a": rng.normal(size=120),
+                         "b": rng.normal(size=120),
+                         "y": rng.normal(size=120)}, key="e4f")
+    DKV.put("e4f", f)
+    r = _post(server, "/3/ModelBuilders/gbm", training_frame="e4f",
+              response_column="y", ntrees="5", max_depth="3",
+              model_id="e4gbm")
+    import time
+    for _ in range(300):
+        j = _get(server, "/3/Jobs/" + urllib.parse.quote(
+            r["job"]["key"], safe=""))["jobs"][0]
+        if j["status"] in ("DONE", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert j["status"] == "DONE", j
+    return "e4gbm"
+
+
+def test_route_count_185_plus(server):
+    assert len(ROUTES) >= 185, len(ROUTES)
+
+
+def test_metrics_frame_scope_and_delete(server, gbm):
+    rows = _get(server, "/3/ModelMetrics")["model_metrics"]
+    assert any(r["model"]["name"] == gbm for r in rows)
+    out = _delete(server, f"/3/ModelMetrics/models/{gbm}")
+    assert "model_metrics" in out
+
+
+def test_frame_column_and_save_load(server, gbm, tmp_path):
+    col = _get(server, "/3/Frames/e4f/columns/a")
+    assert col["frames"][0]["columns"][0]["label"] == "a"
+    d = str(tmp_path)
+    _post(server, "/3/Frames/e4f/save", dir=d)
+    DKV.remove("e4f_copy")
+    out = _post(server, "/3/Frames/load", dir=d, frame_id="e4f")
+    assert out["frames"][0]["frame_id"]["name"] == "e4f"
+
+
+def test_model_fetch_bin_roundtrip(server, gbm):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/3/Models.fetch.bin/{gbm}") as r:
+        body = r.read()
+    assert len(body) > 500
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/99/Models.upload.bin/e4gbm_up",
+        data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    assert out["models"][0]["model_id"]["name"] == "e4gbm_up"
+    m = DKV.get("e4gbm_up")
+    assert m is not None
+
+
+def test_nps_exists_probes(server):
+    _post(server, "/3/NodePersistentStorage/cat1/clipA", value="hello")
+    assert _get(server,
+                "/3/NodePersistentStorage/categories/cat1/exists")["exists"]
+    assert _get(server, "/3/NodePersistentStorage/categories/cat1/names/"
+                        "clipA/exists")["exists"]
+    assert not _get(server, "/3/NodePersistentStorage/categories/nope/"
+                            "exists")["exists"]
+
+
+def test_profiler_and_watermeter(server):
+    prof = _get(server, "/3/Profiler?depth=5")
+    assert prof["nodes"][0]["entries"]
+    io = _get(server, "/3/WaterMeterIo")
+    assert "persist_stats" in io
+
+
+def test_cloudlock_head_sample(server):
+    assert _post(server, "/3/CloudLock", reason="test")["locked"]
+    assert _get(server, "/99/Sample")["cloud_size"] >= 1
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/3/Cloud", method="HEAD")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+
+
+def test_v4_surface(server, gbm):
+    eps = _get(server, "/4/endpoints")["endpoints"]
+    assert len(eps) >= 185
+    pred = _post(server, f"/4/Predictions/models/{gbm}/frames/e4f")
+    assert "predictions_frame" in pred or "model_metrics" in pred
+
+
+def test_target_encoder_transform_route(server):
+    rng = np.random.default_rng(1)
+    g = rng.integers(0, 3, 90)
+    f = Frame.from_dict({"c": np.array([f"L{i}" for i in g], object),
+                         "y": rng.normal(size=90)}, key="e4te")
+    DKV.put("e4te", f)
+    from h2o3_tpu.models.target_encoder import H2OTargetEncoderEstimator
+    te = H2OTargetEncoderEstimator(columns_to_encode=["c"])
+    te.train(x=["c"], y="y", training_frame=f)
+    DKV.put("e4te_model", te)
+    out = _get(server, "/3/TargetEncoderTransform?model=e4te_model"
+                       "&frame=e4te")
+    enc = DKV.get(out["name"])
+    assert "c_te" in enc.names
+
+
+def test_friedmans_h(server, gbm):
+    out = _post(server, "/3/FriedmansPopescusH", model=gbm, frame="e4f",
+                variables='["a", "b"]')
+    assert 0.0 <= out["h"] <= 1.5
+
+
+def test_grid_bin_roundtrip(server, tmp_path):
+    from h2o3_tpu.models.grid import H2OGridSearch
+    from h2o3_tpu.models.tree.gbm import H2OGradientBoostingEstimator
+    f = DKV.get("e4f")
+    grid = H2OGridSearch(H2OGradientBoostingEstimator,
+                         hyper_params={"max_depth": [2, 3]},
+                         grid_id="e4grid")
+    grid.train(y="y", training_frame=f, ntrees=3)
+    d = str(tmp_path / "gexp")
+    _post(server, "/3/Grid.bin/e4grid/export", grid_directory=d)
+    DKV.remove("e4grid")
+    out = _post(server, "/3/Grid.bin/import", grid_path=d)
+    assert out["n_models"] == 2
+    assert DKV.get("e4grid") is not None
+
+
+def test_xgb_executor_loud_reject(server):
+    with pytest.raises(AssertionError) as ei:
+        _post(server, "/3/XGBoostExecutor.init")
+    assert "501" in str(ei.value)
+
+
+def test_metadata_endpoint_by_name(server):
+    out = _get(server, "/3/Metadata/endpoints/h_cloud")
+    assert "Cloud" in out["url_pattern"]
