@@ -294,8 +294,21 @@ def trees_to_arrays(tree_nodes, depth, n_features, cat_width=0):
 # ===========================================================================
 # Container: write
 def export_h2o_mojo(model, path: str) -> str:
-    """Write a reference-layout MOJO zip for a GBM/DRF model
-    (hex/tree/SharedTreeMojoWriter.java + AbstractMojoWriter.java)."""
+    """Write a reference-layout MOJO zip (AbstractMojoWriter.java layout;
+    per-algo writers: SharedTreeMojoWriter, GlmMojoWriter,
+    KMeansMojoWriter, DeeplearningMojoWriter)."""
+    algo = model.algo
+    if algo == "glm":
+        return _export_glm_mojo(model, path)
+    if algo == "kmeans":
+        return _export_kmeans_mojo(model, path)
+    if algo == "deeplearning":
+        return _export_dl_mojo(model, path)
+    return _export_tree_mojo(model, path)
+
+
+def _export_tree_mojo(model, path: str) -> str:
+    """GBM/DRF (hex/tree/SharedTreeMojoWriter.java)."""
     di = model._dinfo
     algo = model.algo
     assert algo in ("gbm", "drf"), f"h2o-mojo export supports trees, not {algo}"
@@ -319,7 +332,7 @@ def export_h2o_mojo(model, path: str) -> str:
     link = {"bernoulli": "logit", "quasibinomial": "logit",
             "multinomial": "multinomial", "poisson": "log", "gamma": "log",
             "tweedie": "log"}.get(dist, "identity")
-    f0 = model._f0 if not multi else 0.0
+    f0 = getattr(model, "_f0", 0.0) if not multi else 0.0
     cat_card = np.zeros(len(feats), np.int64)
     for j, name in enumerate(feats):
         if name in (di.cardinalities or {}):
@@ -494,3 +507,462 @@ def import_h2o_mojo(path: str) -> H2OMojoModel:
                (None, "null") else 0.0)
     return H2OMojoModel(info, columns, domains, groups, f0,
                         info.get("distribution", "gaussian"), algo)
+
+
+# ===========================================================================
+# Non-tree writers (GlmMojoWriter / KMeansMojoWriter / DeeplearningMojoWriter)
+def _ini_header(algo, algorithm, category, nclasses, columns, n_features,
+                supervised=True):
+    """Common [info] block (AbstractMojoWriter.writeModelInfo)."""
+    ini = ["[info]"]
+
+    def kv(k, v):
+        ini.append(f"{k} = {v}")
+
+    kv("h2o_version", "3.46.0.99999")
+    kv("mojo_version", "1.00")
+    kv("license", "Apache License Version 2.0")
+    kv("algo", algo)
+    kv("algorithm", algorithm)
+    kv("endianness", "LITTLE_ENDIAN")
+    kv("category", category)
+    kv("uuid", str(_uuid.uuid4().int & ((1 << 63) - 1)))
+    kv("supervised", "true" if supervised else "false")
+    kv("n_features", n_features)
+    kv("n_classes", nclasses)
+    kv("n_columns", len(columns))
+    kv("balance_classes", "false")
+    kv("default_threshold", "0.5")
+    kv("timestamp", datetime.now(timezone.utc).isoformat())
+    return ini, kv
+
+
+def _arr(vals):
+    """Arrays.toString encoding readkv round-trips: "[a, b, c]"."""
+    return "[" + ", ".join(repr(float(v)) if isinstance(v, float)
+                           else str(v) for v in vals) + "]"
+
+
+def _finish_zip(path, ini, columns, domains_by_ci):
+    ini.append("")
+    ini.append("[columns]")
+    ini += columns
+    ini.append("")
+    ini.append("[domains]")
+    dom_files = []
+    for di_idx, (ci, levels) in enumerate(sorted(domains_by_ci.items())):
+        ini.append(f"{ci}: {len(levels)} d{di_idx:03d}.txt")
+        dom_files.append((f"domains/d{di_idx:03d}.txt", "\n".join(levels)))
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.ini", "\n".join(ini) + "\n")
+        for fn, content in dom_files:
+            z.writestr(fn, content + "\n")
+    return path
+
+
+def _glm_layout(model):
+    """(columns cats-first, beta per-class in genmodel layout, cat meta).
+
+    GlmMojoModel.glmScore0 applies beta to RAW values: indicator betas by
+    catOffsets (use_all_factor_levels=true here — our one-hot keeps every
+    level), then raw numerics, intercept last; standardization is baked
+    out of the betas exactly like the reference writer does."""
+    di = model._dinfo
+    assert not (di.inter_pairs or di.inter_catcat or di.inter_catnum), \
+        "reference GLM MOJO export does not cover interaction columns"
+    cats, nums = list(di.cat_cols), list(di.num_cols)
+    cat_offsets = [0]
+    for c in cats:
+        cat_offsets.append(cat_offsets[-1] + di.cardinalities[c])
+    fam = model._state.family
+    if fam == "multinomial":
+        std = model._coefficients_std          # {name: [K betas]}
+        K = len(di.response_domain)
+        P = cat_offsets[-1] + len(nums) + 1
+        beta = np.zeros(K * P)
+        for k in range(K):
+            j = 0
+            icept = std["Intercept"][k]
+            for c in cats:
+                for lvl in di.domains[c]:
+                    beta[k * P + j] = std[f"{c}.{lvl}"][k]
+                    j += 1
+            for c in nums:
+                b = std[c][k]
+                if di.standardize:
+                    s = max(di.sigmas[c], 1e-10)
+                    beta[k * P + j] = b / s
+                    icept -= b * di.means[c] / s
+                else:
+                    beta[k * P + j] = b
+                j += 1
+            beta[k * P + P - 1] = icept
+    else:
+        raw = model._coefficients
+        # sparse fits keep STANDARDIZED betas in _coefficients
+        # (glm.py skips de-standardization there) — bake the scale out
+        # here so the MOJO's raw-space contract holds
+        destd = bool(di.standardize) and getattr(model, "_sparse_fit",
+                                                 False)
+        beta = np.zeros(cat_offsets[-1] + len(nums) + 1)
+        j = 0
+        icept = raw["Intercept"]
+        for c in cats:
+            for lvl in di.domains[c]:
+                beta[j] = raw[f"{c}.{lvl}"]
+                j += 1
+        for c in nums:
+            b = raw[c]
+            if destd:
+                s = max(di.sigmas[c], 1e-10)
+                beta[j] = b / s
+                icept -= b * di.means[c] / s
+            else:
+                beta[j] = b
+            j += 1
+        beta[-1] = icept
+    return cats, nums, cat_offsets, beta
+
+
+def _export_glm_mojo(model, path: str) -> str:
+    """hex/glm GlmMojoWriter: beta + cat offsets + link in [info]."""
+    di = model._dinfo
+    st = model._state
+    assert st.family in ("gaussian", "binomial", "poisson", "gamma",
+                         "tweedie", "multinomial"), \
+        f"reference GLM MOJO export: unsupported family {st.family}"
+    cats, nums, cat_offsets, beta = _glm_layout(model)
+    resp = di.response_name
+    columns = cats + nums + ([resp] if resp else [])
+    nclasses = len(di.response_domain) if di.response_domain else 1
+    category = ("Binomial" if nclasses == 2 else
+                "Multinomial" if nclasses > 2 else "Regression")
+    ini, kv = _ini_header("glm", "Generalized Linear Model", category,
+                          nclasses, columns, len(cats) + len(nums))
+    kv("use_all_factor_levels", "true")
+    kv("cats", len(cats))
+    # NA categoricals: the engine scores them as an all-zero indicator
+    # row; imputing the (out-of-range) cardinality makes GlmMojoModel's
+    # `ival < catOffsets[i+1]` guard skip the beta — zero contribution,
+    # exactly the engine's semantics
+    kv("cat_modes", _arr([di.cardinalities[c] for c in cats]))
+    kv("cat_offsets", _arr(cat_offsets))
+    kv("nums", len(nums))
+    kv("num_means", _arr([float(di.means[c]) for c in nums]))
+    kv("mean_imputation", "true" if di.impute_missing else "false")
+    kv("beta", _arr([float(b) for b in beta]))
+    kv("family", st.family)
+    kv("link", st.link)
+    kv("tweedie_link_power",
+       float(model.params.get("tweedie_link_power") or 0.0))
+    domains = {ci: list(di.domains[c]) for ci, c in enumerate(cats)}
+    if resp and di.response_domain:
+        domains[len(columns) - 1] = list(di.response_domain)
+    return _finish_zip(path, ini, columns, domains)
+
+
+def _export_kmeans_mojo(model, path: str) -> str:
+    """hex/kmeans KMeansMojoWriter: centers + standardization in [info]."""
+    di = model._dinfo
+    assert not di.cat_cols, \
+        "reference KMeans MOJO export covers numeric frames (categorical " \
+        "columns go through the one-hot design here, which the genmodel " \
+        "row codec does not mirror)"
+    nums = list(di.num_cols)
+    centers = np.asarray(model._centroids, np.float64)
+    ini, kv = _ini_header("kmeans", "K-means", "Clustering", 1, nums,
+                          len(nums), supervised=False)
+    std = bool(model.params.get("standardize"))
+    kv("standardize", "true" if std else "false")
+    if std:
+        kv("standardize_means", _arr([float(di.means[c]) for c in nums]))
+        kv("standardize_mults",
+           _arr([1.0 / max(float(di.sigmas[c]), 1e-10) for c in nums]))
+        kv("standardize_modes", _arr([-1] * len(nums)))
+    kv("center_num", centers.shape[0])
+    for i in range(centers.shape[0]):
+        kv(f"center_{i}", _arr([float(v) for v in centers[i]]))
+    return _finish_zip(path, ini, nums, {})
+
+
+def _export_dl_mojo(model, path: str) -> str:
+    """hex/deeplearning DeeplearningMojoWriter: per-layer weight/bias
+    arrays + input normalization in [info]."""
+    di = model._dinfo
+    act = str(model.params.get("activation") or "Rectifier")
+    assert "Maxout" not in act, \
+        "reference DL MOJO export: Maxout weight layout not covered"
+    assert not model.params.get("autoencoder"), \
+        "reference DL MOJO export covers supervised nets"
+    params = [(np.asarray(W, np.float64), np.asarray(b, np.float64))
+              for W, b in model._params_net]
+    cats, nums = list(di.cat_cols), list(di.num_cols)
+    # GenModel.setCats clamps NA (and out-of-range) categories onto the
+    # LAST level of each factor; the engine scores NA cats as an all-zero
+    # indicator. Export an explicit extra "NA" level per factor with a
+    # ZERO weight row so both scorers agree exactly.
+    cat_offsets = [0]
+    for c in cats:
+        cat_offsets.append(cat_offsets[-1] + di.cardinalities[c] + 1)
+    if cats:
+        W0, b0 = params[0]
+        rows = []
+        pos = 0
+        for c in cats:
+            card = di.cardinalities[c]
+            rows.append(W0[pos: pos + card])
+            rows.append(np.zeros((1, W0.shape[1])))      # the NA slot
+            pos += card
+        rows.append(W0[pos:])                            # numeric rows
+        params[0] = (np.vstack(rows), b0)
+    if not di.standardize and nums:
+        # no norm arrays means genmodel maps a missing numeric to RAW 0,
+        # while the engine imputes the training mean. Shift inputs by the
+        # means (norm_sub=mean, norm_mul=1) and fold the shift into the
+        # first-layer bias so non-missing rows are untouched and missing
+        # ones land on the mean — exact on both sides.
+        W0, b0 = params[0]
+        means = np.array([float(di.means[c]) for c in nums])
+        noff = cat_offsets[-1]
+        params[0] = (W0, b0 + means @ W0[noff: noff + len(nums)])
+    resp = di.response_name
+    columns = cats + nums + ([resp] if resp else [])
+    nclasses = len(di.response_domain) if di.response_domain else 1
+    category = ("Binomial" if nclasses == 2 else
+                "Multinomial" if nclasses > 2 else "Regression")
+    ini, kv = _ini_header("deeplearning", "Deep Learning", category,
+                          nclasses, columns, len(cats) + len(nums))
+    units = [params[0][0].shape[0]] + [b.shape[0] for _, b in params]
+    kv("mini_batch_size", 1)
+    kv("nums", len(nums))
+    kv("cats", len(cats))
+    kv("cat_offsets", _arr(cat_offsets))
+    if di.standardize:
+        kv("norm_sub", _arr([float(di.means[c]) for c in nums]))
+        kv("norm_mul",
+           _arr([1.0 / max(float(di.sigmas[c]), 1e-10) for c in nums]))
+    else:
+        # bias-folded mean shift (see above): missing -> post-norm 0 ==
+        # the training mean, non-missing values reproduce exactly
+        kv("norm_sub", _arr([float(di.means[c]) for c in nums]))
+        kv("norm_mul", _arr([1.0] * len(nums)))
+    kv("norm_resp_mul", "null")
+    kv("norm_resp_sub", "null")
+    kv("use_all_factor_levels", "true")
+    kv("activation", act)
+    kv("mean_imputation", "true" if di.impute_missing else "false")
+    kv("cat_modes", _arr([di.cardinalities[c] for c in cats]))
+    kv("distribution", "bernoulli" if nclasses == 2 else
+       "multinomial" if nclasses > 2 else "gaussian")
+    kv("neural_network_sizes", _arr(units))
+    kv("hidden_dropout_ratios", _arr([]))
+    for li, (W, b) in enumerate(params):
+        kv(f"bias_layer{li}", _arr([float(v) for v in b]))
+        # genmodel weight layout is (out, in) row-major; ours is (in, out)
+        kv(f"weight_layer{li}",
+           _arr([float(v) for v in W.T.reshape(-1)]))
+    domains = {ci: list(di.domains[c]) for ci, c in enumerate(cats)}
+    if resp and di.response_domain:
+        domains[len(columns) - 1] = list(di.response_domain)
+    return _finish_zip(path, ini, columns, domains)
+
+
+# ===========================================================================
+# Non-tree oracles: bit-faithful score0 re-implementations
+def _parse_arr(s, dtype=float):
+    s = s.strip()
+    if s in ("null", "[]", ""):
+        return np.array([], np.float64 if dtype is float else np.int64)
+    vals = [x.strip() for x in s.strip("[]").split(",") if x.strip()]
+    return np.array([dtype(v) for v in vals],
+                    np.float64 if dtype is float else np.int64)
+
+
+class H2OGlmMojoOracle:
+    """GlmMojoModel/GlmMultinomialMojoModel.glmScore0 re-implemented
+    exactly (float64, same eta accumulation order per class)."""
+
+    def __init__(self, info):
+        self.beta = _parse_arr(info["beta"])
+        self.cat_offsets = _parse_arr(info.get("cat_offsets", "[]"), int)
+        self.cats = int(info.get("cats", 0))
+        self.nums = int(info.get("nums", 0))
+        self.num_means = _parse_arr(info.get("num_means", "[]"))
+        self.cat_modes = _parse_arr(info.get("cat_modes", "[]"), int)
+        self.mean_imputation = info.get("mean_imputation") == "true"
+        self.use_all = info.get("use_all_factor_levels", "true") == "true"
+        self.family = info.get("family", "gaussian")
+        self.link = info.get("link", "identity")
+        self.tweedie_link_power = float(
+            info.get("tweedie_link_power") or 0.0)
+        self.n_classes = int(info.get("n_classes", 1))
+
+    def _link_eval(self, eta):
+        if self.link in ("identity", "family_default"):
+            return eta
+        if self.link == "logit":
+            return 1.0 / (1.0 + np.exp(-eta))
+        if self.link == "log":
+            return np.exp(eta)
+        if self.link == "inverse":
+            xx = np.where(np.abs(eta) < 1e-5, np.sign(eta) * 1e-5, eta)
+            return 1.0 / xx
+        if self.link == "ologit":
+            return 1.0 / (1.0 + np.exp(-eta))
+        if self.link == "tweedie":
+            # GenModel.GLM_tweedieInv
+            p = self.tweedie_link_power
+            if p == 0:
+                return np.maximum(2e-16, np.exp(eta))
+            return np.power(eta, 1.0 / p)
+        raise NotImplementedError(self.link)
+
+    def predict_raw(self, X):
+        """X (n, cats+nums): cat level codes then raw numerics."""
+        X = np.array(X, np.float64, copy=True)
+        if self.mean_imputation:
+            for i in range(self.cats):
+                X[np.isnan(X[:, i]), i] = self.cat_modes[i]
+            for i in range(self.nums):
+                j = self.cats + i
+                X[np.isnan(X[:, j]), j] = self.num_means[i]
+        n = X.shape[0]
+        if self.family == "multinomial":
+            K = self.n_classes
+            P = len(self.beta) // K
+            etas = np.zeros((n, K))
+            for k in range(K):
+                b = self.beta[k * P:(k + 1) * P]
+                etas[:, k] = self._eta(X, b)
+            m = np.maximum(etas.max(1), 0.0)       # reference max_row
+            #                                         starts at 0
+            E = np.exp(etas - m[:, None])
+            return E / E.sum(1, keepdims=True)
+        mu = self._link_eval(self._eta(X, self.beta))
+        if self.family in ("binomial", "fractionalbinomial"):
+            return np.stack([1.0 - mu, mu], 1)
+        return mu
+
+    def _eta(self, X, beta):
+        n = X.shape[0]
+        eta = np.zeros(n)
+        noff = (self.cat_offsets[self.cats] - self.cats
+                if self.cats else 0)
+        for i in range(self.cats):
+            raw = X[:, i]
+            # un-imputed NaN contributes nothing (engine zero-row parity)
+            raw = np.where(np.isnan(raw), -(1 << 30), raw)
+            ival = raw.astype(np.int64) + (0 if self.use_all else -1)
+            ival = ival + self.cat_offsets[i]
+            ok = (ival < self.cat_offsets[i + 1]) & \
+                (ival >= self.cat_offsets[i])
+            if not self.use_all:
+                ok &= X[:, i] != 0
+            eta += np.where(ok, beta[np.clip(ival, 0, len(beta) - 1)], 0.0)
+        for i in range(self.cats, len(beta) - 1 - noff):
+            eta += beta[noff + i] * X[:, self.cats + (i - self.cats)]
+        return eta + beta[-1]
+
+
+class H2OKMeansMojoOracle:
+    """KMeansMojoModel.score0: Kmeans_preprocessData + KMeans_closest."""
+
+    def __init__(self, info):
+        self.standardize = info.get("standardize") == "true"
+        k = int(info["center_num"])
+        self.centers = np.stack([_parse_arr(info[f"center_{i}"])
+                                 for i in range(k)])
+        if self.standardize:
+            self.means = _parse_arr(info["standardize_means"])
+            self.mults = _parse_arr(info["standardize_mults"])
+            self.modes = _parse_arr(info["standardize_modes"], int)
+
+    def predict_raw(self, X):
+        X = np.array(X, np.float64, copy=True)
+        if self.standardize:
+            for i in range(X.shape[1]):
+                if self.modes[i] == -1:
+                    na = np.isnan(X[:, i])
+                    X[na, i] = self.means[i]
+                    X[:, i] = (X[:, i] - self.means[i]) * self.mults[i]
+                else:
+                    X[np.isnan(X[:, i]), i] = self.modes[i]
+        d2 = ((X[:, None, :] - self.centers[None, :, :]) ** 2).sum(-1)
+        return d2.argmin(1)
+
+
+class H2ODlMojoOracle:
+    """DeeplearningMojoModel.score0: one-hot cats, normalized nums,
+    dense layers with the stored activation, softmax for classifiers."""
+
+    def __init__(self, info):
+        self.cats = int(info.get("cats", 0))
+        self.nums = int(info.get("nums", 0))
+        self.cat_offsets = _parse_arr(info.get("cat_offsets", "[]"), int)
+        self.norm_sub = _parse_arr(info.get("norm_sub", "[]"))
+        self.norm_mul = _parse_arr(info.get("norm_mul", "[]"))
+        self.cat_modes = _parse_arr(info.get("cat_modes", "[]"), int)
+        self.mean_imputation = info.get("mean_imputation") == "true"
+        self.activation = info.get("activation", "Rectifier")
+        self.units = _parse_arr(info["neural_network_sizes"], int)
+        self.n_classes = int(info.get("n_classes", 1))
+        self.layers = []
+        li = 0
+        while f"weight_layer{li}" in info:
+            W = _parse_arr(info[f"weight_layer{li}"])
+            b = _parse_arr(info[f"bias_layer{li}"])
+            nin, nout = self.units[li], self.units[li + 1]
+            # stored (out, in) row-major -> back to (in, out)
+            self.layers.append((W.reshape(nout, nin).T, b))
+            li += 1
+
+    def _act(self, z):
+        if "Rectifier" in self.activation:
+            return np.maximum(z, 0.0)
+        if "Tanh" in self.activation:
+            return np.tanh(z)
+        raise NotImplementedError(self.activation)
+
+    def predict_raw(self, X):
+        X = np.array(X, np.float64, copy=True)
+        n = X.shape[0]
+        ncat_in = int(self.cat_offsets[-1]) if self.cats else 0
+        H = np.zeros((n, ncat_in + self.nums))
+        for i in range(self.cats):
+            codes = X[:, i]
+            # GenModel.setCats: NaN -> the extra trailing NA level;
+            # out-of-range clamps onto that same last slot
+            idx = np.where(np.isnan(codes), self.cat_offsets[i + 1] - 1,
+                           np.nan_to_num(codes) + self.cat_offsets[i])
+            idx = np.minimum(idx, self.cat_offsets[i + 1] - 1).astype(np.int64)
+            H[np.arange(n), idx] = 1.0
+        for i in range(self.nums):
+            v = X[:, self.cats + i]
+            if len(self.norm_sub):
+                v = (v - self.norm_sub[i]) * self.norm_mul[i]
+            H[:, ncat_in + i] = np.nan_to_num(v)
+        for W, b in self.layers[:-1]:
+            H = self._act(H @ W + b)
+        W, b = self.layers[-1]
+        out = H @ W + b
+        if self.n_classes >= 2:
+            out = out - out.max(1, keepdims=True)
+            E = np.exp(out)
+            return E / E.sum(1, keepdims=True)
+        return out[:, 0]
+
+
+_ORACLES = {"glm": H2OGlmMojoOracle, "kmeans": H2OKMeansMojoOracle,
+            "deeplearning": H2ODlMojoOracle}
+
+
+def import_h2o_mojo_any(path: str):
+    """Dispatch loader: tree MOJOs go through the TPU batch scorer
+    (import_h2o_mojo); GLM/KMeans/DL go to the exact-score0 oracles."""
+    with zipfile.ZipFile(path) as z:
+        info, _, _ = _parse_ini(z.read("model.ini").decode("utf-8",
+                                                           "replace"))
+    algo = info.get("algo", "gbm")
+    if algo in _ORACLES:
+        return _ORACLES[algo](info)
+    return import_h2o_mojo(path)
