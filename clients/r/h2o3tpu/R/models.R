@@ -134,7 +134,25 @@ h2o.performance <- function(model, newdata = NULL) {
 
 h2o.auc <- function(model) .h2o.metric(model, "auc")
 h2o.rmse <- function(model) .h2o.metric(model, "rmse")
+h2o.mse <- function(model) .h2o.metric(model, "mse")
 h2o.logloss <- function(model) .h2o.metric(model, "logloss")
+
+#' GLM coefficients as a named list (h2o-r h2o.coef analog).
+h2o.coef <- function(model) {
+  m <- .h2o.GET(paste0("/3/Models/", model$key))$models
+  m$output$coefficients_table
+}
+
+#' KMeans cluster centers as a matrix (h2o-r h2o.centers analog).
+h2o.centers <- function(model) {
+  m <- .h2o.GET(paste0("/3/Models/", model$key))$models
+  # jsonlite simplifies models to a 1-row data.frame whose centers cell
+  # already holds the k x d matrix
+  cen <- m$output$centers
+  if (is.list(cen) && length(cen) == 1) cen <- cen[[1]]
+  if (!is.matrix(cen)) cen <- do.call(rbind, lapply(cen, unlist))
+  cen
+}
 
 h2o.varimp <- function(model) {
   m <- .h2o.GET(paste0("/3/Models/", model$key))$models

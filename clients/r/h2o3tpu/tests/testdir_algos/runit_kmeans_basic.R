@@ -1,4 +1,14 @@
-# runit: kmeans_basic (h2o-r/tests/testdir_algos analog) — through REST.
+# runit: KMeans (runit_kmeans.R): recovered centers match base R kmeans()
+# on well-separated blobs (matched by nearest-center pairing).
 source("../runit_utils.R")
-fr <- test_frame(300, 4); m <- h2o.kmeans(training_frame = fr, x = c('x', 'y'), k = 3); expect_true(!is.null(m$key))
+set.seed(24)
+df <- data.frame(x = c(rnorm(50, -5), rnorm(50, 5)),
+                 y = c(rnorm(50, -5), rnorm(50, 5)))
+fr <- as.h2o(df)
+m <- h2o.kmeans(training_frame = fr, k = 2, standardize = FALSE)
+cen <- h2o.centers(m)
+rk <- kmeans(df, 2, nstart = 5)
+ours <- cen[order(cen[, 1]), ]
+theirs <- rk$centers[order(rk$centers[, 1]), ]
+expect_equal(as.numeric(unlist(ours)), as.numeric(theirs), tol = 0.5)
 cat("runit_kmeans_basic: PASS\n")

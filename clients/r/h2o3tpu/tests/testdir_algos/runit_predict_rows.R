@@ -1,4 +1,14 @@
-# runit: predict_rows (h2o-r/tests/testdir_algos analog) — through REST.
+# runit: predict frame contract (runit_predict.R): one prediction per
+# input row, finite, reproducible across calls.
 source("../runit_utils.R")
-fr <- test_frame(200, 5); m <- h2o.gbm(y = 'y', training_frame = fr, ntrees = 3); p <- h2o.predict(m, fr); expect_equal(h2o.nrow(p), 200)
+set.seed(25)
+df <- data.frame(x = rnorm(150)); df$y <- df$x * 3 + rnorm(150, 0, 0.1)
+fr <- as.h2o(df)
+m <- h2o.gbm(y = "y", training_frame = fr, ntrees = 10, max_depth = 3)
+p1 <- as.data.frame(h2o.predict(m, fr))
+p2 <- as.data.frame(h2o.predict(m, fr))
+expect_equal(nrow(p1), nrow(df))
+expect_true(all(is.finite(p1[[1]])))
+expect_equal(p1[[1]], p2[[1]], tol = 1e-7)
+expect_equal(cor(p1[[1]], df$y) > 0.99, TRUE)
 cat("runit_predict_rows: PASS\n")

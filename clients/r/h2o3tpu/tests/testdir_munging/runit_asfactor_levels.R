@@ -1,4 +1,11 @@
-# runit: asfactor_levels (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: as.factor / levels (runit_asfactor.R): domain equals base R levels.
 source("../runit_utils.R")
-fr <- test_frame(); g <- h2o.asfactor(fr$g); expect_equal(sort(unlist(h2o.levels(g))), c('a','b','c'))
+df <- data.frame(g = c("b","a","c","a","b","b"), stringsAsFactors = FALSE)
+fr <- as.h2o(df)
+fac <- h2o.asfactor(fr$g)
+expect_equal(sort(unlist(h2o.levels(fac))), sort(levels(factor(df$g))))
+tab <- as.data.frame(h2o.table(fac))
+tab <- tab[order(tab[[1]]), ]
+exp_t <- as.data.frame(table(df$g))
+expect_equal(as.integer(tab[[2]]), as.integer(exp_t$Freq))
 cat("runit_asfactor_levels: PASS\n")

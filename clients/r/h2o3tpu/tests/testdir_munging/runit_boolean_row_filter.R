@@ -1,4 +1,10 @@
-# runit: boolean_row_filter (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: row filter (runit_rowselect.R): boolean slicing returns exactly
+# base R's subset, in order.
 source("../runit_utils.R")
-fr <- test_frame(); z <- fr[fr$x > 0, ]; expect_true(h2o.nrow(z) < 100)
+set.seed(6); df <- data.frame(x = rnorm(50), y = rnorm(50))
+fr <- as.h2o(df)
+sub <- as.data.frame(fr[fr$x > 0, ])
+expect_equal(nrow(sub), sum(df$x > 0))
+expect_equal(sub$x, df$x[df$x > 0], tol = 1e-6)
+expect_equal(sub$y, df$y[df$x > 0], tol = 1e-6)
 cat("runit_boolean_row_filter: PASS\n")

@@ -1,4 +1,9 @@
-# runit: compare_ops (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: comparisons (runit_binop2_gt.R family): 0/1 masks equal base R.
 source("../runit_utils.R")
-fr <- test_frame(); z <- fr$x > 0; expect_true(h2o.mean(z) > 0.2 && h2o.mean(z) < 0.8)
+set.seed(5); df <- data.frame(x = rnorm(70), y = rnorm(70))
+fr <- as.h2o(df)
+expect_equal(as.data.frame(fr$x > fr$y)[[1]], as.numeric(df$x > df$y))
+expect_equal(as.data.frame(fr$x <= 0)[[1]], as.numeric(df$x <= 0))
+expect_equal(as.data.frame(fr$x == fr$x)[[1]], rep(1, 70))
+expect_equal(h2o.sum(fr$x != fr$y), sum(df$x != df$y))
 cat("runit_compare_ops: PASS\n")

@@ -1,4 +1,11 @@
-# runit: cut_bins (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: cut (runit_cut.R): bin assignment counts must equal base R cut().
 source("../runit_utils.R")
-fr <- test_frame(); z <- h2o.cut(fr$x, c(-10, 0, 10)); expect_equal(h2o.nrow(z), 100)
+set.seed(4); df <- data.frame(x = rnorm(120))
+fr <- as.h2o(df)
+breaks <- c(-10, -1, 0, 1, 10)
+z <- as.data.frame(h2o.cut(fr$x, breaks))
+expected <- table(cut(df$x, breaks))
+got <- table(z[[1]])
+expect_equal(as.integer(got[order(names(got))]),
+             as.integer(expected[order(names(expected))]))
 cat("runit_cut_bins: PASS\n")

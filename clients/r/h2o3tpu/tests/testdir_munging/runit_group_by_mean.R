@@ -1,4 +1,16 @@
-# runit: group_by_mean (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: group_by (runit_groupby.R): per-group aggregates must equal
+# base R aggregate() on the same data, row-matched by group key.
 source("../runit_utils.R")
-fr <- test_frame(); gb <- h2o.group_by(fr, 'g', 'mean', 'x'); expect_equal(h2o.nrow(gb), 3)
+set.seed(3)
+df <- data.frame(g = sample(c("a","b","c"), 90, TRUE), x = rnorm(90),
+                 stringsAsFactors = FALSE)
+fr <- as.h2o(df)
+gb <- as.data.frame(h2o.group_by(fr, "g", "mean", "x"))
+exp_m <- aggregate(x ~ g, df, mean)
+gb <- gb[order(gb[[1]]), ]; exp_m <- exp_m[order(exp_m$g), ]
+expect_equal(gb[[2]], exp_m$x, tol = 1e-5)
+gs <- as.data.frame(h2o.group_by(fr, "g", "sum", "x"))
+exp_s <- aggregate(x ~ g, df, sum)
+gs <- gs[order(gs[[1]]), ]; exp_s <- exp_s[order(exp_s$g), ]
+expect_equal(gs[[2]], exp_s$x, tol = 1e-4)
 cat("runit_group_by_mean: PASS\n")

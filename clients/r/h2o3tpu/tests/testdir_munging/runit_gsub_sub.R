@@ -1,4 +1,14 @@
-# runit: gsub_sub (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: gsub/sub/toupper/trim (runit_gsub.R family): string munging
+# equals base R on the same vector.
 source("../runit_utils.R")
-fr <- test_frame(); z <- h2o.gsub('Str', 'X', fr$s); expect_equal(h2o.nrow(z), 100)
+df <- data.frame(s = c(" foo bar ", "bar foo", "foofoo "),
+                 stringsAsFactors = FALSE)
+fr <- as.h2o(df)
+expect_equal(as.data.frame(h2o.gsub("foo", "X", fr$s))[[1]],
+             gsub("foo", "X", df$s))
+expect_equal(as.data.frame(h2o.sub("foo", "X", fr$s))[[1]],
+             sub("foo", "X", df$s))
+expect_equal(as.data.frame(h2o.toupper(fr$s))[[1]], toupper(df$s))
+expect_equal(as.data.frame(h2o.trim(fr$s))[[1]], trimws(df$s))
+expect_equal(as.data.frame(h2o.nchar(fr$s))[[1]], nchar(df$s))
 cat("runit_gsub_sub: PASS\n")

@@ -1,4 +1,7 @@
-# runit: ifelse_clip (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: ifelse (runit_ifelse.R): vectorized conditional equals base R.
 source("../runit_utils.R")
-fr <- test_frame(); z <- h2o.ifelse(fr$x > 0, 1, 0); expect_true(h2o.max(z) <= 1)
+set.seed(8); df <- data.frame(x = rnorm(60))
+fr <- as.h2o(df)
+clipped <- as.data.frame(h2o.ifelse(fr$x > 0, fr$x, 0))
+expect_equal(clipped[[1]], ifelse(df$x > 0, df$x, 0), tol = 1e-6)
 cat("runit_ifelse_clip: PASS\n")

@@ -1,4 +1,14 @@
-# runit: math_ops (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: math_ops (runit_log.R / runit_sqrt.R family): unary math parity
+# against base R, including reductions.
 source("../runit_utils.R")
-fr <- test_frame(); z <- abs(fr$x); expect_true(h2o.min(z) >= 0); z2 <- exp(fr$x); expect_true(h2o.min(z2) > 0)
+set.seed(2); df <- data.frame(x = runif(80) + 0.1)
+fr <- as.h2o(df)
+expect_equal(as.data.frame(h2o.log(fr$x))[[1]], log(df$x), tol = 1e-5)
+expect_equal(as.data.frame(h2o.sqrt(fr$x))[[1]], sqrt(df$x), tol = 1e-5)
+expect_equal(as.data.frame(h2o.exp(fr$x))[[1]], exp(df$x), tol = 1e-4)
+expect_equal(as.data.frame(h2o.abs(fr$x - 0.5))[[1]], abs(df$x - 0.5), tol = 1e-5)
+expect_equal(h2o.mean(fr$x), mean(df$x), tol = 1e-5)
+expect_equal(h2o.sd(fr$x), sd(df$x), tol = 1e-5)
+expect_equal(h2o.sum(fr$x), sum(df$x), tol = 1e-3)
+expect_equal(h2o.median(fr$x), median(df$x), tol = 1e-4)
 cat("runit_math_ops: PASS\n")

@@ -1,4 +1,8 @@
-# runit: mean_sd (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: mean/sd/var reductions vs base R (runit_summary.R family).
 source("../runit_utils.R")
-fr <- test_frame(); m <- h2o.mean(fr$x); expect_true(abs(m) < 0.5); expect_true(h2o.sd(fr$x) > 0.5)
+set.seed(10); df <- data.frame(x = rnorm(100, 3, 2))
+fr <- as.h2o(df)
+expect_equal(h2o.mean(fr$x), mean(df$x), tol = 1e-5)
+expect_equal(h2o.sd(fr$x), sd(df$x), tol = 1e-5)
+expect_equal(h2o.var(fr$x), var(df$x), tol = 1e-4)
 cat("runit_mean_sd: PASS\n")

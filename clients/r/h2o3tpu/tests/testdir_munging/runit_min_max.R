@@ -1,4 +1,7 @@
-# runit: min_max (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: min/max vs base R.
 source("../runit_utils.R")
-fr <- test_frame(); expect_true(h2o.min(fr$x) < h2o.max(fr$x))
+set.seed(11); df <- data.frame(x = rnorm(80))
+fr <- as.h2o(df)
+expect_equal(h2o.min(fr$x), min(df$x), tol = 1e-6)
+expect_equal(h2o.max(fr$x), max(df$x), tol = 1e-6)
 cat("runit_min_max: PASS\n")

@@ -1,4 +1,8 @@
-# runit: nrow_ncol (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: dim / names parity.
 source("../runit_utils.R")
-fr <- test_frame(); expect_equal(h2o.nrow(fr), 100); expect_equal(h2o.ncol(fr), 4)
+df <- data.frame(a = 1:25, b = 26:50)
+fr <- as.h2o(df)
+expect_equal(h2o.nrow(fr), nrow(df))
+expect_equal(h2o.ncol(fr), ncol(df))
+expect_equal(h2o.colnames(fr), names(df))
 cat("runit_nrow_ncol: PASS\n")

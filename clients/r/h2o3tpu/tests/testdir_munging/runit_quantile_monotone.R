@@ -1,4 +1,9 @@
-# runit: quantile_monotone (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: quantiles vs base R type-7-adjacent estimates (runit_quantile.R).
 source("../runit_utils.R")
-fr <- test_frame(); q <- h2o.quantile(fr$x, c(0.25, 0.5, 0.75)); expect_equal(h2o.nrow(q), 3)
+set.seed(12); df <- data.frame(x = rnorm(500))
+fr <- as.h2o(df)
+qs <- h2o.quantile(fr$x, probs = c(0.1, 0.5, 0.9))
+rq <- quantile(df$x, c(0.1, 0.5, 0.9), names = FALSE)
+expect_true(all(diff(qs) > 0))
+expect_equal(qs, rq, tol = 0.05)     # interpolation schemes differ slightly
 cat("runit_quantile_monotone: PASS\n")

@@ -1,4 +1,8 @@
-# runit: row_slice (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: integer row slice keeps exact values and order.
 source("../runit_utils.R")
-fr <- test_frame(); z <- fr[1:10, ]; expect_equal(h2o.nrow(z), 10)
+set.seed(13); df <- data.frame(x = rnorm(40))
+fr <- as.h2o(df)
+idx <- c(5, 1, 17, 33)
+sub <- as.data.frame(fr[idx, ])
+expect_equal(sub[[1]], df$x[idx], tol = 1e-6)
 cat("runit_row_slice: PASS\n")

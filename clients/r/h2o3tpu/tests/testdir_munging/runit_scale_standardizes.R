@@ -1,4 +1,7 @@
-# runit: scale_standardizes (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: h2o.scale vs base R scale() (runit_scale.R).
 source("../runit_utils.R")
-fr <- test_frame(); z <- h2o.scale(fr[, c('x','y')]); expect_true(abs(h2o.mean(z[, 'x'])) < 1e-5)
+set.seed(14); df <- data.frame(x = rnorm(90, 5, 3))
+fr <- as.h2o(df)
+sc <- as.data.frame(h2o.scale(fr$x))
+expect_equal(sc[[1]], as.numeric(scale(df$x)), tol = 1e-4)
 cat("runit_scale_standardizes: PASS\n")

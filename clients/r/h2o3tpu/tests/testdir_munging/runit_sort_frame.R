@@ -1,4 +1,8 @@
-# runit: sort_frame (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: h2o.arrange vs base R order() (runit_sort.R).
 source("../runit_utils.R")
-fr <- test_frame(); s <- h2o.arrange(fr, 'x'); expect_equal(h2o.nrow(s), 100)
+set.seed(15); df <- data.frame(x = rnorm(60), y = rnorm(60))
+fr <- as.h2o(df)
+srt <- as.data.frame(h2o.arrange(fr, "x"))
+expect_equal(srt$x, sort(df$x), tol = 1e-6)
+expect_equal(srt$y, df$y[order(df$x)], tol = 1e-6)
 cat("runit_sort_frame: PASS\n")

@@ -1,4 +1,9 @@
-# runit: string_prims (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: substring/strsplit/tolower vs base R.
 source("../runit_utils.R")
-fr <- test_frame(); up <- h2o.toupper(h2o.trim(fr$s)); nc <- h2o.nchar(up); expect_true(h2o.min(nc) >= 4)
+df <- data.frame(s = c("Hello World", "Foo", "Bar Baz"),
+                 stringsAsFactors = FALSE)
+fr <- as.h2o(df)
+expect_equal(as.data.frame(h2o.tolower(fr$s))[[1]], tolower(df$s))
+expect_equal(as.data.frame(h2o.substring(fr$s, 1, 3))[[1]],
+             substring(df$s, 1, 3))
 cat("runit_string_prims: PASS\n")

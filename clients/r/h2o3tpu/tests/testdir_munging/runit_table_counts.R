@@ -1,4 +1,12 @@
-# runit: table_counts (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: h2o.table vs base R table() (runit_table.R).
 source("../runit_utils.R")
-fr <- test_frame(); tb <- h2o.table(fr$g); expect_equal(h2o.nrow(tb), 3)
+set.seed(16)
+df <- data.frame(g = sample(c("u","v","w"), 120, TRUE, c(.5,.3,.2)),
+                 stringsAsFactors = FALSE)
+fr <- as.h2o(df)
+tab <- as.data.frame(h2o.table(h2o.asfactor(fr$g)))
+tab <- tab[order(tab[[1]]), ]
+exp_t <- as.data.frame(table(df$g))
+expect_equal(as.character(tab[[1]]), as.character(exp_t$Var1))
+expect_equal(as.integer(tab[[2]]), as.integer(exp_t$Freq))
 cat("runit_table_counts: PASS\n")

@@ -1,4 +1,7 @@
-# runit: unique_vals (h2o-r/tests/testdir_munging analog) — through REST/Rapids.
+# runit: h2o.unique vs base R unique().
 source("../runit_utils.R")
-fr <- test_frame(); u <- h2o.unique(fr$g); expect_equal(h2o.nrow(u), 3)
+df <- data.frame(x = c(3, 1, 3, 2, 1, 1))
+fr <- as.h2o(df)
+u <- as.data.frame(h2o.unique(fr$x))
+expect_equal(sort(u[[1]]), sort(unique(df$x)))
 cat("runit_unique_vals: PASS\n")
