@@ -1,0 +1,132 @@
+"""Flow notebook (api/flow.py) — h2o-web Flow analog: cell model with
+assist, frame/model browser panes, inline metric plots (SVG from the
+model JSON's scoring_history/varimp), and .flow JSON interchange.
+
+The JS cell runner drives ONLY public REST routes; these tests replay
+the exact request sequence each cell type issues (the scripted-browser
+contract), plus structural checks on the shipped page."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.api import flow
+from h2o3_tpu.api.server import H2OServer
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get(s, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{s.port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(s, path, **data):
+    body = urllib.parse.urlencode(data).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{s.port}{path}",
+                                 data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def _wait(s, key):
+    for _ in range(300):
+        j = _get(s, "/3/Jobs/" + urllib.parse.quote(key, safe=""))["jobs"][0]
+        if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+            return j
+        time.sleep(0.2)
+    raise TimeoutError
+
+
+def test_page_ships_notebook_features():
+    html = flow.NOTEBOOK_HTML
+    for feature in ("assist(", "importFiles", "buildModel",
+                    "parse &rarr; train &rarr; predict",   # pipeline assist
+                    "framelist", "modellist",              # browser panes
+                    "sparkline", "varimpBars", "plotModel",  # inline plots
+                    "exportFlow", "importFlow", ".flow",   # interchange
+                    "NodePersistentStorage/notebooks"):    # persistence
+        assert feature in html, feature
+
+
+def test_cell_pipeline_parse_train_predict(server, tmp_path):
+    """The 'pipeline' assist's three cells, replayed exactly as the JS
+    issues them: import -> build (job-waited) -> predict."""
+    rng = np.random.default_rng(0)
+    csv = tmp_path / "flow_train.csv"
+    with open(csv, "w") as fh:
+        fh.write("a,b,y\n")
+        for i in range(200):
+            a, b = rng.normal(), rng.normal()
+            fh.write(f"{a},{b},{a * 2 + b + rng.normal() * .1}\n")
+    # import cell: POST /3/Parse with the cell's URLSearchParams body
+    r = _post(server, "/3/Parse", source_frames=str(csv),
+              destination_frame="flow_train")
+    _wait(server, r["job"]["key"])
+    assert DKV.get("flow_train").nrows == 200
+    # build cell: POST /3/ModelBuilders/gbm
+    r = _post(server, "/3/ModelBuilders/gbm", training_frame="flow_train",
+              response_column="y", ntrees="10", max_depth="3",
+              model_id="flow_gbm")
+    j = _wait(server, r["job"]["key"])
+    assert j["status"] == "DONE"
+    # the build cell then fetches the model JSON for its inline plot:
+    # scoring_history (sparkline) + varimp (bars) must be present
+    mj = _get(server, "/3/Models/flow_gbm")["models"][0]
+    assert len(mj["scoring_history"]) >= 2
+    assert mj["variable_importances"][0]["variable"] in ("a", "b")
+    # predict cell
+    r = _post(server, "/3/Predictions/models/flow_gbm/frames/flow_train",
+              predictions_frame="flow_preds")
+    pf = DKV.get("flow_preds")
+    assert pf is not None and pf.nrows == 200
+    # browser panes: both registries list the new artifacts
+    frames = [f["frame_id"]["name"]
+              for f in _get(server, "/3/Frames")["frames"]]
+    models = [m["model_id"] for m in _get(server, "/3/Models")["models"]]
+    assert "flow_train" in frames and "flow_gbm" in models
+    for k in ("flow_train", "flow_gbm", "flow_preds"):
+        DKV.remove(k)
+
+
+def test_notebook_nps_roundtrip(server):
+    cells = [{"type": "markdown", "src": "# t"},
+             {"type": "rapids", "src": "(+ 1 2)"}]
+    _post(server, "/3/NodePersistentStorage/notebooks/nb_t",
+          value=json.dumps(cells))
+    out = _get(server, "/3/NodePersistentStorage/notebooks/nb_t")
+    assert json.loads(out["value"]) == cells
+
+
+def test_flow_doc_shape_roundtrip():
+    """exportFlow/importFlow JS must round-trip the reference .flow doc
+    shape {version, cells:[{type:'cs'|'md', input}]}; mirror the JS
+    transform here to pin the mapping."""
+    ours = [{"type": "markdown", "src": "# hi"},
+            {"type": "build", "src": "algo=gbm&training_frame=t"},
+            {"type": "rapids", "src": "(+ 1 2)"}]
+    doc = {"version": "1.0.0", "cells": [
+        {"type": "md", "input": c["src"]} if c["type"] == "markdown"
+        else {"type": "cs", "input": f"{c['type']} {c['src']}"}
+        for c in ours]}
+    back = []
+    for c in doc["cells"]:
+        if c["type"] == "md":
+            back.append({"type": "markdown", "src": c["input"]})
+        else:
+            head, _, rest = c["input"].partition(" ")
+            assert head in ("rapids", "import", "build", "predict",
+                            "inspect")
+            back.append({"type": head, "src": rest})
+    assert back == ours
