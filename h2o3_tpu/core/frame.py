@@ -17,9 +17,12 @@ TPU-native design:
     for free — the moral equivalent of Chunk.atd() inlined into the map loop.
   * Rows are padded to a multiple of (row-shards × 8) — H2O's uneven ESPC
     chunking becomes even tiling + a padding mask.
-  * Strings/UUIDs stay on the host (numpy object arrays): every H2O compute
-    path over strings is row-local munging, which we run host-side; numeric /
-    categorical / time columns live in HBM.
+  * Strings live on DEVICE as a dictionary-coded plane (StrVec below:
+    int32 codes in HBM + a host-side unique-string table), so string
+    munging (strlen/toupper/substring/…) runs O(unique) host-side and
+    O(rows) on device; UUIDs remain host numpy object arrays (C16Chunk
+    has no device analog yet); numeric / categorical / time columns live
+    in HBM.
   * Rollups are computed lazily in one fused jit pass and cached, invalidated
     on write — same contract as RollupStats.
 """
@@ -430,6 +433,107 @@ def _remap_codes(codes, tbl):
 def _gather_level_f32(codes, tbl):
     safe = jnp.clip(codes, 0, tbl.shape[0] - 1)
     return jnp.where(codes >= 0, jnp.take(tbl, safe), jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+class UuidVec(Vec):
+    """Device-resident UUID column — the C16Chunk analog
+    (water/fvec/C16Chunk.java stores each UUID as two longs in the chunk).
+
+    TPU-native representation: the 128-bit value lives ON DEVICE as four
+    row-sharded int32 lanes (padded, 4) — XLA has no native u128 and TPU
+    x64 is off by default, so the C16 "two longs" become four words. NA is
+    a separate device i32 mask lane (C16's NA sentinel is a reserved
+    bit-pattern; a mask lane avoids stealing one of the 2^128 values).
+    Supported compute is what the reference supports on UUIDs: equality /
+    NA predicates (device-side lane compares) and pass-through storage;
+    arithmetic intentionally raises, as in water.fvec.Vec."""
+
+    def __init__(self, words_dev, na_dev, nrows: int):
+        self.words = words_dev              # (padded, 4) i32
+        self.na = na_dev                    # (padded,) i32 1 = NA/padding
+        super().__init__(None, Codec("const"), None, nrows, T_UUID)
+
+    @staticmethod
+    def encode(col: np.ndarray) -> "UuidVec":
+        """Host UUID strings/objects -> device word lanes."""
+        import uuid as _uuidlib
+        c = _mesh.cloud()
+        n = len(col)
+        pad = c.padded_rows(n)
+        words = np.zeros((pad, 4), np.int32)
+        na = np.ones(pad, np.int32)
+        for i, s in enumerate(col):
+            if s is None or (isinstance(s, float) and math.isnan(s)) \
+                    or (isinstance(s, str) and not s.strip()):
+                continue
+            try:
+                v = (_uuidlib.UUID(str(s).strip()).int
+                     if not isinstance(s, _uuidlib.UUID) else s.int)
+            except (ValueError, AttributeError):
+                continue                 # malformed token -> NA (C16 NA)
+            for w in range(4):
+                u = (v >> (32 * (3 - w))) & 0xFFFFFFFF
+                words[i, w] = np.int64(u - (1 << 32) if u >= (1 << 31)
+                                       else u)
+            na[i] = 0
+        return UuidVec(_mr.device_put_rows(words),
+                       _mr.device_put_rows(na), n)
+
+    # ---- Vec surface -----------------------------------------------------
+    @property
+    def padded_len(self) -> int:
+        return int(self.words.shape[0])
+
+    @property
+    def host_data(self):
+        """Decode to an object array of uuid.UUID (on demand only)."""
+        import uuid as _uuidlib
+        W = np.asarray(_mr.host_fetch(self.words))[: self.nrows]
+        na = np.asarray(_mr.host_fetch(self.na))[: self.nrows]
+        out = np.empty(self.nrows, object)
+        for i in range(self.nrows):
+            if na[i]:
+                continue
+            v = 0
+            for w in range(4):
+                v = (v << 32) | (int(W[i, w]) & 0xFFFFFFFF)
+            out[i] = _uuidlib.UUID(int=v)
+        return out
+
+    @host_data.setter
+    def host_data(self, v):
+        if v is not None:
+            raise AttributeError("UuidVec host_data is derived")
+
+    def to_numpy(self) -> np.ndarray:
+        return self.host_data
+
+    def as_f32(self):
+        raise TypeError("UUID Vec has no numeric view (C16Chunk atd "
+                        "throws in the reference too)")
+
+    def eq(self, other: "UuidVec") -> jax.Array:
+        """(padded,) f32 0/1 row equality, computed on device."""
+        return _uuid_eq(self.words, self.na, other.words, other.na)
+
+    def isna_f32(self) -> jax.Array:
+        return jnp.asarray(self.na, jnp.float32)
+
+    def na_cnt(self) -> int:
+        return int(_mr.host_fetch(self.na)[: self.nrows].sum())
+
+    def _compute_rollups(self) -> Rollups:
+        return Rollups(min=math.nan, max=math.nan, mean=math.nan,
+                       sigma=math.nan, nas=self.na_cnt(), zeros=0,
+                       is_int=False)
+
+
+@jax.jit
+def _uuid_eq(wa, na_a, wb, na_b):
+    same = jnp.all(wa == wb, axis=1)
+    ok = (na_a == 0) & (na_b == 0)
+    return jnp.where(ok & same, 1.0, 0.0).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -39,7 +39,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from h2o3_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
+from h2o3_tpu.core.frame import (Frame, T_CAT, T_NUM, T_STR, T_TIME,
+                                 T_UUID, Vec)
 from h2o3_tpu.io.parser import (NA_TOKENS, ParseSetup, _num_token,
                                 _parse_time_ms, parse_setup)
 
@@ -219,6 +220,12 @@ def parse_files(paths, setup: Optional[ParseSetup] = None,
                 [_chunk_tokens(*p) for p in parts]) if parts else \
                 np.empty(0, object)
             vecs.append(Vec.from_numpy(toks, type=T_STR))
+        elif t == T_UUID:
+            from h2o3_tpu.core.frame import UuidVec
+            toks = np.concatenate(
+                [_chunk_tokens(*p) for p in parts]) if parts else \
+                np.empty(0, object)
+            vecs.append(UuidVec.encode(toks))
         else:
             vecs.append(_merge_categorical(parts, n, offs))
     return Frame(names[:ncol], vecs, destination_frame)

@@ -27,7 +27,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from h2o3_tpu.core.frame import Frame, T_CAT, T_NUM, T_STR, T_TIME, Vec
+from h2o3_tpu.core.frame import (Frame, T_CAT, T_NUM, T_STR, T_TIME,
+                                 T_UUID, UuidVec, Vec)
 
 NA_TOKENS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
 _SEPARATORS = [",", "\t", ";", "|", " "]
@@ -138,9 +139,25 @@ def _guess_types(rows: Sequence[Sequence[str]], ncol: int) -> list:
             types.append(T_NUM)
         elif all(_looks_time(t) for t in vals[:20]) and vals:
             types.append(T_TIME)
+        elif all(_looks_uuid(t) for t in vals[:20]) and vals:
+            types.append(T_UUID)
         else:
             types.append(T_CAT)
     return types
+
+
+_UUID_RE = None
+
+
+def _looks_uuid(tok: str) -> bool:
+    """ParseTime.attemptUUIDParse analog: 8-4-4-4-12 hex groups."""
+    global _UUID_RE
+    if _UUID_RE is None:
+        import re as _re
+        _UUID_RE = _re.compile(
+            r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-"
+            r"[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$")
+    return bool(_UUID_RE.match(tok.strip()))
 
 
 def _looks_time(tok: str) -> bool:
@@ -232,14 +249,18 @@ def _native_parse(path: str, setup: ParseSetup, dest, col_types):
                 except ValueError:
                     out[i] = np.nan
             vecs.append(Vec.from_numpy(out, type=T_TIME))
-        else:  # enum / str: reconstruct token strings
+        else:  # enum / str / uuid: reconstruct token strings
             toks = np.empty(len(num), object)
             isnan = np.isnan(num)
             for i in range(len(num)):
                 toks[i] = None if isnan[i] else _num_token(num[i])
             for i, s in smap.items():
                 toks[i] = s
-            vecs.append(Vec.from_numpy(toks, type=T_STR if t == T_STR else None))
+            if t == T_UUID:
+                vecs.append(UuidVec.encode(toks))
+            else:
+                vecs.append(Vec.from_numpy(toks,
+                                           type=T_STR if t == T_STR else None))
     return Frame(names[: len(vecs)], vecs, dest)
 
 
@@ -259,6 +280,10 @@ def _column_to_vec(tokens: list, vtype: str) -> Vec:
     if vtype == T_STR:
         arr = np.array([None if t in NA_TOKENS else t for t in tokens], object)
         return Vec.from_numpy(arr, type=T_STR)
+    if vtype == T_UUID:
+        arr = np.array([None if t in NA_TOKENS or not _looks_uuid(t)
+                        else t for t in tokens], object)
+        return UuidVec.encode(arr)
     # enum; promote to str if nearly-unique (CsvParser enum→string promotion)
     arr = np.array([None if t in NA_TOKENS else t for t in tokens], object)
     uniq = {t for t in tokens if t not in NA_TOKENS}

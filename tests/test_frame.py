@@ -85,3 +85,49 @@ def test_dkv_and_scope():
         key = f.key
         assert DKV.get(key) is f
     assert DKV.get(key) is None
+
+
+def test_uuid_device_plane():
+    """C16Chunk analog (water/fvec/C16Chunk.java): UUID columns live on
+    DEVICE as four i32 word lanes; equality and NA predicates run
+    device-side; decode to uuid.UUID on demand; no numeric view."""
+    import uuid
+    import jax
+    import pytest as _pt
+    from h2o3_tpu.core.frame import UuidVec
+    ids = [uuid.uuid4() for _ in range(5)]
+    col = np.array([str(ids[0]), str(ids[1]), None, str(ids[3]),
+                    str(ids[4])], object)
+    v = UuidVec.encode(col)
+    assert v.type == "uuid" and v.nrows == 5
+    assert isinstance(v.words, jax.Array) and v.words.shape[1] == 4
+    # 128-bit exact round trip
+    back = v.host_data
+    assert back[0] == ids[0] and back[3] == ids[3] and back[2] is None
+    assert v.na_cnt() == 1
+    # device equality
+    v2 = UuidVec.encode(np.array([str(ids[0]), str(ids[2]), None,
+                                  str(ids[3]), None], object))
+    eq = np.asarray(v.eq(v2))[:5]
+    np.testing.assert_allclose(eq, [1, 0, 0, 1, 0])
+    with _pt.raises(TypeError):
+        v.as_f32()
+
+
+def test_uuid_column_parses_from_csv(tmp_path):
+    import uuid
+    from h2o3_tpu.io.parser import parse, parse_setup
+    ids = [uuid.uuid4() for _ in range(30)]
+    p = tmp_path / "u.csv"
+    with open(p, "w") as fh:
+        fh.write("id,x\n")
+        for i, u in enumerate(ids):
+            fh.write(f"{u},{i}\n")
+    s = parse_setup(str(p))
+    assert s.column_types[0] == "uuid"
+    fr = parse(str(p))
+    v = fr.vec("id")
+    assert v.type == "uuid"
+    got = v.to_numpy()
+    assert got[7] == ids[7] and got[29] == ids[29]
+    assert fr.vec("x").to_numpy()[3] == 3.0
