@@ -22,7 +22,9 @@ def _lib():
     if _LIB is None:
         here = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        path = os.path.join(here, "native", "libfastcsv.so")
+        ndir = os.environ.get("H2O3_NATIVE_DIR",
+                              os.path.join(here, "native"))
+        path = os.path.join(ndir, "libfastcsv.so")
         lib = ctypes.CDLL(path)
         lib.fastcsv_parse.restype = ctypes.c_void_p
         lib.fastcsv_parse.argtypes = [ctypes.c_char_p, ctypes.c_char,

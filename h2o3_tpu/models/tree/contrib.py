@@ -26,7 +26,9 @@ def _lib():
     if _LIB is None:
         here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))))
-        path = os.path.join(here, "native", "libtreeshap.so")
+        ndir = os.environ.get("H2O3_NATIVE_DIR",
+                              os.path.join(here, "native"))
+        path = os.path.join(ndir, "libtreeshap.so")
         try:
             lib = ctypes.CDLL(path)
         except OSError as e:

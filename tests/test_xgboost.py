@@ -148,3 +148,73 @@ def test_xgboost_dart_multinomial():
     # dropout must actually change the ensemble
     assert not np.allclose(np.asarray(m._trees_k[0].value),
                            np.asarray(base._trees_k[0].value))
+
+
+def test_xgboost_checkpoint_restart():
+    """ModelBuilder.java:1401 restart semantics: `ntrees` is the TOTAL;
+    a continued booster's margin must extend the prior one exactly when
+    the learn rate is unchanged."""
+    f = _cls_frame(n=300, seed=9)
+    m1 = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=5, max_depth=3, seed=4, learn_rate=0.3,
+        model_id="xgb_ck_base", score_tree_interval=100)
+    m1.train(y="y", training_frame=f)
+    m2 = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=10, max_depth=3, seed=4, learn_rate=0.3,
+        checkpoint="xgb_ck_base", score_tree_interval=100)
+    m2.train(y="y", training_frame=f)
+    assert m2._trees.ntrees == 10
+    # first 5 trees are the checkpoint's trees verbatim
+    np.testing.assert_allclose(np.asarray(m2._trees.value)[:5],
+                               np.asarray(m1._trees.value), rtol=1e-6)
+    # more boosting must not hurt training logloss
+    assert (m2._output.training_metrics.logloss
+            <= m1._output.training_metrics.logloss + 1e-6)
+    # one-shot equivalence: same seed, 10 straight trees
+    m3 = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=10, max_depth=3, seed=4, learn_rate=0.3,
+        score_tree_interval=100)
+    m3.train(y="y", training_frame=f)
+    p2 = m2.predict(f).vec("pyes").to_numpy()
+    p3 = m3.predict(f).vec("pyes").to_numpy()
+    # restart re-derives RNG state, so trees 6-10 may differ — but the
+    # models must agree closely in fit quality
+    assert abs(np.mean(p2) - np.mean(p3)) < 0.05
+
+
+def test_xgboost_checkpoint_lr_change_rescales():
+    f = _cls_frame(n=200, seed=10)
+    m1 = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=4, max_depth=2, seed=1, learn_rate=0.4,
+        model_id="xgb_ck_lr")
+    m1.train(y="y", training_frame=f)
+    m2 = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=6, max_depth=2, seed=1, learn_rate=0.2,
+        checkpoint="xgb_ck_lr")
+    m2.train(y="y", training_frame=f)
+    # prior leaves were rescaled by eta_prev/eta so lr*sum is preserved
+    np.testing.assert_allclose(np.asarray(m2._trees.value)[:4],
+                               np.asarray(m1._trees.value) * 2.0,
+                               rtol=1e-6)
+
+
+def test_xgboost_stump_closed_form():
+    """Exact hist-objective math on a hand-computable stump: 8 rows, one
+    binary feature, lambda=1. G_left/right and leaf weights follow
+    xgboost's structure-score formulas (XGBoostModel hist semantics):
+    leaf = G/(H+lambda) in our res=-g convention, applied via lr."""
+    x = np.array([0, 0, 0, 0, 1, 1, 1, 1], float)
+    y = np.array([1, 1, 1, 0, 0, 0, 0, 1], float)
+    f = Frame.from_dict({"x": x,
+                         "y": np.array(["n", "p"], object)[y.astype(int)]})
+    lam = 1.0
+    m = h2o3_tpu.models.H2OXGBoostEstimator(
+        ntrees=1, max_depth=1, learn_rate=1.0, reg_lambda=lam,
+        min_rows=0.0, min_split_improvement=0.0, seed=1)
+    m.train(y="y", training_frame=f)
+    # F0=0 -> p=0.5, g = y-p = ±0.5, h = 0.25
+    # left (x=0): G=3*0.5-0.5=1.0, H=1.0 -> leaf=G/(H+lam)=0.5
+    # right (x=1): G=-1.0, H=1.0 -> leaf=-0.5
+    val = np.asarray(m._trees.value[0])
+    leaves = sorted(np.unique(np.round(val[1:3], 6)))
+    assert leaves == [-0.5, 0.5], val[:3]
