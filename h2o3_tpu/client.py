@@ -297,3 +297,149 @@ class GroupBy:
         by = " ".join(str(b) for b in self._by)
         aggs = " ".join(f'"{op}" {ci} "rm"' for op, ci in self._aggs)
         return self._frame._x(f"(GB {self._frame._fr.key} [{by}] {aggs})")
+
+
+# ---------------------------------------------------------------------------
+# h2o-py H2OFrame surface, continued: string ops, time ops, statistics,
+# cumulative/rank transforms — each a thin AST builder over the same
+# Rapids prims the reference client emits (h2o-py/h2o/frame.py).
+def _extend_h2oframe():
+    F = H2OFrame
+
+    def _qstr(v):
+        """Rapids string literal: the parser unescapes backslash
+        sequences, so literal backslashes and quotes must be escaped or
+        regex patterns like \\d+ silently lose their backslash."""
+        return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+    def _unary(op):
+        def m(self):
+            return self._x(f"({op} {self._fr.key})")
+        m.__name__ = op
+        return m
+
+    # string munging (h2o-py frame.py gsub/sub/trim/... emit these ASTs)
+    for name, op in [("tolower", "tolower"), ("toupper", "toupper"),
+                     ("trim", "trim"), ("lstrip", "lstrip"),
+                     ("rstrip", "rstrip"), ("nchar", "strlen")]:
+        setattr(F, name, _unary(op))
+
+    def gsub(self, pattern, replacement, ignore_case=False):
+        return self._x(f'(replaceall {self._fr.key} {_qstr(pattern)} '
+                       f'{_qstr(replacement)} {ignore_case})')
+
+    def sub(self, pattern, replacement, ignore_case=False):
+        return self._x(f'(replacefirst {self._fr.key} {_qstr(pattern)} '
+                       f'{_qstr(replacement)} {ignore_case})')
+
+    def strsplit(self, pattern):
+        return self._x(f'(strsplit {self._fr.key} {_qstr(pattern)})')
+
+    def substring(self, start_index, end_index=1000000):
+        return self._x(f"(substring {self._fr.key} {start_index} "
+                       f"{end_index})")
+
+    def countmatches(self, pattern):
+        pats = pattern if isinstance(pattern, list) else [pattern]
+        lst = " ".join(_qstr(p) for p in pats)
+        return self._x(f"(countmatches {self._fr.key} [{lst}])")
+
+    def grep(self, pattern, ignore_case=False, invert=False,
+             output_logical=False):
+        return self._x(f'(grep {self._fr.key} {_qstr(pattern)} '
+                       f"{ignore_case} {invert} {output_logical})")
+
+    F.gsub, F.sub, F.strsplit = gsub, sub, strsplit
+    F.substring, F.countmatches, F.grep = substring, countmatches, grep
+
+    # time accessors (AstTime family)
+    for name in ("year", "month", "day", "hour", "minute", "second",
+                 "week", "dayOfWeek"):
+        setattr(F, name, _unary(name))
+
+    # cumulative + rounding (AstCumu / AstRound)
+    for name in ("cumsum", "cumprod", "cummax", "cummin"):
+        setattr(F, name, _unary(name))
+
+    def round(self, digits=0):
+        return self._x(f"(round {self._fr.key} {digits})")
+
+    def signif(self, digits=6):
+        return self._x(f"(signif {self._fr.key} {digits})")
+
+    F.round, F.signif = round, signif
+
+    # statistics
+    def cor(self, y=None, use="complete.obs", method="Pearson"):
+        other = y._fr.key if isinstance(y, H2OFrame) else self._fr.key
+        return self._x(f'(cor {self._fr.key} {other} "{use}" '
+                       f'"{method}")')
+
+    def entropy(self):
+        # per-row Shannon entropy of string values (AstEntropy)
+        return self._x(f"(entropy {self._fr.key})")
+
+    def kurtosis(self, na_rm=True):
+        return rapids_exec(f"(kurtosis {self._fr.key} {na_rm})")
+
+    def skewness(self, na_rm=True):
+        return rapids_exec(f"(skewness {self._fr.key} {na_rm})")
+
+    def hist(self, breaks="sturges", plot=False):
+        if isinstance(breaks, str):
+            b = f'"{breaks}"'
+        elif isinstance(breaks, (list, tuple)):
+            b = "[" + " ".join(str(float(x)) for x in breaks) + "]"
+        else:
+            b = str(breaks)
+        return self._x(f"(hist {self._fr.key} {b})")
+
+    def na_omit(self):
+        return self._x(f"(na.omit {self._fr.key})")
+
+    def nacnt(self):
+        out = rapids_exec(f"(naCnt {self._fr.key})")
+        return out if isinstance(out, list) else [out]
+
+    def match(self, table):
+        vals = " ".join(_qstr(v) if isinstance(v, str) else str(v)
+                        for v in table)
+        return self._x(f"(match {self._fr.key} [{vals}])")
+
+    def cut(self, breaks, labels=None, include_lowest=False, right=True,
+            dig_lab=3):
+        bs = " ".join(str(float(b)) for b in breaks)
+        # prim signature: (cut fr breaks labels include.lowest right digits)
+        lab = ("[" + " ".join(_qstr(v) for v in labels) + "]"
+               if labels else "[]")
+        return self._x(f"(cut {self._fr.key} [{bs}] {lab} "
+                       f"{include_lowest} {right} {dig_lab})")
+
+    def which(self):
+        return self._x(f"(which {self._fr.key})")
+
+    def any_na(self):
+        return bool(rapids_exec(f"(any.na {self._fr.key})"))
+
+    def t(self):
+        return self._x(f"(t {self._fr.key})")
+
+    F.cor, F.entropy, F.kurtosis, F.skewness = cor, entropy, kurtosis, skewness
+    F.hist, F.na_omit, F.nacnt, F.match = hist, na_omit, nacnt, match
+    F.cut, F.which, F.any_na, F.t = cut, which, any_na, t
+
+    def rep_len(self, length_out):
+        return self._x(f"(rep_len {self._fr.key} {length_out})")
+
+    def topn(self, column=0, nPercent=10, grabTopN=-1):
+        """h2o-py semantics: grabTopN=-1 -> top N%, 1 -> bottom N%;
+        the prim's flag is bottom=truthy, hence the inversion."""
+        ci = self._fr.col_idx(column) if isinstance(column, str) else column
+        bottom = 1 if grabTopN > 0 else 0
+        return self._x(f"(topn {self._fr.key} {ci} {nPercent} {bottom})")
+
+    F.rep_len, F.topn = rep_len, topn
+
+
+_extend_h2oframe()
+del _extend_h2oframe

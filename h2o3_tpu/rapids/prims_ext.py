@@ -97,8 +97,14 @@ def _trigamma(a, e):
 def _cor(a, e):
     """(cor fr1 fr2 use method) — AstCor; pearson, 'complete.obs' rows."""
     x = _f(_eval(a[0], e))
-    y = _f(_eval(a[1], e)) if len(a) > 1 and not isinstance(a[1], str) \
-        else x
+    # the y slot must be EVALUATED before deciding whether it is a frame:
+    # identifier tokens are plain strings, so testing the raw token made
+    # every (cor x y ...) silently compute cor(x, x)
+    y = x
+    if len(a) > 1:
+        cand = _eval(a[1], e)
+        if isinstance(cand, Frame):
+            y = cand
     X = _mat(x)
     Y = _mat(y)
     ok = ~(np.isnan(X).any(1) | np.isnan(Y).any(1))

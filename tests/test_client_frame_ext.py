@@ -1,0 +1,145 @@
+"""Extended h2o-py client surface (client.py round 5): string/time ops,
+statistics, cumulative transforms — thin AST builders over the Rapids
+prims, value-checked against numpy/pandas oracles."""
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import client as h2o
+from h2o3_tpu.core.kvstore import DKV
+
+
+@pytest.fixture()
+def fr():
+    rng = np.random.default_rng(4)
+    f = h2o.H2OFrame({"x": rng.normal(size=50).tolist(),
+                      "y": rng.normal(size=50).tolist()})
+    yield f
+    DKV.remove(f.frame_id)
+
+
+def _col(frame, j=0):
+    return frame._fr.vecs[j].to_numpy()
+
+
+def _strs(frame, j=0):
+    """Decoded string values of a cat/str column."""
+    v = frame._fr.vecs[j]
+    vals = v.to_numpy()
+    if v.type == "str":
+        return list(vals)
+    dom = v.levels()
+    return [None if np.isnan(c) else dom[int(c)] for c in vals]
+
+
+def test_string_ops():
+    f = h2o.H2OFrame({"s": [" Foo bar ", "BAZ foo", "foo"]})
+    f2 = h2o.H2OFrame_from(f.frame)
+    up = f.toupper()
+    assert _strs(up) == [" FOO BAR ", "BAZ FOO", "FOO"]
+    tr = f.trim()
+    assert _strs(tr) == ["Foo bar", "BAZ foo", "foo"]
+    g = f.gsub("foo", "X")
+    assert _strs(g) == [" Foo bar ", "BAZ X", "X"]
+    n = f.nchar()
+    assert list(_col(n)) == [9.0, 7.0, 3.0]
+    cm = f.countmatches("foo")
+    assert list(_col(cm)) == [0.0, 1.0, 1.0]
+    sub3 = f.substring(0, 3)
+    assert _strs(sub3) == [" Fo", "BAZ", "foo"]
+    DKV.remove(f.frame_id)
+
+
+def test_stats_and_cumulative(fr):
+    x = _col(fr[["x"]])
+    cs = fr[["x"]].cumsum()
+    np.testing.assert_allclose(_col(cs), np.cumsum(x), rtol=1e-5)
+    cm = fr[["x"]].cummax()
+    np.testing.assert_allclose(_col(cm), np.maximum.accumulate(x),
+                               rtol=1e-6)
+    r2 = fr[["x"]].round(2)
+    np.testing.assert_allclose(_col(r2), np.round(x, 2), atol=1e-6)
+    # correlation between the two columns against numpy
+    c = fr[["x"]].cor(fr[["y"]])
+    xs = _col(fr, 0)
+    ys = _col(fr, 1)
+    expect = np.corrcoef(xs, ys)[0, 1]
+    assert abs(float(c) - expect) < 1e-4
+    # full-frame cor returns the 2x2 matrix frame with unit diagonal
+    M = fr.cor()
+    diag = _col(M, 0)[0]
+    assert abs(diag - 1.0) < 1e-6
+
+
+def test_time_accessors():
+    import datetime as dt
+    times = [dt.datetime(2023, 5, 17, 14, 30), dt.datetime(2024, 12, 1, 7, 5)]
+    ms = np.array([int(t.replace(tzinfo=dt.timezone.utc).timestamp()
+                       * 1000) for t in times], np.int64)
+    f = h2o.H2OFrame_from(
+        __import__("h2o3_tpu").Frame.from_dict(
+            {"t": ms.astype("datetime64[ms]")}))
+    yr = f.year()
+    assert list(_col(yr)) == [2023.0, 2024.0]
+    mo = f.month()
+    assert list(_col(mo)) == [5.0, 12.0]
+    DKV.remove(f.frame_id)
+
+
+def test_na_match_cut(fr):
+    f = h2o.H2OFrame({"v": [1.0, None, 3.0, None, 5.0]})
+    assert f.any_na()
+    assert f.nacnt()[0] == 2
+    om = f.na_omit()
+    assert om.nrows == 3
+    g = h2o.H2OFrame({"g": ["a", "b", "c", "a"]})
+    m = g.match(["a", "c"])
+    vals = _col(m)
+    assert vals[0] == vals[3] and not np.isnan(vals[0])
+    assert np.isnan(vals[1])
+    c = fr[["x"]].cut([-10, 0, 10])
+    assert c._fr.vecs[0].type == "enum"
+    for k in (f.frame_id, g.frame_id):
+        DKV.remove(k)
+
+
+def test_hist_and_entropy():
+    f = h2o.H2OFrame({"x": list(np.linspace(0, 1, 64))})
+    h = f.hist()
+    assert h.ncols >= 2 and h.nrows >= 3        # breaks + counts table
+    s = h2o.H2OFrame({"s": ["aa", "ab", "ba"]})
+    e = s.entropy()
+    assert _col(e).shape == (3,)
+    for k in (f.frame_id, s.frame_id):
+        DKV.remove(k)
+
+
+def test_regex_escaping_and_labels():
+    """Review r5: regex backslashes must survive the Rapids string
+    parser; cut labels must reach the prim; topn(-1) means TOP."""
+    f = h2o.H2OFrame({"s": ["a1", "bb", "c22"]})
+    # grep takes a REGEX: the \d must survive the Rapids string parser
+    g = f.grep(r"\d+", output_logical=True)
+    assert list(_col(g)) == [1.0, 0.0, 1.0]
+    # countmatches counts SUBSTRINGS (AstCountMatches semantics)
+    cm = f.countmatches("2")
+    assert list(_col(cm)) == [0.0, 0.0, 2.0]
+    DKV.remove(f.frame_id)
+    v = h2o.H2OFrame({"x": [0.5, 1.5, 2.5]})
+    c = v.cut([0, 1, 2, 3], labels=["lo", "mid", "hi"])
+    assert _strs(c) == ["lo", "mid", "hi"]
+    b = v.hist(breaks=[0, 1, 2, 3])
+    assert b.nrows >= 3
+    DKV.remove(v.frame_id)
+
+
+def test_topn_direction():
+    vals = list(np.arange(100.0))
+    f = h2o.H2OFrame({"x": vals})
+    top = f.topn("x", nPercent=10, grabTopN=-1)
+    got_top = _col(top, 1) if top.ncols > 1 else _col(top)
+    assert got_top.max() == 99.0 and got_top.min() >= 90.0
+    bot = f.topn("x", nPercent=10, grabTopN=1)
+    got_bot = _col(bot, 1) if bot.ncols > 1 else _col(bot)
+    assert got_bot.min() == 0.0 and got_bot.max() <= 9.0
+    DKV.remove(f.frame_id)
