@@ -29,6 +29,33 @@ def is_multihost() -> bool:
             and int(os.environ.get("TPU_WORKER_COUNT", "1") or 1) > 1))
 
 
+def assisted_clustering_env() -> dict:
+    """h2o-k8s assisted clustering analog (H2OAssistedClusteringEndpoint):
+    inside a k8s StatefulSet, derive the coordinator address, world size
+    and this pod's rank from the headless-service DNS convention instead
+    of requiring the manifest to wire H2O3_* explicitly.
+
+    Uses the downward-API hostname `<set>-<ordinal>` plus
+    H2O3_K8S_SERVICE (headless service name) and H2O3_K8S_REPLICAS:
+    coordinator = <set>-0.<service>:8476, process_id = <ordinal>.
+    Returns {} when not running under that convention."""
+    svc = os.environ.get("H2O3_K8S_SERVICE")
+    replicas = (os.environ.get("H2O3_K8S_REPLICAS") or "").strip()
+    host = os.environ.get("HOSTNAME", "")
+    if not (svc and replicas.isdigit() and "-" in host):
+        return {}
+    base, _, ordinal = host.rpartition("-")
+    if not ordinal.isdigit():
+        return {}
+    # 8476 matches the StatefulSet/Service declared coordinator port
+    port = os.environ.get("H2O3_COORDINATOR_PORT", "8476")
+    ns = os.environ.get("H2O3_K8S_NAMESPACE")
+    fqdn = f"{base}-0.{svc}" + (f".{ns}.svc.cluster.local" if ns else "")
+    return {"H2O3_COORDINATOR_ADDRESS": f"{fqdn}:{port}",
+            "H2O3_NUM_PROCESSES": replicas,
+            "H2O3_PROCESS_ID": ordinal}
+
+
 def bootstrap(n_rows_shards=None, n_model_shards: int = 1):
     """Initialize the distributed runtime (when applicable) and form the
     global cloud over every visible chip on every host.
@@ -41,6 +68,14 @@ def bootstrap(n_rows_shards=None, n_model_shards: int = 1):
     autodetects from the TPU metadata the same way MEGASCALE jobs do.
     """
     import jax
+
+    # assisted clustering: fill the H2O3_* wiring from StatefulSet DNS
+    # when the manifest didn't set it explicitly
+    if not os.environ.get("H2O3_COORDINATOR_ADDRESS"):
+        # plain assignment: a present-but-EMPTY manual override means
+        # "use assisted mode", and setdefault would leave it empty
+        for k, v in assisted_clustering_env().items():
+            os.environ[k] = v
 
     if is_multihost():
         addr = os.environ.get("H2O3_COORDINATOR_ADDRESS")

@@ -123,3 +123,25 @@ def test_no_pickle_in_channel():
     import inspect
     src = inspect.getsource(MH)
     assert "import pickle" not in src and "pickle." not in src
+
+
+def test_assisted_clustering_env(monkeypatch):
+    """h2o-k8s assisted clustering analog: StatefulSet DNS convention
+    derives coordinator/world/rank without explicit H2O3_* wiring."""
+    from h2o3_tpu.deploy.multihost import assisted_clustering_env
+    monkeypatch.setenv("HOSTNAME", "h2o3-tpu-3")
+    monkeypatch.setenv("H2O3_K8S_SERVICE", "h2o3-headless")
+    monkeypatch.setenv("H2O3_K8S_REPLICAS", "4")
+    monkeypatch.delenv("H2O3_K8S_NAMESPACE", raising=False)
+    env = assisted_clustering_env()
+    assert env == {
+        "H2O3_COORDINATOR_ADDRESS": "h2o3-tpu-0.h2o3-headless:8476",
+        "H2O3_NUM_PROCESSES": "4",
+        "H2O3_PROCESS_ID": "3"}
+    monkeypatch.setenv("H2O3_K8S_NAMESPACE", "ml")
+    env = assisted_clustering_env()
+    assert env["H2O3_COORDINATOR_ADDRESS"] == \
+        "h2o3-tpu-0.h2o3-headless.ml.svc.cluster.local:8476"
+    # not under the convention -> empty
+    monkeypatch.delenv("H2O3_K8S_SERVICE")
+    assert assisted_clustering_env() == {}
