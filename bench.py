@@ -25,9 +25,31 @@ import numpy as np
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
 
 
+def _registry():
+    """The obs metrics registry — bench publishes its numbers there FIRST
+    and builds the JSON line from it, so /metrics (a live server scraping
+    the same process) and BENCH_*.json can never disagree."""
+    from h2o3_tpu.obs import metrics as om
+    return om.REGISTRY
+
+
 def blocked_record(stage: str, detail: str) -> dict:
     """Structured evidence when the chip is unreachable (BENCH_r03 lesson:
-    a raw traceback at import left the round with zero perf record)."""
+    a raw traceback at import left the round with zero perf record). The
+    wedged state is also a labeled gauge, so a scraper sees
+    h2o3_bench_blocked{stage="backend-probe-timeout"} instead of silence.
+    The registry import pulls in jax — the very thing the subprocess probe
+    isolates — so it is best-effort here: a broken backend must never turn
+    the blocked record itself into a raw traceback."""
+    try:
+        reg = _registry()
+        reg.gauge("h2o3_bench_blocked",
+                  "1 when the chip bench could not run; label = failed stage"
+                  ).set(1, stage=stage)
+        reg.gauge("h2o3_bench_row_trees_per_sec",
+                  "headline GBM training throughput").set(0)
+    except BaseException:   # noqa: BLE001 — record first, metrics second
+        traceback.print_exc()
     return {
         "metric": "gbm_hist_row_trees_per_sec",
         "value": 0,
@@ -213,6 +235,8 @@ def main():
         float(F[0])
         dt = time.time() - t0
         ntrees = CHUNK * NCHUNK
+        from h2o3_tpu.models.tree.engine import ROW_TREES
+        ROW_TREES.inc(N * ntrees, engine="binned")   # /metrics sees the bench
         macs, hbm_b = roofline_model(codes.shape[0], codes.shape[1], int8)
         mode = "int8" if int8 else "f32"
         mfu = 2 * macs * ntrees / dt / PEAK_FLOPS[mode]
@@ -260,15 +284,31 @@ def main():
         traceback.print_exc()
 
     baseline = 157e6  # H100 gpu_hist row*trees/s reference point (header)
+    # publish into the obs registry, then emit the JSON line FROM it —
+    # one source of truth for the driver record and a /metrics scraper
+    reg = _registry()
+    g_tp = reg.gauge("h2o3_bench_row_trees_per_sec",
+                     "headline GBM training throughput")
+    g_tp.set(throughput)
+    g = reg.gauge("h2o3_bench", "chip benchmark facts (labeled by stat)")
+    g.set(auc, stat="train_auc")
+    g.set(mfu, stat="mfu")
+    g.set(hbm_frac, stat="hbm_frac")
+    g.set(throughput / baseline, stat="vs_baseline")
+    reg.gauge("h2o3_bench_blocked",
+              "1 when the chip bench could not run; label = failed stage"
+              ).set(0, stage="none")
+    if ingest:
+        g.set(ingest["mb_per_sec"], stat="ingest_mb_per_sec")
     print(json.dumps({
         "metric": "gbm_hist_row_trees_per_sec",
-        "value": round(throughput),
+        "value": round(g_tp.value()),
         "unit": "row*trees/s",
-        "vs_baseline": round(throughput / baseline, 4),
-        "train_auc": round(auc, 4),
+        "vs_baseline": round(g.value(stat="vs_baseline"), 4),
+        "train_auc": round(g.value(stat="train_auc"), 4),
         "stats_mode": mode,
-        "mfu": round(mfu, 4),
-        "hbm_frac": round(hbm_frac, 4),
+        "mfu": round(g.value(stat="mfu"), 4),
+        "hbm_frac": round(g.value(stat="hbm_frac"), 4),
         "radix_shallow": bool(HP.radix_supported()),
         "paths": paths,
         "ingest": ingest,

@@ -282,20 +282,38 @@ function sparkline(hist){
   `<text x="${P}" y="${H-P+14}" font-size="10" fill="#89a">iterations &rarr;</text></svg>`;
 }
 function varimpBars(vi){
+ // DOM construction like paneItem, not innerHTML: a hostile column name in
+ // r.variable must render as TEXT inside the SVG, never as markup
+ // (stored-XSS guard)
  const top = vi.slice(0,8);
- const W=420,BH=14,P=120;
- const rows = top.map((r,i)=>
-  `<rect x="${P}" y="${6+i*(BH+4)}" width="${(W-P-10)*r.scaled_importance}" height="${BH}" fill="#1b6ca8"/>`+
-  `<text x="${P-6}" y="${17+i*(BH+4)}" font-size="10" fill="#345" text-anchor="end">${r.variable}</text>`).join('');
- return `<svg width="${W}" height="${top.length*(BH+4)+10}" role="img" aria-label="variable importances">${rows}</svg>`;
+ const W=420,BH=14,P=120, NS='http://www.w3.org/2000/svg';
+ const svg = document.createElementNS(NS,'svg');
+ svg.setAttribute('width',W); svg.setAttribute('height',top.length*(BH+4)+10);
+ svg.setAttribute('role','img'); svg.setAttribute('aria-label','variable importances');
+ top.forEach((r,i)=>{
+  const rect = document.createElementNS(NS,'rect');
+  rect.setAttribute('x',P); rect.setAttribute('y',6+i*(BH+4));
+  rect.setAttribute('width',(W-P-10)*r.scaled_importance);
+  rect.setAttribute('height',BH); rect.setAttribute('fill','#1b6ca8');
+  svg.appendChild(rect);
+  const t = document.createElementNS(NS,'text');
+  t.setAttribute('x',P-6); t.setAttribute('y',17+i*(BH+4));
+  t.setAttribute('font-size',10); t.setAttribute('fill','#345');
+  t.setAttribute('text-anchor','end');
+  t.textContent = r.variable;
+  svg.appendChild(t);
+ });
+ return svg;
 }
 async function plotModel(i, modelId){
  try{
   const m = (await J('/3/Models/'+modelId)).models[0];
-  let html='';
-  if(m.scoring_history && m.scoring_history.length>1) html += sparkline(m.scoring_history);
-  if(m.variable_importances && m.variable_importances.length) html += varimpBars(m.variable_importances);
-  document.getElementById('viz'+i).innerHTML = html;
+  const viz = document.getElementById('viz'+i);
+  // sparkline interpolates only server-derived metric names, never ids
+  viz.innerHTML = (m.scoring_history && m.scoring_history.length>1)
+    ? sparkline(m.scoring_history) : '';
+  if(m.variable_importances && m.variable_importances.length)
+   viz.appendChild(varimpBars(m.variable_importances));
  }catch(e){}
 }
 

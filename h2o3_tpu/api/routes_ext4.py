@@ -361,7 +361,7 @@ def _h_friedmans_h(h):
 
 
 def friedmans_h(model, frame: Frame, variables, sample: int = 500,
-                grid: int = 8):
+                grid: int = 8, seed: int = 42):
     """H statistic over the joint grid of the given variables."""
     di = model._dinfo
     n = min(frame.nrows, sample)
@@ -369,9 +369,13 @@ def friedmans_h(model, frame: Frame, variables, sample: int = 500,
     if n < frame.nrows:
         # sample ONCE before the grid loops: the cross-grid scores the
         # design matrix len(grid)^k times — full-frame passes would do
-        # millions of discarded predictions on big frames
+        # millions of discarded predictions on big frames. A seeded
+        # uniform draw over ALL rows, not the first n: sorted/clustered
+        # frames (by time, by class) would otherwise bias the PDs.
         from h2o3_tpu.rapids.rapids import rapids_exec
-        idx = " ".join(str(i) for i in range(n))
+        rng = np.random.default_rng(seed)
+        ridx = np.sort(rng.choice(frame.nrows, size=n, replace=False))
+        idx = " ".join(str(i) for i in ridx)
         frame = sampled = rapids_exec(f"(rows {frame.key} [{idx}])")
     X = di.matrix(frame)
     from h2o3_tpu.explain_data import _grid_for, _set_feature, _score_col

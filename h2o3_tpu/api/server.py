@@ -151,6 +151,7 @@ class _Handler(BaseHTTPRequestHandler):
         # replaying an idempotent GET is free, deadlocking the cloud isn't.
         bc = getattr(self.server, "broadcaster", None)
         if bc is not None and not _is_static_path(path) \
+                and not _is_obs_path(path) \
                 and not path.startswith("/3/PostFile"):
             # PostFile is excluded: its body is raw (often binary) bytes
             # that neither parse as params nor replay through the channel
@@ -174,6 +175,15 @@ def _is_static_path(path: str) -> bool:
     """Static Flow-UI assets never touch device arrays — broadcasting
     them would serialize page loads behind the cluster replay barrier."""
     return path == "/" or path.startswith("/flow")
+
+
+def _is_obs_path(path: str) -> bool:
+    """Observability endpoints launch no device programs (registry reads +
+    memory_stats are host-local), and /3/Timeline does its own explicit
+    cloud-wide collect — replaying them would put every Prometheus scrape
+    behind the replay barrier."""
+    return path in ("/metrics", "/3/Timeline", "/3/WaterMeter") \
+        or path.startswith("/3/Logs")
 
 
 def _json_default(o):
@@ -553,13 +563,59 @@ def _h_logs(h: _Handler, *_):
 
 
 def _h_timeline(h: _Handler):
+    """GET /3/Timeline — the TimelineSnapshot analog: this host's span
+    ring plus every worker's, collected through the multihost replay
+    channel so the response covers the whole cloud."""
+    import time as _time
+    from h2o3_tpu.obs import timeline as _obs_tl
+    spans = _obs_tl.SPANS.snapshot(limit=512)
+    hosts = [{"host": _obs_tl.host_id(), "n_spans": len(spans)}]
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        # one flat merged list; hosts[] summarizes who answered (a None
+        # entry is a worker that outwaited the collect timeout)
+        for i, remote in enumerate(bc.collect("timeline")):
+            if isinstance(remote, dict):
+                rs = remote.get("spans", [])
+                spans.extend(rs)
+                hosts.append({"host": remote.get("host", i + 1),
+                              "n_spans": len(rs)})
+            else:
+                hosts.append({"host": i + 1, "n_spans": None,
+                              "lagging": True})
+        spans.sort(key=lambda s: s.get("start") or 0.0)
+    # legacy dispatch-event ring (utils/timeline) rides along
     from h2o3_tpu.utils.timeline import TIMELINE
     try:
         events = TIMELINE.snapshot()
     except Exception:
         events = []
     h._send({"__meta": {"schema_type": "TimelineV3"},
+             "now": _time.time(), "spans": spans, "hosts": hosts,
              "events": events[-512:]})
+
+
+def _h_metrics(h: _Handler):
+    """GET /metrics — Prometheus text exposition of the process registry."""
+    from h2o3_tpu.obs import metrics as _obs_m
+    _obs_m.install_runtime_gauges()
+    body = _obs_m.REGISTRY.prometheus_text().encode()
+    h.send_response(200)
+    h.send_header("Content-Type",
+                  "text/plain; version=0.0.4; charset=utf-8")
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    if getattr(h, "command", "") != "HEAD":
+        h.wfile.write(body)
+
+
+def _h_watermeter(h: _Handler):
+    """GET /3/WaterMeter — the registry as JSON (WaterMeterCpuTicks/
+    WaterMeterIo's REST shape, generalized to the whole registry)."""
+    from h2o3_tpu.obs import metrics as _obs_m
+    _obs_m.install_runtime_gauges()
+    h._send({"__meta": {"schema_type": "WaterMeterV3"},
+             "metrics": _obs_m.REGISTRY.to_dict()})
 
 
 def _h_metadata_endpoints(h: _Handler):
@@ -609,6 +665,8 @@ ROUTES = [
     (re.compile(r"/3/Logs/download"), "GET", _h_logs),
     (re.compile(r"/3/Logs/nodes/([^/]+)/files/([^/]+)"), "GET", _h_logs),
     (re.compile(r"/3/Timeline"), "GET", _h_timeline),
+    (re.compile(r"/metrics"), "GET", _h_metrics),
+    (re.compile(r"/3/WaterMeter"), "GET", _h_watermeter),
     (re.compile(r"/3/Metadata/endpoints"), "GET", _h_metadata_endpoints),
     (re.compile(r"/3/InitID"), "GET", _h_init_session),
     (re.compile(r"/3/InitID"), "DELETE", _h_end_session),
@@ -713,6 +771,8 @@ class H2OServer:
 
     def start(self, background=True):
         h2o3_tpu.cloud()  # form the device mesh before serving
+        from h2o3_tpu.obs import metrics as _obs_m
+        _obs_m.install_runtime_gauges()
         if background:
             self.thread = threading.Thread(target=self.httpd.serve_forever,
                                            daemon=True, name="h2o3-rest")

@@ -309,19 +309,32 @@ class H2OAutoML:
         if not te_cols:
             self._log("target_encoding: no high-cardinality columns; skipped")
             return x, training_frame, validation_frame, leaderboard_frame, None
-        fold_col = "__automl_te_fold__"
-        n = training_frame.nrows
-        rng = np.random.default_rng(self.seed if self.seed > 0 else 0)
-        folds = rng.permutation(n) % max(2, self.nfolds)
-        train2 = Frame(list(training_frame.names),
-                       list(training_frame.vecs),
-                       key=DKV.make_key("te_train"))
-        train2[fold_col] = Vec.from_numpy(folds.astype(np.float64))
-        te = H2OTargetEncoderEstimator(
-            data_leakage_handling="kfold", blending=True,
-            inflection_point=10.0, smoothing=20.0, noise=0.01,
-            seed=self.seed if self.seed > 0 else 1,
-            fold_column=fold_col, columns_to_encode=te_cols)
+        if self.nfolds and self.nfolds >= 2:
+            fold_col = "__automl_te_fold__"
+            n = training_frame.nrows
+            rng = np.random.default_rng(self.seed if self.seed > 0 else 0)
+            folds = rng.permutation(n) % self.nfolds
+            train2 = Frame(list(training_frame.names),
+                           list(training_frame.vecs),
+                           key=DKV.make_key("te_train"))
+            train2[fold_col] = Vec.from_numpy(folds.astype(np.float64))
+            te = H2OTargetEncoderEstimator(
+                data_leakage_handling="kfold", blending=True,
+                inflection_point=10.0, smoothing=20.0, noise=0.01,
+                seed=self.seed if self.seed > 0 else 1,
+                fold_column=fold_col, columns_to_encode=te_cols)
+        else:
+            # nfolds=0 disables CV: a synthetic 2-fold column here would
+            # force fold-based CV on every model the run builds. Fall back
+            # to leave-one-out, the non-kfold leakage strategy
+            # (TargetEncoding.java LeaveOneOut) — no fold column at all.
+            fold_col = None
+            train2 = training_frame
+            te = H2OTargetEncoderEstimator(
+                data_leakage_handling="loo", blending=True,
+                inflection_point=10.0, smoothing=20.0, noise=0.01,
+                seed=self.seed if self.seed > 0 else 1,
+                columns_to_encode=te_cols)
         te.train(x=x, y=y, training_frame=train2)
         self.te_model = te
         train_enc = te.transform(train2, as_training=True)

@@ -12,6 +12,7 @@ between device steps — the same contract Job.stop_requested() gives MRTasks.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 import traceback
@@ -45,6 +46,9 @@ class Job:
         # keeping the partial model (SharedTree stop_requested semantics)
         self.deadline: Optional[float] = None
         self.budget_exhausted = False
+        # per-phase wall time (ms), accumulated by `with job.phase(...)`
+        # blocks in the builders; surfaced in to_dict → /3/Jobs
+        self.phases: dict[str, float] = {}
         self.exception: Optional[BaseException] = None
         self.traceback: Optional[str] = None
         self.start_time = 0.0
@@ -61,8 +65,11 @@ class Job:
         self.start_time = time.time()
 
         def _run():
+            from h2o3_tpu.obs.timeline import span
             try:
-                result = work(self)
+                with span("job.run", job=self.key,
+                          description=self.description):
+                    result = work(self)
                 if result is not None and self.dest:
                     DKV.put(self.dest, result)
                 self.progress = 1.0
@@ -93,6 +100,20 @@ class Job:
         if self.dest:
             return DKV.get(self.dest)
         return None
+
+    # ---- phase timing (obs/timeline spans + /3/Jobs phases) -------------
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time one builder phase: wall time accumulates under `name` in
+        to_dict()["phases"], and the block is a span on /3/Timeline."""
+        from h2o3_tpu.obs.timeline import span
+        t0 = time.time()
+        try:
+            with span(f"job.{name}", job=self.key):
+                yield
+        finally:
+            dt = 1000.0 * (time.time() - t0)
+            self.phases[name] = self.phases.get(name, 0.0) + dt
 
     # ---- progress & cancellation ---------------------------------------
     def update(self, progress: float, msg: str = ""):
@@ -128,6 +149,10 @@ class Job:
             "status": self.status, "progress": self.progress,
             "progress_msg": self.progress_msg, "dest": self.dest,
             "msec": self.run_time_ms,
+            # snapshot first: the builder thread inserts phase keys while
+            # /3/Jobs serializes concurrently
+            "phases": {k: round(v, 3)
+                       for k, v in list(self.phases.items())},
             "exception": repr(self.exception) if self.exception else None,
             "stacktrace": self.traceback,
         }

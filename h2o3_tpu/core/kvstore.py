@@ -99,6 +99,29 @@ class _DKV:
         with self._mutex:
             return key in self._locks
 
+    # ---- census (obs/metrics gauges + /3/WaterMeter) --------------------
+    def stats(self) -> dict:
+        """Registry census: live keys, frames and their host-side bytes.
+        Uses raw_get so scraping /metrics never faults spilled frames
+        back into memory."""
+        with self._mutex:
+            keys = list(self._store.keys())
+            locked = len(self._locks)
+        from h2o3_tpu.core.frame import Frame
+        from h2o3_tpu.core.memory import MANAGER
+        nframes = 0
+        fbytes = 0
+        for k in keys:
+            v = self.raw_get(k)
+            if isinstance(v, Frame):
+                nframes += 1
+                try:
+                    fbytes += MANAGER.frame_bytes(v)
+                except Exception:   # noqa: BLE001 — census must never raise
+                    pass
+        return {"keys": len(keys), "frames": nframes,
+                "frame_bytes": fbytes, "write_locked": locked}
+
     # ---- key minting (water/Key.make) -----------------------------------
     def make_key(self, prefix: str = "obj") -> str:
         with self._mutex:

@@ -126,17 +126,6 @@ def _cluster_secret() -> bytes:
     return s.encode()
 
 
-def _recvall(sock, n: int) -> bytes:
-    import socket as _socket
-    buf = b""
-    while len(buf) < n:
-        part = sock.recv(n - len(buf), _socket.MSG_WAITALL)
-        if not part:
-            return b""
-        buf += part
-    return buf
-
-
 def _send_frame(sock, key: bytes, obj) -> None:
     import hashlib
     import hmac
@@ -147,25 +136,40 @@ def _send_frame(sock, key: bytes, obj) -> None:
     sock.sendall(struct.pack("!I", len(payload)) + tag + payload)
 
 
-def _recv_frame(sock, key: bytes):
+def _decode_frame(buf: bytes, key: bytes):
+    """Decode one length-prefixed HMAC frame from `buf`. Returns
+    (message, remaining_bytes) once a whole frame is present, None while
+    more bytes are needed — the single source of truth for the wire
+    format, shared by the blocking and buffered/resumable readers."""
     import hashlib
     import hmac
     import json
     import struct
-    hdr = _recvall(sock, 4)
-    if not hdr:
+    if len(buf) < 4:
         return None
-    (ln,) = struct.unpack("!I", hdr)
+    (ln,) = struct.unpack("!I", buf[:4])
     if ln > _MAX_FRAME:
         raise RuntimeError(f"replay channel: oversized frame ({ln} bytes)")
-    tag = _recvall(sock, 32)
-    payload = _recvall(sock, ln)
-    if len(tag) != 32 or len(payload) != ln:
+    need = 4 + 32 + ln
+    if len(buf) < need:
         return None
+    tag, payload = buf[4:36], buf[36:need]
     want = hmac.new(key, payload, hashlib.sha256).digest()
     if not hmac.compare_digest(tag, want):
         raise RuntimeError("replay channel: HMAC mismatch (untrusted peer?)")
-    return json.loads(payload)
+    return json.loads(payload), buf[need:]
+
+
+def _recv_frame(sock, key: bytes):
+    buf = b""
+    while True:
+        out = _decode_frame(buf, key)
+        if out is not None:
+            return out[0]
+        part = sock.recv(65536)
+        if not part:
+            return None       # EOF (possibly mid-frame)
+        buf += part
 
 
 def _session_key(secret: bytes, nonce_c: str, nonce_w: str) -> bytes:
@@ -234,7 +238,10 @@ class Broadcaster:
         secret = _cluster_secret()
         self._lock = threading.Lock()
         self._conns = []          # [(sock, session_key)]
-        self._seq = 0
+        self._owed: list = []     # per-conn acks abandoned by a timed-out
+        self._bufs: list = []     # collect; drained before the next send
+        self._dead: list = []     # peers that errored: excluded from
+        self._seq = 0             # collects (broadcast still fails loudly)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("0.0.0.0", port))
@@ -257,21 +264,128 @@ class Broadcaster:
                 conn.settimeout(None)
                 seen.add(hello["hello"])
                 self._conns.append((conn, key))
+                self._owed.append(0)
+                self._bufs.append(b"")
+                self._dead.append(False)
             except Exception as ex:  # noqa: BLE001 — drop peer, re-arm slot
                 print(f"replay channel: rejected peer {addr}: {ex}")
                 conn.close()
         srv.close()
+
+    def _recv_frame_at(self, i: int, timeout=None):
+        """Like _recv_frame but RESUMABLE: bytes consumed before a timeout
+        stay in the per-conn buffer, so abandoning a slow ack mid-frame
+        never desyncs the stream (a later drain re-enters and finishes
+        the same frame). `timeout` is a whole-frame DEADLINE, not a
+        per-recv idle limit — a worker trickling a large frame cannot
+        hold the caller past it. Raises socket.timeout on expiry."""
+        import socket as _socket
+        import time as _time
+        c, key = self._conns[i]
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        try:
+            while True:
+                out = _decode_frame(self._bufs[i], key)
+                if out is not None:
+                    msg, self._bufs[i] = out
+                    return msg
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise _socket.timeout("collect deadline")
+                    c.settimeout(remaining)
+                part = c.recv(65536)
+                if not part:
+                    return None           # peer gone
+                self._bufs[i] = self._bufs[i] + part
+        finally:
+            c.settimeout(None)
+
+    def _drain_owed(self, i: int):
+        """Consume acks a timed-out collect left in flight, so the next
+        frame's ack lines up with its own sequence number."""
+        while self._owed[i] > 0:
+            if self._recv_frame_at(i) is None:   # peer gone: stop spinning
+                break
+            self._owed[i] -= 1
 
     def broadcast(self, method: str, path: str, params: dict):
         with self._lock:
             self._seq += 1
             msg = {"seq": self._seq, "method": method, "path": path,
                    "params": params}
-            for c, key in self._conns:
+            for i, (c, key) in enumerate(self._conns):
+                self._drain_owed(i)
                 _send_frame(c, key, msg)
-            for c, key in self._conns:
-                ack = _recv_frame(c, key)  # receipt ack: ordering barrier
+            for i in range(len(self._conns)):
+                ack = self._recv_frame_at(i)  # receipt ack: order barrier
                 assert ack and ack.get("ack") == self._seq
+
+    def collect(self, op: str, timeout: float = 2.0) -> list:
+        """Gather per-worker observability state (TimelineSnapshot's
+        cloud-wide assembly): a collect frame replaces the request replay
+        and the worker answers its ack WITH the data — same socket, same
+        sequence numbers, so ordering against replayed requests holds.
+
+        Bounded wait: a worker stuck inside a long request replay won't
+        read the collect frame until it finishes, and /3/Timeline is
+        exactly the endpoint needed while something is slow — so each
+        worker gets `timeout` seconds, after which its slot returns None
+        and its still-owed ack is drained before the next send. A peer
+        that errors (EOF, HMAC, bad seq) is marked dead and excluded from
+        future collects WITHOUT touching the other workers' ack
+        accounting — one broken worker plus a scrape must not poison the
+        replay channel for the healthy ones."""
+        import socket as _socket
+        with self._lock:
+            self._seq += 1
+            msg = {"seq": self._seq, "op": op}
+            sent = [False] * len(self._conns)
+            for i, (c, key) in enumerate(self._conns):
+                if self._dead[i]:
+                    continue
+                try:
+                    self._drain_owed(i)
+                    _send_frame(c, key, msg)
+                    sent[i] = True
+                except Exception:   # noqa: BLE001 — peer broken, isolate it
+                    self._dead[i] = True
+            out = []
+            for i in range(len(self._conns)):
+                if not sent[i]:
+                    out.append(None)
+                    continue
+                try:
+                    ack = self._recv_frame_at(i, timeout=timeout)
+                    if not ack or ack.get("ack") != self._seq:
+                        raise RuntimeError(
+                            f"replay channel: bad collect ack from {i}")
+                    out.append(ack.get("data"))
+                except (_socket.timeout, TimeoutError):
+                    self._owed[i] += 1    # lagging worker: ack still due
+                    out.append(None)
+                except Exception:   # noqa: BLE001 — dead peer: isolate, keep going
+                    self._dead[i] = True
+                    out.append(None)
+            return out
+
+
+def _collect_local(op: str):
+    """Worker-side observability snapshot for Broadcaster.collect."""
+    try:
+        if op == "timeline":
+            from h2o3_tpu.obs import timeline as _tl
+            return {"host": _tl.host_id(),
+                    "spans": _tl.SPANS.snapshot(limit=512)}
+        if op == "metrics":
+            from h2o3_tpu.obs import metrics as _m
+            from h2o3_tpu.obs import timeline as _tl
+            return {"host": _tl.host_id(),
+                    "metrics": _m.REGISTRY.to_dict()}
+    except Exception:   # noqa: BLE001 — a worker probe error must not kill the loop
+        import traceback
+        traceback.print_exc()
+    return None
 
 
 def worker_loop(coordinator_host: str, port: int):
@@ -310,6 +424,11 @@ def worker_loop(coordinator_host: str, port: int):
             raise RuntimeError(f"replay channel: bad seq {msg.get('seq')}"
                                f" (expected {expect})")
         expect += 1
+        if "op" in msg:                   # observability collect: the data
+            _send_frame(sock, key,        # rides the ack, no route replay
+                        {"ack": msg["seq"],
+                         "data": _collect_local(msg["op"])})
+            continue
         _send_frame(sock, key, {"ack": msg["seq"]})  # ack, then execute
         try:
             replay_request(msg["method"], msg["path"], msg["params"])

@@ -14,6 +14,14 @@ import os
 
 import numpy as np
 
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs.timeline import span as _span
+
+# bytes handed to the native tokenizer (per byte-range call — the sum over
+# ranges equals the file bytes, so this tracks true tokenizer throughput)
+FASTCSV_BYTES = _om.counter("h2o3_fastcsv_bytes_total",
+                            "bytes tokenized by the native CSV parser")
+
 _LIB = None
 
 
@@ -71,10 +79,16 @@ def parse_columns(path: str, sep: str, header: bool,
     ctypes call releases the GIL, so ThreadPoolExecutor over ranges
     tokenizes in true parallel."""
     lib = _lib()
-    h = lib.fastcsv_parse_range(path.encode(), sep.encode(),
-                                start, end, 1 if header else 0)
+    try:
+        span_bytes = (end if end >= 0 else os.path.getsize(path)) - start
+    except OSError:
+        span_bytes = 0
+    with _span("parse.tokenize", engine="fastcsv", start=start, end=end):
+        h = lib.fastcsv_parse_range(path.encode(), sep.encode(),
+                                    start, end, 1 if header else 0)
     if not h:
         raise IOError(f"fastcsv failed on {path}")
+    FASTCSV_BYTES.inc(max(span_bytes, 0))
     try:
         nrows = lib.fastcsv_nrows(h)
         ncols = lib.fastcsv_ncols(h)

@@ -29,6 +29,16 @@ import numpy as np
 
 from h2o3_tpu.core.frame import (Frame, T_CAT, T_NUM, T_STR, T_TIME,
                                  T_UUID, UuidVec, Vec)
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs.timeline import span as _span
+
+# source bytes ingested, labeled by parse type (CSV/ARFF/SVMLight) — the
+# /metrics view of ingest volume; the python-vs-native engine split lives
+# in h2o3_fastcsv_bytes_total and the parse.tokenize span's engine attr
+PARSE_BYTES = _om.counter("h2o3_parse_bytes_total",
+                          "source bytes ingested by the 2-phase parser")
+PARSE_ROWS = _om.counter("h2o3_parse_rows_total",
+                         "rows materialized into Frames by the parser")
 
 NA_TOKENS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
 _SEPARATORS = [",", "\t", ";", "|", " "]
@@ -80,7 +90,8 @@ def _is_num(tok: str) -> bool:
 
 def parse_setup(path: str, sample_lines: int = 200) -> ParseSetup:
     """Phase 1: sniff separator, header, and column types from a sample."""
-    with _open_text(path) as f:
+    with _span("parse.setup", file=os.path.basename(path)), \
+            _open_text(path) as f:
         sample = [line.rstrip("\r\n") for _, line in zip(range(sample_lines), f)]
     sample = [l for l in sample if l]
     if not sample:
@@ -172,6 +183,18 @@ def parse(path: str, setup: Optional[ParseSetup] = None,
           col_types: Optional[dict] = None) -> Frame:
     """Phase 2: full tokenize → typed columns → packed sharded Vecs."""
     setup = setup or parse_setup(path)
+    with _span("parse.file", file=os.path.basename(path),
+               parse_type=setup.parse_type):
+        f = _parse_dispatch(path, setup, destination_frame, col_types)
+    try:
+        PARSE_BYTES.inc(os.path.getsize(path), type=setup.parse_type)
+    except OSError:
+        pass
+    PARSE_ROWS.inc(f.nrows)
+    return f
+
+
+def _parse_dispatch(path, setup, destination_frame, col_types) -> Frame:
     if setup.parse_type == "ARFF":
         return _parse_arff(path, setup, destination_frame)
     if setup.parse_type == "SVMLight":
@@ -179,7 +202,8 @@ def parse(path: str, setup: Optional[ParseSetup] = None,
     native = _native_parse(path, setup, destination_frame, col_types)
     if native is not None:
         return native
-    cols = _tokenize_csv(path, setup)
+    with _span("parse.tokenize", engine="python_csv"):
+        cols = _tokenize_csv(path, setup)
     names = list(setup.column_names)
     types = list(setup.column_types)
     # pad short rows / extend names if data is wider than the sample suggested
@@ -190,8 +214,9 @@ def parse(path: str, setup: Optional[ParseSetup] = None,
         for k, v in col_types.items():
             if k in names:
                 types[names.index(k)] = v
-    vecs = [_column_to_vec(cols[j], types[j]) for j in range(len(cols))]
-    return Frame(names[: len(vecs)], vecs, destination_frame)
+    with _span("parse.pack", cols=len(cols)):
+        vecs = [_column_to_vec(cols[j], types[j]) for j in range(len(cols))]
+        return Frame(names[: len(vecs)], vecs, destination_frame)
 
 
 def _tokenize_csv(path: str, setup: ParseSetup) -> list:

@@ -34,6 +34,11 @@ import numpy as np
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models import metrics as M
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs.timeline import span as _span
+
+_IRLSM_ITERS = _om.counter("h2o3_glm_irlsm_iterations_total",
+                           "IRLSM iterations across all GLM fits")
 
 # ---------------------------------------------------------------------------
 # Families / links (hex/glm/GLMModel.GLMParameters.Family)
@@ -787,28 +792,32 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         path = []
         for lam in lams:
             for it in range(max(1, max_it)):
-                eta = _eta_pass(Xi, jnp.asarray(beta, jnp.float32))
-                wi, z = _irls_weights(fam, link, eta, y, w,
-                                      self.params["tweedie_variance_power"] or 1.5,
-                                      self.params["theta"])
-                G, q = _gram_pass(Xi, wi, z)
-                Gn = np.asarray(G, np.float64)
-                qn = np.asarray(q, np.float64)
-                # quadratic (spline-smoothness) penalty: ∇½βᵀPβ = Pβ folds
-                # into the Gram exactly, for both solvers
-                Gs = Gn if P is None else Gn + P
-                if (alpha > 0 and lam > 0) or lo is not None:
-                    # objective is (1/N)·deviance + λ·pen ⇒ scale λ by Σw;
-                    # bounds force the projected-COD solver too
-                    nb = _cod_solve(Gs, qn, lam * wn.sum(), alpha, p_pen,
-                                    beta, lo=lo, hi=hi)
-                else:
-                    A = Gs + lam * wn.sum() * (1 - alpha) * np.eye(p1)
-                    if p_pen < p1:
-                        A[p1 - 1, p1 - 1] = Gs[p1 - 1, p1 - 1]
-                    nb = np.linalg.solve(A + 1e-10 * np.eye(p1), qn)
-                dmax = float(np.max(np.abs(nb - beta)))
-                beta = nb
+                with _span("glm.irlsm", iter=it, lam=float(lam),
+                           family=fam):
+                    _IRLSM_ITERS.inc()
+                    eta = _eta_pass(Xi, jnp.asarray(beta, jnp.float32))
+                    wi, z = _irls_weights(
+                        fam, link, eta, y, w,
+                        self.params["tweedie_variance_power"] or 1.5,
+                        self.params["theta"])
+                    G, q = _gram_pass(Xi, wi, z)
+                    Gn = np.asarray(G, np.float64)
+                    qn = np.asarray(q, np.float64)
+                    # quadratic (spline-smoothness) penalty: ∇½βᵀPβ = Pβ
+                    # folds into the Gram exactly, for both solvers
+                    Gs = Gn if P is None else Gn + P
+                    if (alpha > 0 and lam > 0) or lo is not None:
+                        # objective is (1/N)·deviance + λ·pen ⇒ scale λ by
+                        # Σw; bounds force the projected-COD solver too
+                        nb = _cod_solve(Gs, qn, lam * wn.sum(), alpha,
+                                        p_pen, beta, lo=lo, hi=hi)
+                    else:
+                        A = Gs + lam * wn.sum() * (1 - alpha) * np.eye(p1)
+                        if p_pen < p1:
+                            A[p1 - 1, p1 - 1] = Gs[p1 - 1, p1 - 1]
+                        nb = np.linalg.solve(A + 1e-10 * np.eye(p1), qn)
+                    dmax = float(np.max(np.abs(nb - beta)))
+                    beta = nb
                 if fam == GAUSSIAN and link == "identity":
                     break
                 if dmax < beps:
@@ -866,20 +875,23 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         for sweep in range(max_it):
             dmax = 0.0
             last_good = beta.copy()
-            for c in range(K):
-                yk = jnp.asarray((yi == c).astype(np.float32))
-                G, q = class_gram(jnp.asarray(beta, jnp.float32),
-                                  c, yk)
-                Gn, qn = np.asarray(G, np.float64), np.asarray(q, np.float64)
-                if alpha > 0 and lam > 0:
-                    nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha, p_pen,
-                                    beta[c].copy())
-                else:
-                    A = Gn + lam * wn.sum() * (1 - alpha) * np.eye(p1)
-                    A[p1 - 1, p1 - 1] = Gn[p1 - 1, p1 - 1]
-                    nb = np.linalg.solve(A + 1e-8 * np.eye(p1), qn)
-                dmax = max(dmax, float(np.max(np.abs(nb - beta[c]))))
-                beta[c] = nb
+            with _span("glm.irlsm", iter=sweep, family=MULTINOMIAL):
+                _IRLSM_ITERS.inc()
+                for c in range(K):
+                    yk = jnp.asarray((yi == c).astype(np.float32))
+                    G, q = class_gram(jnp.asarray(beta, jnp.float32),
+                                      c, yk)
+                    Gn, qn = (np.asarray(G, np.float64),
+                              np.asarray(q, np.float64))
+                    if alpha > 0 and lam > 0:
+                        nb = _cod_solve(Gn, qn, lam * wn.sum(), alpha,
+                                        p_pen, beta[c].copy())
+                    else:
+                        A = Gn + lam * wn.sum() * (1 - alpha) * np.eye(p1)
+                        A[p1 - 1, p1 - 1] = Gn[p1 - 1, p1 - 1]
+                        nb = np.linalg.solve(A + 1e-8 * np.eye(p1), qn)
+                    dmax = max(dmax, float(np.max(np.abs(nb - beta[c]))))
+                    beta[c] = nb
             job.update(0.6, f"multinomial sweep {sweep}")
             obj = float(obj_fn(jnp.asarray(beta, jnp.float32)))
             if not math.isfinite(obj) or obj > prev_obj + 1e-6 * abs(prev_obj):

@@ -39,6 +39,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs.timeline import span as _span
+
+# Σ rows·trees processed — the headline GBM throughput numerator; bench.py
+# and /metrics read the same counter (per-ensemble rate = Δcounter/Δt)
+ROW_TREES = _om.counter("h2o3_gbm_row_trees_total",
+                        "rows x trees processed by the tree engines")
+_LEVEL_SECONDS = _om.histogram(
+    "h2o3_tree_level_seconds",
+    "per-level dispatch wall time of the adaptive tree engine")
+
 # Dense-matmul histogram path is used while (leaves × 3 stats) stays MXU-sized.
 # Measured on v5e: the one-hot matmul beats segment-sum scatter ~3× even at
 # L=256 (scatter serializes on TPU); the threshold is a memory guard, not a
@@ -243,14 +254,22 @@ def _final_leaves(stats, leaf, active, w_in, valA, *, D):
     return jax.lax.dynamic_update_slice(valA, vals, (2 ** D - 1,))
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("nodes", "scale", "reg_lambda",
-                                    "reg_alpha"))
 def gamma_pass(heap, w, res, hess, val, *, nodes, scale=1.0,
                reg_lambda=0.0, reg_alpha=0.0):
     """GammaPass (GBM.java:1235) on device: Newton leaf Σw·res / Σw·hess.
     With reg_lambda/reg_alpha this is the XGBoost leaf weight
     sign(G)·max(|G|−α, 0)/(H+λ)."""
+    with _span("tree.gamma", nodes=nodes):
+        return _gamma_pass_jit(heap, w, res, hess, val, nodes=nodes,
+                               scale=scale, reg_lambda=reg_lambda,
+                               reg_alpha=reg_alpha)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nodes", "scale", "reg_lambda",
+                                    "reg_alpha"))
+def _gamma_pass_jit(heap, w, res, hess, val, *, nodes, scale=1.0,
+                    reg_lambda=0.0, reg_alpha=0.0):
     num = jax.ops.segment_sum(w * res, heap, num_segments=nodes)
     den = jax.ops.segment_sum(w * hess, heap, num_segments=nodes)
     if reg_alpha:
@@ -444,26 +463,35 @@ class TreeGrower:
             col_mask = jnp.ones(C, bool)
         if key is None:
             key = jax.random.PRNGKey(0)
-        for d in range(self.D):
-            leaf, heap, active, colA, thrA, nalA, valA, gains = _level_step(
-                X, stats, w, leaf, heap, active, colA, thrA, nalA, valA,
-                gains, col_mask, key, d=d, B=self.B, mtries=int(mtries),
-                min_rows=self.min_rows, min_split_improvement=self.msi,
-                reg_lambda=self.reg_lambda)
+        ROW_TREES.inc(n, engine="adaptive")
+        with _span("tree.grow", rows=n, cols=C, depth=self.D):
+            for d in range(self.D):
+                # span covers the level DISPATCH (histogram + split search
+                # + routing are one fused async program; on TPU the enqueue
+                # returns before the device finishes)
+                with _span("tree.level", depth=d), _LEVEL_SECONDS.time():
+                    leaf, heap, active, colA, thrA, nalA, valA, gains = \
+                        _level_step(
+                            X, stats, w, leaf, heap, active, colA, thrA,
+                            nalA, valA, gains, col_mask, key, d=d, B=self.B,
+                            mtries=int(mtries), min_rows=self.min_rows,
+                            min_split_improvement=self.msi,
+                            reg_lambda=self.reg_lambda)
+                if _cpu_backend():
+                    # XLA CPU collectives abort flakily when programs
+                    # containing all-reduces pile up in the async queue
+                    # (virtual-device test mesh only): drain per level. And
+                    # since the controller is synchronous here anyway, stop
+                    # growing once every row is frozen — deep levels of
+                    # unbalanced limits (max_depth 15+ on small data) would
+                    # otherwise compile and run for nothing. TPU stays
+                    # fully async at fixed depth.
+                    jax.block_until_ready(valA)
+                    if not bool(jnp.any(active)):
+                        return colA, thrA, nalA, valA, heap, gains
+            valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
             if _cpu_backend():
-                # XLA CPU collectives abort flakily when programs containing
-                # all-reduces pile up in the async queue (virtual-device test
-                # mesh only): drain per level. And since the controller is
-                # synchronous here anyway, stop growing once every row is
-                # frozen — deep levels of unbalanced limits (max_depth 15+ on
-                # small data) would otherwise compile and run for nothing.
-                # TPU stays fully async at fixed depth.
                 jax.block_until_ready(valA)
-                if not bool(jnp.any(active)):
-                    return colA, thrA, nalA, valA, heap, gains
-        valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
-        if _cpu_backend():
-            jax.block_until_ready(valA)
         return colA, thrA, nalA, valA, heap, gains
 
 

@@ -27,6 +27,7 @@ import numpy as np
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models.model import ModelBase
 from h2o3_tpu.models.tree import engine as E
+from h2o3_tpu.obs.timeline import span as _span
 
 
 class SharedTreeEstimator(ModelBase):
@@ -469,26 +470,29 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
             self._valid_advance(E.stack_trees(trees, grower.D), lr)
         last_scored = len(trees)
         for t in range(len(trees), ntrees):
-            key, k1, k2, k3 = jax.random.split(key, 4)
-            res, hess = _grad_hess(dist, F, y, udf=self._udf_dist)
-            wt = self._sample_weights(w, k1, sample_rate)
-            cmask = self._col_mask(X.shape[1], k2)
-            col, thr, nal, val, heap, g = grower.grow(
-                X, wt, res, col_mask=cmask, key=k3,
-                mtries=self._per_level_mtries(X.shape[1]))
-            gains_tot = gains_tot + g
-            if dist != "gaussian":   # GammaPass Newton refit (device)
-                val = E.gamma_pass(heap, wt, res, hess, val,
-                                   nodes=grower.nodes)
-            cover = E.node_covers(heap, wt, nodes=grower.nodes, D=grower.D)
-            trees.append((col, thr, nal, val, cover))
-            F = F + lr * val[heap]
+            with job.phase("grow"):
+                key, k1, k2, k3 = jax.random.split(key, 4)
+                res, hess = _grad_hess(dist, F, y, udf=self._udf_dist)
+                wt = self._sample_weights(w, k1, sample_rate)
+                cmask = self._col_mask(X.shape[1], k2)
+                col, thr, nal, val, heap, g = grower.grow(
+                    X, wt, res, col_mask=cmask, key=k3,
+                    mtries=self._per_level_mtries(X.shape[1]))
+                gains_tot = gains_tot + g
+                if dist != "gaussian":   # GammaPass Newton refit (device)
+                    val = E.gamma_pass(heap, wt, res, hess, val,
+                                       nodes=grower.nodes)
+                cover = E.node_covers(heap, wt, nodes=grower.nodes,
+                                      D=grower.D)
+                trees.append((col, thr, nal, val, cover))
+                F = F + lr * val[heap]
             if (t + 1) % interval == 0 or t == ntrees - 1:
-                if self._vstate is not None and len(trees) > last_scored:
-                    self._valid_advance(
-                        E.stack_trees(trees[last_scored:], grower.D), lr)
-                    last_scored = len(trees)
-                self._record_history(t + 1, F, y, w, dist)
+                with job.phase("score"):
+                    if self._vstate is not None and len(trees) > last_scored:
+                        self._valid_advance(
+                            E.stack_trees(trees[last_scored:], grower.D), lr)
+                        last_scored = len(trees)
+                    self._record_history(t + 1, F, y, w, dist)
                 if self._should_stop():
                     break
             job.update(0.1 + 0.8 * (t + 1) / ntrees, f"tree {t+1}")
@@ -534,7 +538,8 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         if dist == "multinomial":
             return self._fit_binned_multinomial(frame, job)
         p = self.params
-        ctx = self._binned_setup(frame)
+        with job.phase("setup"):   # quantile spec + codes + device_put
+            ctx = self._binned_setup(frame)
         BN, grower, cl = ctx["BN"], ctx["grower"], ctx["cl"]
         X, y, w, y1, w1 = ctx["X"], ctx["y"], ctx["w"], ctx["y1"], ctx["w1"]
         n, C, n_pad = ctx["n"], ctx["C"], ctx["n_pad"]
@@ -589,18 +594,22 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
                 "(ModelBuilder checkpoint validation)")
         while done < ntrees:
             k = min(interval, ntrees - done)
-            trainer = BN.gbm_chunk_trainer(
-                grower, n, dist=dist, eta=lr, sample_rate=sample_rate,
-                mtries=mtries, k_trees=k, col_rate_tree=col_rate_tree,
-                mesh=ctx["mesh"])
-            key, kc = jax.random.split(key)
-            F, trees = trainer(ctx["codes"], y1, w1, F, kc)
+            with job.phase("grow"), \
+                    _span("gbm.chunk", trees=k, rows=n, engine="binned"):
+                trainer = BN.gbm_chunk_trainer(
+                    grower, n, dist=dist, eta=lr, sample_rate=sample_rate,
+                    mtries=mtries, k_trees=k, col_rate_tree=col_rate_tree,
+                    mesh=ctx["mesh"])
+                key, kc = jax.random.split(key)
+                F, trees = trainer(ctx["codes"], y1, w1, F, kc)
+            E.ROW_TREES.inc(n * k, engine="binned")
             chunks.append(trees)
             done += k
-            if self._vstate is not None:
-                ta_chunk, _ = self._binned_tree_arrays(ctx, [trees])
-                self._valid_advance(ta_chunk, lr)
-            self._record_history(done, F[:n], y, w, dist)
+            with job.phase("score"):
+                if self._vstate is not None:
+                    ta_chunk, _ = self._binned_tree_arrays(ctx, [trees])
+                    self._valid_advance(ta_chunk, lr)
+                self._record_history(done, F[:n], y, w, dist)
             job.update(0.1 + 0.8 * done / ntrees, f"tree {done}")
             if self._should_stop() or job.budget_exhausted:
                 break
@@ -671,15 +680,20 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
                 f"ntrees ({ntrees}) must exceed it to continue training")
         while done < ntrees:
             k = min(interval, ntrees - done)
-            trainer = BN.gbm_multi_chunk_trainer(
-                grower, n, n_classes=K, eta=lr, sample_rate=sample_rate,
-                mtries=mtries, k_iters=k, col_rate_tree=col_rate_tree,
-                mesh=ctx["mesh"])
-            key, kc = jax.random.split(key)
-            F, trees = trainer(ctx["codes"], y1, w1, F, kc)
+            with job.phase("grow"), \
+                    _span("gbm.chunk", trees=k * K, rows=n,
+                          engine="binned_multinomial"):
+                trainer = BN.gbm_multi_chunk_trainer(
+                    grower, n, n_classes=K, eta=lr, sample_rate=sample_rate,
+                    mtries=mtries, k_iters=k, col_rate_tree=col_rate_tree,
+                    mesh=ctx["mesh"])
+                key, kc = jax.random.split(key)
+                F, trees = trainer(ctx["codes"], y1, w1, F, kc)
+            E.ROW_TREES.inc(n * k * K, engine="binned")
             chunks.append(trees)
             done += k
-            self._record_history_multi(done, F[:n], y, w)
+            with job.phase("score"):
+                self._record_history_multi(done, F[:n], y, w)
             job.update(0.1 + 0.8 * done / ntrees, f"iter {done}")
             if self._should_stop() or job.budget_exhausted:
                 break

@@ -1,0 +1,23 @@
+"""Observability subsystem — metrics registry + span timeline.
+
+The reference ships water/util/WaterMeterCpuTicks + WaterMeterIo (counters
+scraped over REST), water.TimeLine (per-node event ring assembled
+cloud-wide via TimelineSnapshot at /3/Timeline) and per-job progress. This
+package is the TPU-native rebuild: a process-global metrics registry
+(Prometheus text at GET /metrics, JSON at GET /3/WaterMeter) and a bounded
+ring of timed spans (GET /3/Timeline, merged across hosts through the
+deploy/multihost replay channel).
+
+Env surface:
+  H2O3_OBS_TIMELINE_CAPACITY  span ring size (default 4096)
+  H2O3_OBS_TRACE_DIR          xprof bridge: jax.profiler trace output dir
+  H2O3_OBS_TRACE_SPAN         span-name prefix that triggers the capture
+"""
+
+from h2o3_tpu.obs.metrics import (REGISTRY, Counter, Gauge, Histogram,
+                                  MetricsRegistry, counter, gauge, histogram)
+from h2o3_tpu.obs.timeline import SPANS, Span, SpanTimeline, span
+
+__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "counter", "gauge", "histogram",
+           "SPANS", "Span", "SpanTimeline", "span"]

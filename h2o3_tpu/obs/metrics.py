@@ -1,0 +1,365 @@
+"""Metrics registry — water/util/WaterMeter* rebuilt as a Prometheus-style
+process registry.
+
+Reference: WaterMeterCpuTicks.java / WaterMeterIo.java expose per-node
+counters over REST for external scrapers; H2O has no first-class metric
+types. Here the registry is explicit — counters, gauges and fixed-bucket
+histograms with label support — because the TPU runtime's interesting
+numbers (HBM in use, compile-cache hits, rows·trees/s) don't fall out of
+/proc the way CPU ticks do.
+
+Exposed at GET /metrics (text exposition format 0.0.4) and GET
+/3/WaterMeter (JSON) by api/server.py. One registry per process; workers
+in a multi-host cloud serve their own /metrics, and the span timeline (not
+the registry) is what gets merged cloud-wide.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+# Default latency buckets (seconds): sub-ms dispatches up to multi-minute
+# jobs — one decade finer at the low end than Prometheus' defaults because
+# device-program enqueues sit in the 0.1-10ms range.
+DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(key: tuple, extra: tuple = ()) -> str:
+    items = list(key) + list(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in items) + "}"
+
+
+def _fmt_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 2 ** 53 else repr(f)
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: dict = {}
+
+    def clear(self):
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative increment")
+        k = _label_key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0.0)
+
+    def _expose(self) -> list:
+        with self._lock:
+            items = sorted(self._series.items())
+        return [f"{self.name}{_fmt_labels(k)} {_fmt_num(v)}"
+                for k, v in items]
+
+    def _json(self):
+        with self._lock:
+            return [{"labels": dict(k), "value": v}
+                    for k, v in sorted(self._series.items())]
+
+
+class Gauge(_Metric):
+    """Settable gauge, or a callback gauge when `fn` is given: fn() returns
+    a scalar or a {labels_dict: value}-style list of (labels, value) pairs,
+    evaluated at scrape time (WaterMeter's read-on-request semantics)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable] = None):
+        super().__init__(name, help)
+        self._fn = fn
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        for k, v in self._collect():
+            if k == _label_key(labels):
+                return v
+        return 0.0
+
+    def _collect(self) -> list:
+        if self._fn is not None:
+            try:
+                out = self._fn()
+            except Exception:   # noqa: BLE001 — a dead probe must not 500 /metrics
+                return []
+            if isinstance(out, (int, float)):
+                return [((), float(out))]
+            return [(_label_key(dict(lbl)), float(v)) for lbl, v in out]
+        with self._lock:
+            return sorted(self._series.items())
+
+    def _expose(self) -> list:
+        return [f"{self.name}{_fmt_labels(k)} {_fmt_num(v)}"
+                for k, v in self._collect()]
+
+    def _json(self):
+        return [{"labels": dict(k), "value": v} for k, v in self._collect()]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket cumulative histogram (Prometheus semantics: _bucket
+    series are cumulative counts with a +Inf catch-all, plus _sum/_count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets=None):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+
+    def observe(self, value: float, **labels):
+        k = _label_key(labels)
+        v = float(value)
+        with self._lock:
+            st = self._series.get(k)
+            if st is None:
+                st = self._series[k] = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    st["counts"][i] += 1
+                    break
+            else:
+                st["counts"][-1] += 1
+            st["sum"] += v
+            st["count"] += 1
+
+    def time(self, **labels):
+        """Context manager: observe the block's wall time in seconds."""
+        import contextlib
+        import time as _time
+
+        @contextlib.contextmanager
+        def _cm():
+            t0 = _time.perf_counter()
+            try:
+                yield
+            finally:
+                self.observe(_time.perf_counter() - t0, **labels)
+        return _cm()
+
+    def snapshot(self, **labels) -> dict:
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            if st is None:
+                return {"sum": 0.0, "count": 0,
+                        "counts": [0] * (len(self.buckets) + 1)}
+            return {"sum": st["sum"], "count": st["count"],
+                    "counts": list(st["counts"])}
+
+    def _expose(self) -> list:
+        with self._lock:
+            items = sorted((k, {"counts": list(s["counts"]),
+                                "sum": s["sum"], "count": s["count"]})
+                           for k, s in self._series.items())
+        lines = []
+        for k, st in items:
+            cum = 0
+            for ub, c in zip(self.buckets, st["counts"]):
+                cum += c
+                lines.append(f"{self.name}_bucket"
+                             f"{_fmt_labels(k, (('le', _fmt_num(ub)),))}"
+                             f" {cum}")
+            cum += st["counts"][-1]
+            lines.append(f"{self.name}_bucket"
+                         f"{_fmt_labels(k, (('le', '+Inf'),))} {cum}")
+            lines.append(f"{self.name}_sum{_fmt_labels(k)}"
+                         f" {_fmt_num(st['sum'])}")
+            lines.append(f"{self.name}_count{_fmt_labels(k)} {st['count']}")
+        return lines
+
+    def _json(self):
+        with self._lock:
+            return [{"labels": dict(k), "sum": s["sum"], "count": s["count"],
+                     "buckets": dict(zip([_fmt_num(b) for b in self.buckets]
+                                         + ["+Inf"], s["counts"]))}
+                    for k, s in sorted(self._series.items())]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_make(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(f"metric {name!r} already registered "
+                                    f"as {m.kind}")
+                return m
+            m = cls(name, help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "",
+              fn: Optional[Callable] = None) -> Gauge:
+        return self._get_or_make(Gauge, name, help, fn=fn)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=None) -> Histogram:
+        return self._get_or_make(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def metrics(self) -> list:
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    # ---- exposition -----------------------------------------------------
+    def prometheus_text(self) -> str:
+        """Text exposition format 0.0.4 (the GET /metrics body)."""
+        out = []
+        for m in self.metrics():
+            out.append(f"# HELP {m.name} {_escape(m.help)}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            out.extend(m._expose())
+        return "\n".join(out) + "\n"
+
+    def to_dict(self) -> dict:
+        """JSON exposition (the GET /3/WaterMeter body)."""
+        return {m.name: {"kind": m.kind, "help": m.help,
+                         "series": m._json()}
+                for m in self.metrics()}
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "", fn: Optional[Callable] = None) -> Gauge:
+    return REGISTRY.gauge(name, help, fn=fn)
+
+
+def histogram(name: str, help: str = "", buckets=None) -> Histogram:
+    return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+# ---------------------------------------------------------------------------
+# Runtime gauges: JAX device memory, DKV census, XLA compile cache.
+def _device_memory_series():
+    import jax
+    out = []
+    for d in jax.local_devices():
+        stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+        if not stats:
+            continue
+        lbl = {"device": str(d.id)}
+        if "bytes_in_use" in stats:
+            out.append((dict(lbl, kind="bytes_in_use"),
+                        stats["bytes_in_use"]))
+        if "peak_bytes_in_use" in stats:
+            out.append((dict(lbl, kind="peak_bytes_in_use"),
+                        stats["peak_bytes_in_use"]))
+        if "bytes_limit" in stats:
+            out.append((dict(lbl, kind="bytes_limit"),
+                        stats["bytes_limit"]))
+    return out
+
+
+def _dkv_series():
+    from h2o3_tpu.core.kvstore import DKV
+    st = DKV.stats()
+    return [({"what": "keys"}, st["keys"]),
+            ({"what": "frames"}, st["frames"]),
+            ({"what": "frame_bytes"}, st["frame_bytes"]),
+            ({"what": "write_locked"}, st["write_locked"])]
+
+
+_JAX_LISTENERS_INSTALLED = False
+
+
+def _install_jax_listeners():
+    """Count XLA compile-cache traffic via jax.monitoring events. Safe to
+    call before the backend initializes (listener registration imports jax
+    but touches no devices)."""
+    global _JAX_LISTENERS_INSTALLED
+    if _JAX_LISTENERS_INSTALLED:
+        return
+    _JAX_LISTENERS_INSTALLED = True
+    try:
+        import jax.monitoring as _mon
+    except Exception:   # noqa: BLE001 — no jax, no compile metrics
+        return
+    hits = counter("h2o3_xla_compile_cache_hits_total",
+                   "persistent XLA compilation cache hits")
+    misses = counter("h2o3_xla_compile_cache_misses_total",
+                     "persistent XLA compilation cache misses")
+
+    def _on_event(event: str, **kw):
+        if event == "/jax/compilation_cache/cache_hits":
+            hits.inc()
+        elif event == "/jax/compilation_cache/cache_misses":
+            misses.inc()
+
+    try:
+        _mon.register_event_listener(_on_event)
+    except Exception:   # noqa: BLE001
+        pass
+
+
+def install_runtime_gauges():
+    """Register the default runtime gauges (idempotent; called by the API
+    server at start and by /metrics scrapes)."""
+    gauge("h2o3_device_memory_bytes",
+          "JAX per-device HBM usage from device.memory_stats()",
+          fn=_device_memory_series)
+    gauge("h2o3_dkv_objects",
+          "DKV registry census: live keys, frames, frame bytes",
+          fn=_dkv_series)
+    _install_jax_listeners()
+
+
+# Registered at import: the registry must answer a scrape even if the
+# server never called install explicitly (bench.py, tests, notebooks).
+install_runtime_gauges()

@@ -1,0 +1,175 @@
+"""Span timeline — water.TimeLine rebuilt as a ring of timed spans.
+
+Reference: TimeLine.java:22 keeps a lock-free per-node ring of every
+UDP/TCP packet; TimelineSnapshot assembles the rings cloud-wide for
+/3/Timeline. A single-controller TPU runtime has no packets — the unit of
+"what happened" is a timed SPAN (a job phase, a tree level dispatch, an
+IRLSM iteration), nested via a per-thread stack so /3/Timeline can show
+the call tree of a model build.
+
+The ring holds COMPLETED spans (recorded at exit, like TimeLine records a
+packet once sent); `snapshot()` is the per-host view, and api/server.py
+merges snapshots across hosts through the deploy/multihost channel — the
+TimelineSnapshot analog.
+
+xprof bridge: when H2O3_OBS_TRACE_DIR is set and a span's name starts with
+H2O3_OBS_TRACE_SPAN, the span also starts/stops a jax.profiler trace —
+deep kernel-level visibility for exactly the region you care about.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from collections import deque
+
+
+def host_id() -> int:
+    """This process' rank in the cloud. Env-derived (the multihost
+    bootstrap wires H2O3_PROCESS_ID) so reading it never initializes the
+    JAX backend."""
+    try:
+        return int(os.environ.get("H2O3_PROCESS_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+@dataclass
+class Span:
+    name: str
+    t_start: float
+    span_id: int
+    parent_id: int = 0           # 0 = root (no parent)
+    t_end: float | None = None
+    host: int = 0
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float | None:
+        if self.t_end is None:
+            return None
+        return 1000.0 * (self.t_end - self.t_start)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "id": self.span_id,
+                "parent": self.parent_id, "host": self.host,
+                "start": self.t_start, "end": self.t_end,
+                "duration_ms": self.duration_ms, "attrs": self.attrs}
+
+
+class SpanTimeline:
+    """Bounded ring of completed spans + per-thread open-span stack."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = int(os.environ.get("H2O3_OBS_TIMELINE_CAPACITY",
+                                          "4096") or 4096)
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # ---- span lifecycle -------------------------------------------------
+    def begin(self, name: str, **attrs) -> Span:
+        st = self._stack()
+        sp = Span(name=name, t_start=time.time(),
+                  span_id=next(self._ids),
+                  parent_id=st[-1].span_id if st else 0,
+                  host=host_id(), attrs=attrs)
+        st.append(sp)
+        return sp
+
+    def end(self, sp: Span):
+        sp.t_end = time.time()
+        st = self._stack()
+        if st and st[-1] is sp:
+            st.pop()
+        elif sp in st:           # mis-nested exit: unwind through it
+            while st and st.pop() is not sp:
+                pass
+        with self._lock:
+            self._ring.append(sp)
+
+    def current(self) -> Span | None:
+        st = self._stack()
+        return st[-1] if st else None
+
+    # ---- views ----------------------------------------------------------
+    def snapshot(self, limit: int = 0) -> list:
+        """Completed spans, oldest first (the /3/Timeline per-host body)."""
+        with self._lock:
+            spans = list(self._ring)
+        if limit and len(spans) > limit:
+            spans = spans[-limit:]
+        return [s.to_dict() for s in spans]
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+SPANS = SpanTimeline()
+
+
+# ---------------------------------------------------------------------------
+# xprof bridge (env-gated; one capture at a time)
+_TRACE_LOCK = threading.Lock()
+_TRACE_ACTIVE = False
+
+
+def _maybe_start_trace(name: str) -> bool:
+    trace_dir = os.environ.get("H2O3_OBS_TRACE_DIR")
+    want = os.environ.get("H2O3_OBS_TRACE_SPAN")
+    if not trace_dir or not want or not name.startswith(want):
+        return False
+    global _TRACE_ACTIVE
+    with _TRACE_LOCK:
+        if _TRACE_ACTIVE:
+            return False        # nested match: outer capture already running
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+        except Exception:   # noqa: BLE001 — profiler trouble must not kill the span
+            return False
+        _TRACE_ACTIVE = True
+        return True
+
+
+def _stop_trace():
+    global _TRACE_ACTIVE
+    with _TRACE_LOCK:
+        if not _TRACE_ACTIVE:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:   # noqa: BLE001
+            pass
+        _TRACE_ACTIVE = False
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Time a block as one span: `with span("gbm.histogram", job=k): ...`.
+    Nesting is tracked per thread; attrs land in the /3/Timeline record."""
+    sp = SPANS.begin(name, **attrs)
+    traced = _maybe_start_trace(name)
+    if traced:
+        sp.attrs["xprof"] = os.environ.get("H2O3_OBS_TRACE_DIR")
+    try:
+        yield sp
+    finally:
+        if traced:
+            _stop_trace()
+        SPANS.end(sp)

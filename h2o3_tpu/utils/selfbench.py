@@ -95,11 +95,31 @@ def memory_bandwidth(n: int = 1 << 24) -> dict:
             "gbps": bytes_moved / max(t, 1e-12) / 1e9}
 
 
+def publish(results: dict) -> dict:
+    """Emit selfbench numbers into the obs registry so /metrics and
+    bench.py report the same hardware facts (the WaterMeter contract:
+    one source of truth for scrapers and humans)."""
+    from h2o3_tpu.obs import metrics as om
+    g = om.gauge("h2o3_selfbench", "in-product hardware self-benchmarks "
+                 "(linpack gflops, HBM triad GB/s, ICI collectives)")
+    lp = results.get("linpack")
+    if lp:
+        g.set(lp["gflops"], probe="linpack_gflops", dtype=lp["dtype"])
+    mb = results.get("memory_bandwidth")
+    if mb:
+        g.set(mb["gbps"], probe="hbm_triad_gbps")
+    for row in results.get("network") or []:
+        pb = str(row["payload_bytes_per_device"])
+        g.set(row["latency_us"], probe="ici_latency_us", payload_bytes=pb)
+        g.set(row["algo_bw_gbps"], probe="ici_bw_gbps", payload_bytes=pb)
+    return results
+
+
 def run_all() -> dict:
-    return {"network": network_bench(), "linpack": linpack(),
-            "memory_bandwidth": memory_bandwidth(),
-            "backend": jax.default_backend(),
-            "n_devices": len(jax.devices())}
+    return publish({"network": network_bench(), "linpack": linpack(),
+                    "memory_bandwidth": memory_bandwidth(),
+                    "backend": jax.default_backend(),
+                    "n_devices": len(jax.devices())})
 
 
 if __name__ == "__main__":

@@ -121,3 +121,24 @@ def test_automl_te_skips_when_low_cardinality():
     aml.train(y="y", training_frame=f)
     assert aml.te_model is None          # below the cardinality threshold
     assert aml.leader is not None
+
+
+def test_te_nfolds_zero_uses_loo_not_synthetic_kfold():
+    """nfolds=0 disables CV: the TE preprocessing must not fabricate a
+    2-fold column (which would silently force fold-based CV on every
+    model); it falls back to the leave-one-out leakage strategy."""
+    f = _hicard_frame(n=200)
+    aml = H2OAutoML(max_models=1, nfolds=0, seed=5,
+                    preprocessing=["target_encoding"])
+    x = [c for c in f.names if c != "y"]
+    x2, train2, valid2, lb2, fold_col = aml._apply_target_encoding(
+        x, "y", f, None, None)
+    assert fold_col is None
+    assert aml.te_model.params["data_leakage_handling"] == "loo"
+    assert aml.te_model.params["fold_column"] is None
+    assert "cat_te" in train2.names
+    assert "__automl_te_fold__" not in train2.names
+    # and the original frame is untouched
+    assert "__automl_te_fold__" not in f.names
+    for fr in (f, train2):
+        DKV.remove(fr.key)

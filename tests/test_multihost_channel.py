@@ -145,3 +145,49 @@ def test_assisted_clustering_env(monkeypatch):
     # not under the convention -> empty
     monkeypatch.delenv("H2O3_K8S_SERVICE")
     assert assisted_clustering_env() == {}
+
+
+def test_collect_roundtrip_and_lagging_worker(secret_env):
+    """Broadcaster.collect: a prompt worker answers its ack with data; a
+    busy worker times out (slot = None, ack owed) and a later broadcast
+    drains the stale ack — even when the timeout hit MID-frame — so the
+    sequence protocol stays in lockstep."""
+    import time
+    port = _free_port()
+    out = {}
+
+    def coord():
+        bc = MH.Broadcaster(1, port)
+        out["fast"] = bc.collect("timeline")
+        out["slow"] = bc.collect("timeline", timeout=0.3)
+        bc.broadcast("POST", "/3/Frames", {"a": "1"})   # drains owed ack
+
+    t = threading.Thread(target=coord, daemon=True)
+    t.start()
+    sock = _connect(port)
+    key = _worker_handshake(sock, secret_env)
+    # collect 1: answer promptly, data in the ack
+    m1 = MH._recv_frame(sock, key)
+    assert m1 == {"seq": 1, "op": "timeline"}
+    MH._send_frame(sock, key, {"ack": 1, "data": {"host": 3, "spans": []}})
+    # collect 2: dribble the ack out byte-by-byte past the timeout —
+    # the coordinator must give up cleanly mid-frame and resume later
+    m2 = MH._recv_frame(sock, key)
+    assert m2["seq"] == 2
+    import hashlib
+    import hmac
+    import json as _json
+    import struct
+    payload = _json.dumps({"ack": 2, "data": {"host": 3, "spans": []}}).encode()
+    tag = hmac.new(key, payload, hashlib.sha256).digest()
+    frame = struct.pack("!I", len(payload)) + tag + payload
+    sock.sendall(frame[:10])        # partial: header + part of the tag
+    time.sleep(0.6)                 # let the collect timeout fire
+    sock.sendall(frame[10:])        # late remainder → drained by broadcast
+    m3 = MH._recv_frame(sock, key)  # the broadcast frame arrives next
+    assert m3["seq"] == 3 and m3["path"] == "/3/Frames"
+    MH._send_frame(sock, key, {"ack": 3})
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert out["fast"] == [{"host": 3, "spans": []}]
+    assert out["slow"] == [None]
