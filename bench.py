@@ -61,25 +61,44 @@ def blocked_record(stage: str, detail: str) -> dict:
     }
 
 
-def probe_backend() -> dict | None:
-    """Pre-flight the backend in a SUBPROCESS with a hard timeout so a wedged
-    TPU relay (observed: jax.devices() hung >5h) yields a blocked record
-    instead of hanging the driver. Returns None when healthy."""
+def _probe_once(env: dict) -> tuple | None:
+    """One subprocess probe: None when healthy, else (stage, detail)."""
     code = ("import jax, jax.numpy as jnp; x = jnp.ones((4,)); "
             "print(jax.default_backend(), float(x.sum()))")
     try:
-        r = subprocess.run([sys.executable, "-c", code], timeout=PROBE_TIMEOUT_S,
-                           capture_output=True, text=True, env=dict(os.environ))
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=PROBE_TIMEOUT_S,
+                           capture_output=True, text=True, env=env)
     except subprocess.TimeoutExpired:
-        return blocked_record(
-            "backend-probe-timeout",
-            f"backend init did not respond within {PROBE_TIMEOUT_S}s "
-            "(TPU relay wedged?)")
+        return ("backend-probe-timeout",
+                f"backend init did not respond within {PROBE_TIMEOUT_S}s "
+                "(TPU relay wedged?)")
     if r.returncode != 0:
-        return blocked_record("backend-probe-error",
-                              (r.stderr or r.stdout or "").strip())
+        return ("backend-probe-error",
+                (r.stderr or r.stdout or "").strip())
     print(f"backend probe: {r.stdout.strip()}", file=sys.stderr)
     return None
+
+
+def probe_backend() -> dict | None:
+    """Pre-flight the backend in a SUBPROCESS with a hard timeout so a wedged
+    TPU relay (observed: jax.devices() hung >5h) yields a blocked record
+    instead of hanging the driver. When the chip is unreachable but the CPU
+    backend works (or JAX_PLATFORMS=cpu was requested), fall back to CPU
+    smoke mode and report a REAL number instead of a blocked record
+    (BENCH_r05: blocked_stage=backend-probe-timeout left the round with
+    zero perf signal). Returns None when a usable backend exists."""
+    fail = _probe_once(dict(os.environ))
+    if fail is None:
+        return None
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        if _probe_once(dict(os.environ, JAX_PLATFORMS="cpu")) is None:
+            print(f"chip probe failed ({fail[0]}); falling back to "
+                  "JAX_PLATFORMS=cpu smoke mode", file=sys.stderr)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ.setdefault("BENCH_N", "200000")
+            return None
+    return blocked_record(*fail)
 
 
 def ingest_bench(mb: int = 50) -> dict:
@@ -115,6 +134,51 @@ def ingest_bench(mb: int = 50) -> dict:
         os.unlink(path)
 
 
+def scoring_bench() -> dict:
+    """Warm-cache serving throughput: rows/sec through the shape-bucketed
+    compiled-scorer cache (h2o3_tpu/serving) scoring a GBM at a
+    serving-sized bucketed batch. The first call compiles the one resident
+    program; the timed loop re-stages + dispatches it with zero compiles —
+    what a steady-state /3/Predictions stream sees."""
+    import numpy as np
+    from h2o3_tpu.core.frame import Frame
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu.models import ESTIMATORS
+    from h2o3_tpu import serving
+    from h2o3_tpu.obs import metrics as om
+
+    rng = np.random.default_rng(3)
+    ntr, batch, iters = 20_000, 4096, 25
+    cols = {f"x{j}": rng.normal(size=ntr) for j in range(10)}
+    hot = rng.random(ntr) < 1 / (1 + np.exp(-(cols["x0"] - cols["x1"])))
+    cols["y"] = np.where(hot, "yes", "no").astype(object)
+    fr = Frame.from_dict(cols)
+    m = ESTIMATORS["gbm"](ntrees=10, max_depth=5, seed=1,
+                          histogram_type="UniformAdaptive")
+    m.train(x=[f"x{j}" for j in range(10)], y="y", training_frame=fr)
+    sf = Frame.from_dict({f"x{j}": rng.normal(size=batch)
+                          for j in range(10)})
+    for _ in range(2):                     # warm: compile + settle
+        serving.score_frame(m, sf)
+    c0 = om.xla_compile_count()
+    t0 = time.time()
+    for _ in range(iters):
+        out = serving.score_frame(m, sf)
+    dt = time.time() - t0
+    assert out is not None and len(out) >= batch
+    warm_compiles = om.xla_compile_count() - c0
+    rows_per_sec = batch * iters / dt
+    om.REGISTRY.gauge("h2o3_bench_scoring_rows_per_sec",
+                      "warm-cache bucketed serving throughput"
+                      ).set(rows_per_sec)
+    for k in (fr.key, sf.key, m.key):
+        DKV.remove(k)
+    return {"rows_per_sec": round(rows_per_sec),
+            "batch_rows": batch, "iters": iters,
+            "bucket": serving.row_bucket(batch),
+            "warm_compiles": int(warm_compiles)}
+
+
 def main():
     rec = probe_backend()
     if rec is not None:
@@ -123,6 +187,12 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        # this image's sitecustomize imports jax at interpreter start, so
+        # the env var (incl. the probe's CPU fallback) is read too late —
+        # force the platform through the config instead
+        jax.config.update("jax_platforms", "cpu")
 
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -244,7 +314,9 @@ def main():
         return N * ntrees / dt, float(auc_dev(F, y)), mfu, hbm_frac
 
     tp_f32, auc_f32, mfu_f32, hbm_f32 = run_mode(False)
-    assert auc_f32 > 0.72, \
+    # CPU smoke mode trains far fewer trees — gate correctness, not power
+    auc_gate = 0.72 if N >= 1_000_000 else 0.60
+    assert auc_f32 > auc_gate, \
         f"AUC gate failed: {auc_f32:.4f} — kernels mis-trained"
     print(f"f32: {tp_f32/1e6:.2f}M row*trees/s auc={auc_f32:.4f} "
           f"mfu={mfu_f32:.3f} hbm={hbm_f32:.3f}", file=sys.stderr)
@@ -283,6 +355,15 @@ def main():
     except Exception:
         traceback.print_exc()
 
+    scoring = None
+    try:
+        scoring = scoring_bench()
+        print(f"scoring: {scoring['rows_per_sec']/1e3:.1f}k rows/s warm "
+              f"(batch {scoring['batch_rows']}, "
+              f"{scoring['warm_compiles']} warm compiles)", file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+
     baseline = 157e6  # H100 gpu_hist row*trees/s reference point (header)
     # publish into the obs registry, then emit the JSON line FROM it —
     # one source of truth for the driver record and a /metrics scraper
@@ -300,6 +381,8 @@ def main():
               ).set(0, stage="none")
     if ingest:
         g.set(ingest["mb_per_sec"], stat="ingest_mb_per_sec")
+    if scoring:
+        g.set(scoring["rows_per_sec"], stat="scoring_rows_per_sec")
     print(json.dumps({
         "metric": "gbm_hist_row_trees_per_sec",
         "value": round(g_tp.value()),
@@ -307,11 +390,14 @@ def main():
         "vs_baseline": round(g.value(stat="vs_baseline"), 4),
         "train_auc": round(g.value(stat="train_auc"), 4),
         "stats_mode": mode,
+        "backend": jax.default_backend(),
         "mfu": round(g.value(stat="mfu"), 4),
         "hbm_frac": round(g.value(stat="hbm_frac"), 4),
         "radix_shallow": bool(HP.radix_supported()),
+        "scoring_rows_per_sec": (scoring or {}).get("rows_per_sec"),
         "paths": paths,
         "ingest": ingest,
+        "scoring": scoring,
     }))
 
 

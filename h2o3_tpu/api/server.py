@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time as _time_mod
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -28,7 +29,14 @@ from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.core.jobs import Job, jobs_list
 from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.io import parser as io_parser
+from h2o3_tpu.obs import metrics as _obs_metrics
 from h2o3_tpu.rapids import rapids_exec, Session
+
+# per-request REST latency, labeled by ROUTE PATTERN (bounded cardinality),
+# method and status — the ROADMAP observability gap this closes
+REQUEST_SECONDS = _obs_metrics.histogram(
+    "h2o3_rest_request_seconds",
+    "REST request wall time by route pattern, method and status")
 
 
 def _frame_schema(f: Frame, with_summary=False) -> dict:
@@ -51,6 +59,11 @@ def _model_schema(m) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "h2o3-tpu/0.1"
+
+    def send_response(self, code, message=None):
+        # remember the status for the request-latency histogram labels
+        self._status = code
+        super().send_response(code, message)
 
     # ---- security (water/H2OSecurityManager.java + webserver auth) ------
     def _check_auth(self) -> bool:
@@ -139,7 +152,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._route("GET")
 
     def _route(self, method):
+        t0 = _time_mod.perf_counter()
+        self._status = 0
+        self._route_label = "unmatched"
+        try:
+            self._route_inner(method)
+        finally:
+            REQUEST_SECONDS.observe(
+                _time_mod.perf_counter() - t0,
+                route=self._route_label, method=method,
+                status=str(self._status or 0))
+
+    def _route_inner(self, method):
         if not self._check_auth():
+            self._route_label = "auth"
             return
         path = urllib.parse.urlparse(self.path).path
         # SPMD replay (deploy/multihost): requests broadcast to every
@@ -164,6 +190,7 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 mm = pat.fullmatch(path)
                 if mm:
+                    self._route_label = pat.pattern
                     fn(self, *mm.groups())
                     return
             self._error(f"no route {method} {path}", 404)
@@ -378,6 +405,10 @@ def _h_model(h: _Handler, mid):
 
 def _h_model_delete(h: _Handler, mid):
     DKV.remove(mid)
+    # drop the serving cache's compiled programs so their closures stop
+    # pinning the deleted model (and its device arrays)
+    from h2o3_tpu import serving
+    serving.CACHE.invalidate_key(mid)
     h._send({"__meta": {"schema_type": "ModelsV3"}})
 
 
@@ -388,7 +419,10 @@ def _h_predict(h: _Handler, mid, fid):
         return h._error("model or frame not found", 404)
     p = h._params()
     dest = p.get("predictions_frame")
-    pred = m.predict(f)
+    # micro-batched serving fast path: concurrent predictions against the
+    # same model coalesce into one padded device dispatch per bucket
+    from h2o3_tpu import serving
+    pred = serving.predict_via_rest(m, f)
     if dest:
         DKV.remove(pred.key)
         pred.key = dest
@@ -410,6 +444,31 @@ def _h_predict(h: _Handler, mid, fid):
     h._send({"__meta": {"schema_type": "ModelMetricsListSchemaV3"},
              "predictions_frame": {"name": pred.key},
              "model_metrics": mm_json})
+
+
+def _h_predict_rows(h: _Handler, mid):
+    """POST /3/Predictions/models/{m} — lightweight row-payload scoring:
+    JSON rows in, per-row predictions out, no DKV frame round-trip.
+    Body: {"rows": [[..] | {col: val}, ...], "columns": [names]?}.
+    Rides the micro-batch queue, so concurrent callers share one padded
+    device dispatch per bucket."""
+    m = DKV.get(mid)
+    if m is None or getattr(m, "_dinfo", None) is None:
+        return h._error(f"model {mid} not found", 404)
+    p = h._params()
+    rows = p.get("rows")
+    if isinstance(rows, str):
+        rows = json.loads(rows) if rows else []
+    if not isinstance(rows, list):
+        return h._error("rows must be a JSON list", 400)
+    cols = p.get("columns")
+    if isinstance(cols, str) and cols:
+        cols = json.loads(cols)
+    from h2o3_tpu import serving
+    preds = serving.score_payload(m, rows, cols)
+    h._send({"__meta": {"schema_type": "PredictionsRowsV3"},
+             "model": {"name": mid}, "predictions": preds,
+             "row_count": len(preds)})
 
 
 def _h_jobs(h: _Handler):
@@ -650,6 +709,7 @@ ROUTES = [
     (re.compile(r"/3/Models/([^/]+)"), "DELETE", _h_model_delete),
     (re.compile(r"/3/Predictions/models/([^/]+)/frames/([^/]+)"), "POST",
      _h_predict),
+    (re.compile(r"/3/Predictions/models/([^/]+)"), "POST", _h_predict_rows),
     (re.compile(r"/3/Jobs"), "GET", _h_jobs),
     (re.compile(r"/3/Jobs/([^/]+)"), "GET", _h_job),
     (re.compile(r"/99/Rapids"), "POST", _h_rapids),

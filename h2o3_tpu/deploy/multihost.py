@@ -303,7 +303,9 @@ class Broadcaster:
 
     def _drain_owed(self, i: int):
         """Consume acks a timed-out collect left in flight, so the next
-        frame's ack lines up with its own sequence number."""
+        broadcast's ack barrier lines up with its own sequence number.
+        Used by the (intentionally lockstep) broadcast path only; collect
+        absorbs stale acks inside its own bounded recv loop."""
         while self._owed[i] > 0:
             if self._recv_frame_at(i) is None:   # peer gone: stop spinning
                 break
@@ -337,6 +339,7 @@ class Broadcaster:
         accounting — one broken worker plus a scrape must not poison the
         replay channel for the healthy ones."""
         import socket as _socket
+        import time as _time
         with self._lock:
             self._seq += 1
             msg = {"seq": self._seq, "op": op}
@@ -344,8 +347,12 @@ class Broadcaster:
             for i, (c, key) in enumerate(self._conns):
                 if self._dead[i]:
                     continue
+                # ALWAYS send to live peers — a skipped send would leave a
+                # hole in that worker's sequence stream and kill it on the
+                # next frame ("bad seq"). Stale owed acks from earlier
+                # timed-out collects are absorbed in the recv phase below,
+                # inside this round's deadline.
                 try:
-                    self._drain_owed(i)
                     _send_frame(c, key, msg)
                     sent[i] = True
                 except Exception:   # noqa: BLE001 — peer broken, isolate it
@@ -355,8 +362,20 @@ class Broadcaster:
                 if not sent[i]:
                     out.append(None)
                     continue
+                deadline = _time.monotonic() + timeout
                 try:
-                    ack = self._recv_frame_at(i, timeout=timeout)
+                    while True:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            raise _socket.timeout("collect deadline")
+                        ack = self._recv_frame_at(i, timeout=remaining)
+                        if ack and self._owed[i] > 0 \
+                                and ack.get("ack") != self._seq:
+                            # stale ack from an earlier timed-out collect:
+                            # retire the debt, keep waiting for ours
+                            self._owed[i] -= 1
+                            continue
+                        break
                     if not ack or ack.get("ack") != self._seq:
                         raise RuntimeError(
                             f"replay channel: bad collect ack from {i}")

@@ -203,9 +203,22 @@ class H2ODeepLearningEstimator(ModelBase):
         }
 
     # ------------------------------------------------------------------
+    def __getstate__(self):
+        # derived jit wrapper is rebuilt on demand; never pickled
+        state = dict(self.__dict__)
+        state.pop("_forward_jit", None)
+        return state
+
     def _score_matrix(self, X):
+        # one jit wrapper PER MODEL, cached on the instance: the old
+        # jit(lambda) had a fresh identity per call and recompiled on
+        # every predict. Under the serving scorer cache this inlines into
+        # the outer program; the legacy big-batch path still runs fused.
+        fwd = self.__dict__.get("_forward_jit")
+        if fwd is None:
+            fwd = self._forward_jit = jax.jit(self._forward)
         Xz = jnp.where(jnp.isnan(X), 0.0, X)
-        out = jax.jit(lambda p, x: self._forward(p, x))(self._params_net, Xz)
+        out = fwd(self._params_net, Xz)
         if self.params.get("autoencoder"):
             return out
         if self._is_classifier:

@@ -155,8 +155,8 @@ class H2OExtendedIsolationForestEstimator(SharedTreeEstimator):
                          self._vals, self._D)
 
     def predict(self, test_data: Frame) -> Frame:
-        X = self._dinfo.matrix(test_data)
-        ml = np.asarray(self._score_matrix(X))[: test_data.nrows]
+        # bucketed compiled-scorer cache via _score_host (legacy for big n)
+        ml = np.asarray(self._score_host(test_data))[: test_data.nrows]
         score = 2.0 ** (-ml / self._cn)
         return Frame(["anomaly_score", "mean_length"],
                      [Vec.from_numpy(score.astype(np.float64)),

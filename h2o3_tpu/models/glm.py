@@ -918,10 +918,14 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                  jnp.ones((cum.shape[0], 1))], axis=1)
             return jnp.clip(jnp.diff(cum_full, axis=1), 0.0, 1.0)
         if st.family == MULTINOMIAL:
+            # plain jnp: a fresh jit(lambda) here had a new function
+            # identity per call and recompiled on EVERY predict; the
+            # serving fast path traces this whole method into one cached
+            # program anyway
             B = jnp.asarray(st.beta, jnp.float32)
-            return jax.jit(lambda Xi: jax.nn.softmax(Xi @ B.T, axis=1))(Xi)
+            return jax.nn.softmax(Xi @ B.T, axis=1)
         b = jnp.asarray(st.beta, jnp.float32)
-        eta = jax.jit(lambda Xi: Xi @ b)(Xi)
+        eta = Xi @ b
         mu = _linkinv(st.link, eta,
                       self.params.get("tweedie_link_power") or 1.0)
         if st.family in (BINOMIAL, QUASIBINOMIAL) and self._is_classifier:

@@ -109,7 +109,8 @@ class H2OGeneralizedLowRankEstimator(ModelBase):
         return jax.vmap(jnp.linalg.solve)(G, rhs)
 
     def predict(self, test_data: Frame) -> Frame:
-        A = np.asarray(self._score_matrix(self._dinfo.matrix(test_data)))
+        # bucketed compiled-scorer cache via _score_host (legacy for big n)
+        A = np.asarray(self._score_host(test_data))
         A = A[: test_data.nrows]
         return Frame([f"Arch{j+1}" for j in range(A.shape[1])],
                      [Vec.from_numpy(A[:, j].astype(np.float64))

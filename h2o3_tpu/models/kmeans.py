@@ -149,8 +149,8 @@ class H2OKMeansEstimator(ModelBase):
         return assign
 
     def predict(self, test_data: Frame) -> Frame:
-        X = self._dinfo.matrix(test_data)
-        assign = np.asarray(self._score_matrix(X))[: test_data.nrows]
+        # bucketed compiled-scorer cache via _score_host (legacy for big n)
+        assign = np.asarray(self._score_host(test_data))[: test_data.nrows]
         return Frame(["predict"], [Vec.from_numpy(assign.astype(np.float64))])
 
     def centers(self) -> np.ndarray:

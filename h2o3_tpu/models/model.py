@@ -138,71 +138,23 @@ class DataInfo:
         return len(self.feature_names)
 
     # ---- device-side matrix build --------------------------------------
-    def matrix(self, frame: Frame) -> jax.Array:
-        """(padded, n_features) f32 row-sharded design matrix. NaN padding rows
-        remain NaN in "label" mode; in onehot mode NAs are imputed/zeroed and
-        callers must use weights() to exclude padding."""
-        frame = self.adapt(frame)
+    def raw_columns(self) -> list:
+        """Column order of the RAW (pre-expansion) staging matrix consumed
+        by assemble_design: cat codes first, then numerics — the serving
+        fast path stages exactly these into its bucket buffer."""
         if self.cat_mode == "label":
-            return frame.matrix(self.predictors)
-        raw_cat = frame.matrix(self.cat_cols) if self.cat_cols else None
-        raw_num = frame.matrix(self.num_cols) if self.num_cols else None
+            return list(self.predictors)
+        return self.cat_cols + self.num_cols
+
+    def _assemble(self, raw_cat, raw_num):
+        """Expand raw columns into the design matrix (one-hot, standardize,
+        impute, interactions). Pure traceable jnp — callable eagerly, under
+        matrix()'s jit, or inside a serving scorer program."""
         cards = tuple(self.cardinalities[c] for c in self.cat_cols)
         means = np.array([self.means[c] for c in self.num_cols], np.float32)
-        sigmas = np.array([max(s, 1e-10) for c, s in
-                           ((c, self.sigmas[c]) for c in self.num_cols)],
-                          np.float32)
+        sigmas = np.array([max(self.sigmas[c], 1e-10)
+                           for c in self.num_cols], np.float32)
         standardize = self.standardize
-
-        def build(raw_cat, raw_num, means, sigmas):
-            parts = []
-            if raw_cat is not None:
-                for j, k in enumerate(cards):
-                    col = raw_cat[:, j]
-                    code = jnp.where(jnp.isnan(col), -1, col).astype(jnp.int32)
-                    parts.append(jax.nn.one_hot(code, k, dtype=jnp.float32))
-            if raw_num is not None:
-                x = raw_num
-                if standardize:
-                    x = (x - means) / sigmas
-                if self.impute_missing:
-                    fill = jnp.zeros_like(means) if standardize else means
-                    x = jnp.where(jnp.isnan(x), fill, x)
-                parts.append(x)
-            for (ia, ib, im, isg) in inter_idx:
-                p = raw_num[:, ia] * raw_num[:, ib]     # RAW product
-                if standardize:
-                    p = (p - im) / isg
-                if self.impute_missing:
-                    p = jnp.where(jnp.isnan(p),
-                                  0.0 if standardize else im, p)
-                parts.append(p[:, None])
-            for (ia, ib, ka, kb) in catcat_idx:
-                # interaction categorical: indicator over the level cross;
-                # NA in either factor -> all-zero row (InteractionWrappedVec)
-                ca = raw_cat[:, ia]
-                cb = raw_cat[:, ib]
-                bad = jnp.isnan(ca) | jnp.isnan(cb)
-                code = jnp.where(
-                    bad, -1,
-                    jnp.nan_to_num(ca) * kb + jnp.nan_to_num(cb)
-                ).astype(jnp.int32)
-                parts.append(jax.nn.one_hot(code, ka * kb,
-                                            dtype=jnp.float32))
-            for (ia, ib, ka, im, isg) in catnum_idx:
-                # cat x num wrapped vec: num value in the active level slot
-                ca = raw_cat[:, ia]
-                code = jnp.where(jnp.isnan(ca), -1, ca).astype(jnp.int32)
-                x = raw_num[:, ib]
-                if standardize:
-                    x = (x - im) / isg
-                if self.impute_missing:
-                    x = jnp.where(jnp.isnan(x), 0.0 if standardize else im,
-                                  x)
-                parts.append(jax.nn.one_hot(code, ka, dtype=jnp.float32)
-                             * x[:, None])
-            return jnp.concatenate(parts, axis=1)
-
         inter_idx = tuple(
             (self.num_cols.index(a), self.num_cols.index(b),
              np.float32(self.means[n]),
@@ -217,8 +169,89 @@ class DataInfo:
              self.cardinalities[a], np.float32(self.means[b]),
              np.float32(max(self.sigmas[b], 1e-10)))
             for a, b, _ in self.inter_catnum)
-        out_sh = _mesh.cloud().rows_sharding(2)
-        return jax.jit(build, out_shardings=out_sh)(raw_cat, raw_num, means, sigmas)
+        parts = []
+        if raw_cat is not None:
+            for j, k in enumerate(cards):
+                col = raw_cat[:, j]
+                code = jnp.where(jnp.isnan(col), -1, col).astype(jnp.int32)
+                parts.append(jax.nn.one_hot(code, k, dtype=jnp.float32))
+        if raw_num is not None:
+            x = raw_num
+            if standardize:
+                x = (x - means) / sigmas
+            if self.impute_missing:
+                fill = jnp.zeros_like(means) if standardize else means
+                x = jnp.where(jnp.isnan(x), fill, x)
+            parts.append(x)
+        for (ia, ib, im, isg) in inter_idx:
+            p = raw_num[:, ia] * raw_num[:, ib]     # RAW product
+            if standardize:
+                p = (p - im) / isg
+            if self.impute_missing:
+                p = jnp.where(jnp.isnan(p),
+                              0.0 if standardize else im, p)
+            parts.append(p[:, None])
+        for (ia, ib, ka, kb) in catcat_idx:
+            # interaction categorical: indicator over the level cross;
+            # NA in either factor -> all-zero row (InteractionWrappedVec)
+            ca = raw_cat[:, ia]
+            cb = raw_cat[:, ib]
+            bad = jnp.isnan(ca) | jnp.isnan(cb)
+            code = jnp.where(
+                bad, -1,
+                jnp.nan_to_num(ca) * kb + jnp.nan_to_num(cb)
+            ).astype(jnp.int32)
+            parts.append(jax.nn.one_hot(code, ka * kb,
+                                        dtype=jnp.float32))
+        for (ia, ib, ka, im, isg) in catnum_idx:
+            # cat x num wrapped vec: num value in the active level slot
+            ca = raw_cat[:, ia]
+            code = jnp.where(jnp.isnan(ca), -1, ca).astype(jnp.int32)
+            x = raw_num[:, ib]
+            if standardize:
+                x = (x - im) / isg
+            if self.impute_missing:
+                x = jnp.where(jnp.isnan(x), 0.0 if standardize else im,
+                              x)
+            parts.append(jax.nn.one_hot(code, ka, dtype=jnp.float32)
+                         * x[:, None])
+        return jnp.concatenate(parts, axis=1)
+
+    def assemble_design(self, raw):
+        """raw (rows, len(raw_columns())) f32 NaN-NA → design matrix.
+        Traceable; the serving scorer cache compiles it together with the
+        model's _score_matrix into ONE program per (model, bucket)."""
+        if self.cat_mode == "label":
+            return raw
+        ncat = len(self.cat_cols)
+        raw_cat = raw[:, :ncat] if ncat else None
+        raw_num = raw[:, ncat:] if self.num_cols else None
+        return self._assemble(raw_cat, raw_num)
+
+    def __getstate__(self):
+        # the jit wrapper is derived state, rebuilt on demand; never pickled
+        state = dict(self.__dict__)
+        state.pop("_assemble_jit", None)
+        return state
+
+    def matrix(self, frame: Frame) -> jax.Array:
+        """(padded, n_features) f32 row-sharded design matrix. NaN padding rows
+        remain NaN in "label" mode; in onehot mode NAs are imputed/zeroed and
+        callers must use weights() to exclude padding."""
+        frame = self.adapt(frame)
+        if self.cat_mode == "label":
+            return frame.matrix(self.predictors)
+        raw_cat = frame.matrix(self.cat_cols) if self.cat_cols else None
+        raw_num = frame.matrix(self.num_cols) if self.num_cols else None
+        # ONE jit wrapper per DataInfo: a fresh jax.jit(self._assemble)
+        # here would have a new identity (and empty trace cache) per call
+        # — the same per-call recompile hazard fixed in weights()/engine
+        fn = self.__dict__.get("_assemble_jit")
+        if fn is None:
+            out_sh = _mesh.cloud().rows_sharding(2)
+            fn = self._assemble_jit = jax.jit(self._assemble,
+                                              out_shardings=out_sh)
+        return fn(raw_cat, raw_num)
 
     def response(self, frame: Frame) -> jax.Array:
         """(padded,) f32 response; class index for categorical; NaN padding."""
@@ -232,13 +265,9 @@ class DataInfo:
             w = jnp.where(jnp.isnan(w), 0.0, w)
         else:
             w = jnp.ones(frame.padded_len, jnp.float32)
-        n = frame.nrows
-
-        @jax.jit
-        def mask(w):
-            idx = jnp.arange(w.shape[0])
-            return jnp.where(idx < n, w, 0.0)
-        return mask(w)
+        # n is a traced scalar: the old closure-over-n jit had a fresh
+        # function identity per call and recompiled on every invocation
+        return _mask_padding(w, frame.nrows)
 
     def offset(self, frame: Frame):
         if not self.offset_name:
@@ -280,6 +309,13 @@ class DataInfo:
         f = Frame(names, vecs)
         DKV.remove(f.key)  # adaptation product is transient, not registered
         return f
+
+
+@jax.jit
+def _mask_padding(w, n):
+    """Zero weights on padding rows; n traced, so one compile per shape."""
+    idx = jnp.arange(w.shape[0])
+    return jnp.where(idx < n, w, 0.0)
 
 
 def _fold_custom_metric(udf, mapped):
@@ -457,21 +493,50 @@ class ModelBase:
         return len(d) if d else 1
 
     def predict(self, test_data: Frame) -> Frame:
+        out = self._score_host(test_data)
+        return self._prediction_frame(out, test_data.nrows)
+
+    def _score_host(self, test_data: Frame) -> np.ndarray:
+        """Score a frame and fetch the result to host in ONE device→host
+        transfer. Serving-sized frames ride the compiled-scorer cache (no
+        recompile per row count); large frames take the legacy sharded
+        path, whose compile cost amortizes over the batch."""
+        from h2o3_tpu import serving
         from h2o3_tpu.parallel import mrtask as _mrt
-        X = self._dinfo.matrix(test_data)
-        out = self._score_matrix(X)
-        n = test_data.nrows
+        out = serving.score_frame(self, test_data)
+        if out is None:
+            X = self._dinfo.matrix(test_data)
+            out = _mrt.host_fetch(self._score_matrix(X))
+        return out
+
+    def _prediction_columns(self, out: np.ndarray, n: int) -> list:
+        """Host-side prediction column assembly — the ONE place that maps
+        raw scores to (name, float64 values, domain-or-None) columns.
+        Shared by _prediction_frame and the REST row-payload route, so
+        the two serving answers can never diverge. The classifier path
+        slices every p<level> column out of the ONE fetched copy — there
+        is exactly one device→host transfer per predict."""
         if self._is_classifier:
-            probs = _mrt.host_fetch(out)[:n]
+            probs = np.asarray(out, np.float64)[:n]
             pred = probs.argmax(axis=1).astype(np.float64)
             dom = self._dinfo.response_domain
-            cols = {"predict": Vec._from_floats(pred, np.zeros(n, bool),
-                                                T_CAT, np.asarray(dom, object))}
-            for k, lvl in enumerate(dom):
-                cols[f"p{lvl}"] = Vec.from_numpy(probs[:, k].astype(np.float64))
-            return Frame(list(cols), list(cols.values()))
-        pred = _mrt.host_fetch(out)[:n].astype(np.float64)
-        return Frame(["predict"], [Vec.from_numpy(pred)])
+            cols = [("predict", pred, dom)]
+            cols += [(f"p{lvl}", probs[:, k], None)
+                     for k, lvl in enumerate(dom)]
+            return cols
+        return [("predict", np.asarray(out, np.float64)[:n], None)]
+
+    def _prediction_frame(self, out: np.ndarray, n: int) -> Frame:
+        """Build the predictions Frame from host scores."""
+        names, vecs = [], []
+        for name, vals, dom in self._prediction_columns(out, n):
+            if dom is not None:
+                vecs.append(Vec._from_floats(vals, np.zeros(n, bool),
+                                             T_CAT, np.asarray(dom, object)))
+            else:
+                vecs.append(Vec.from_numpy(vals))
+            names.append(name)
+        return Frame(names, vecs)
 
     def model_performance(self, test_data: Optional[Frame] = None):
         if test_data is None:
@@ -479,12 +544,20 @@ class ModelBase:
         return self._compute_metrics(test_data)
 
     def _compute_metrics(self, frame: Frame):
+        from h2o3_tpu import serving
         di = self._dinfo
-        X = di.matrix(frame)
-        y = di.response(frame)
-        w = di.weights(frame)
-        w = jnp.where(jnp.isnan(y), 0.0, w)
-        out = self._score_matrix(X)
+        fast = serving.score_frame_with_response(self, frame)
+        if fast is not None:
+            # bucketed fast path: host (bucket,)-shaped y/w with w=0 on
+            # padding AND missing-response rows — padded rows can never
+            # poison the aggregates
+            out, y, w = fast
+        else:
+            X = di.matrix(frame)
+            y = di.response(frame)
+            w = di.weights(frame)
+            w = jnp.where(jnp.isnan(y), 0.0, w)
+            out = self._score_matrix(X)
         m = self._metrics_from_preds(y, out, w)
         cmf = self.params.get("custom_metric_func")
         if cmf and m is not None:

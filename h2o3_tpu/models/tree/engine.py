@@ -339,6 +339,45 @@ def stack_trees(tree_list, depth) -> TreeArrays:
         depth=depth, cover=cover)
 
 
+@functools.partial(jax.jit, static_argnames=("depth", "has_cat"))
+def _ensemble_walk(X, col, thr, nal, val, tw, catbits, iscat, *, depth,
+                   has_cat):
+    """Module-level jitted gather walk: cached per (shapes, depth, has_cat)
+    signature. Defining this as a closure inside predict_ensemble gave the
+    jit a fresh function identity per call — every single ensemble predict
+    retraced AND recompiled, which dominated serving latency."""
+    n = X.shape[0]
+    if has_cat:
+        nb = catbits.shape[-1] * 32
+
+    def per_tree(acc, t):
+        node = jnp.zeros(n, jnp.int32)
+
+        def step(d, node):
+            c = col[t][node]
+            leafish = c < 0
+            cc = jnp.maximum(c, 0)
+            x = jnp.take_along_axis(X, cc[:, None], axis=1)[:, 0]
+            isna = jnp.isnan(x)
+            right = x > thr[t][node]
+            if has_cat:
+                code = jnp.clip(jnp.nan_to_num(x).astype(jnp.int32),
+                                0, nb - 1)
+                word = catbits[t][node, code // 32]
+                bit = (word >> (code % 32).astype(jnp.uint32)) & 1
+                right = jnp.where(iscat[cc], bit == 1, right)
+            right = jnp.where(isna, ~nal[t][node], right)
+            child = 2 * node + 1 + right.astype(jnp.int32)
+            return jnp.where(leafish, node, child)
+
+        node = jax.lax.fori_loop(0, depth, step, node)
+        return acc + tw[t] * val[t][node], None
+
+    out, _ = jax.lax.scan(per_tree, jnp.zeros(n, jnp.float32),
+                          jnp.arange(col.shape[0]))
+    return out
+
+
 def predict_ensemble(X, trees: TreeArrays, weights=None):
     """Σ_t value[t, leaf_t(row)] — fixed-depth gather walk per tree.
     Categorical SET-split nodes route by bitset membership of the level id
@@ -349,83 +388,53 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
     val = jnp.asarray(trees.value)
     tw = (jnp.asarray(weights, jnp.float32) if weights is not None
           else jnp.ones(trees.ntrees, jnp.float32))
-    depth = trees.depth
     has_cat = trees.catbits is not None and trees.col_is_cat is not None \
         and bool(np.any(np.asarray(trees.col_is_cat)))
     if has_cat:
         catbits = jnp.asarray(trees.catbits)
         iscat = jnp.asarray(np.asarray(trees.col_is_cat))
-        nb = catbits.shape[-1] * 32
+    else:
+        # fixed dummy shapes so the no-cat program signature is stable
+        catbits = jnp.zeros((1, 1, 1), jnp.uint32)
+        iscat = jnp.zeros(1, bool)
+    return _ensemble_walk(X, col, thr, nal, val, tw, catbits, iscat,
+                          depth=trees.depth, has_cat=has_cat)
 
-    @jax.jit
-    def run(X, col, thr, nal, val, tw):
-        n = X.shape[0]
 
-        def per_tree(acc, t):
-            node = jnp.zeros(n, jnp.int32)
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _leaf_id_walk(X, col, thr, nal, *, depth):
+    """Module-level (cached) version of the leaf-id walk — same per-call
+    recompile hazard as _ensemble_walk."""
+    n = X.shape[0]
 
-            def step(d, node):
-                c = col[t][node]
-                leafish = c < 0
-                cc = jnp.maximum(c, 0)
-                x = jnp.take_along_axis(X, cc[:, None], axis=1)[:, 0]
-                isna = jnp.isnan(x)
-                right = x > thr[t][node]
-                if has_cat:
-                    code = jnp.clip(jnp.nan_to_num(x).astype(jnp.int32),
-                                    0, nb - 1)
-                    word = catbits[t][node, code // 32]
-                    bit = (word >> (code % 32).astype(jnp.uint32)) & 1
-                    right = jnp.where(iscat[cc], bit == 1, right)
-                right = jnp.where(isna, ~nal[t][node], right)
-                child = 2 * node + 1 + right.astype(jnp.int32)
-                return jnp.where(leafish, node, child)
+    def per_tree(_, t):
+        node = jnp.zeros(n, jnp.int32)
+        dep = jnp.zeros(n, jnp.int32)
 
-            node = jax.lax.fori_loop(0, depth, step, node)
-            return acc + tw[t] * val[t][node], None
+        def step(d, carry):
+            node, dep = carry
+            c = col[t][node]
+            leafish = c < 0
+            cc = jnp.maximum(c, 0)
+            x = jnp.take_along_axis(X, cc[:, None], axis=1)[:, 0]
+            isna = jnp.isnan(x)
+            right = jnp.where(isna, ~nal[t][node], x > thr[t][node])
+            child = 2 * node + 1 + right.astype(jnp.int32)
+            return (jnp.where(leafish, node, child),
+                    jnp.where(leafish, dep, dep + 1))
 
-        out, _ = jax.lax.scan(per_tree, jnp.zeros(n, jnp.float32),
-                              jnp.arange(col.shape[0]))
-        return out
+        node, dep = jax.lax.fori_loop(0, depth, step, (node, dep))
+        return None, (node, dep)
 
-    return run(X, col, thr, nal, val, tw)
+    _, (nodes, deps) = jax.lax.scan(per_tree, None,
+                                    jnp.arange(col.shape[0]))
+    return nodes, deps
 
 
 def predict_leaf_ids(X, trees: TreeArrays):
     """Per-(row, tree) terminal node ids and depths (IF path length, SHAP)."""
-    col = jnp.asarray(trees.col)
-    thr = jnp.asarray(trees.thr)
-    nal = jnp.asarray(trees.na_left)
-    depth = trees.depth
-
-    @jax.jit
-    def run(X, col, thr, nal):
-        n = X.shape[0]
-
-        def per_tree(_, t):
-            node = jnp.zeros(n, jnp.int32)
-            dep = jnp.zeros(n, jnp.int32)
-
-            def step(d, carry):
-                node, dep = carry
-                c = col[t][node]
-                leafish = c < 0
-                cc = jnp.maximum(c, 0)
-                x = jnp.take_along_axis(X, cc[:, None], axis=1)[:, 0]
-                isna = jnp.isnan(x)
-                right = jnp.where(isna, ~nal[t][node], x > thr[t][node])
-                child = 2 * node + 1 + right.astype(jnp.int32)
-                return (jnp.where(leafish, node, child),
-                        jnp.where(leafish, dep, dep + 1))
-
-            node, dep = jax.lax.fori_loop(0, depth, step, (node, dep))
-            return None, (node, dep)
-
-        _, (nodes, deps) = jax.lax.scan(per_tree, None,
-                                        jnp.arange(col.shape[0]))
-        return nodes, deps
-
-    return run(X, col, thr, nal)
+    return _leaf_id_walk(X, jnp.asarray(trees.col), jnp.asarray(trees.thr),
+                         jnp.asarray(trees.na_left), depth=trees.depth)
 
 
 # ===========================================================================

@@ -135,8 +135,10 @@ class H2OIsolationForestEstimator(SharedTreeEstimator):
         return self._mean_length(X)
 
     def predict(self, test_data: Frame) -> Frame:
-        X = self._dinfo.matrix(test_data)
-        ml = np.asarray(self._mean_length(X))[: test_data.nrows].astype(np.float64)
+        # _score_host prefers the serving compiled-scorer cache (bucketed,
+        # recompile-free); large frames fall back to the sharded path
+        ml = np.asarray(self._score_host(test_data),
+                        np.float64)[: test_data.nrows]
         span = max(self._max_len - self._min_len, 1e-12)
         score = (self._max_len - ml) / span   # H2O's observed-range normalization
         return Frame(["predict", "mean_length"],

@@ -335,6 +335,12 @@ def _install_jax_listeners():
                    "persistent XLA compilation cache hits")
     misses = counter("h2o3_xla_compile_cache_misses_total",
                      "persistent XLA compilation cache misses")
+    compiles = counter("h2o3_xla_compiles_total",
+                       "XLA backend compilations in this process (every "
+                       "new program x shape signature costs one)")
+    compile_secs = counter("h2o3_xla_compile_seconds_total",
+                           "cumulative wall time spent in XLA backend "
+                           "compilation")
 
     def _on_event(event: str, **kw):
         if event == "/jax/compilation_cache/cache_hits":
@@ -342,10 +348,22 @@ def _install_jax_listeners():
         elif event == "/jax/compilation_cache/cache_misses":
             misses.inc()
 
+    def _on_duration(event: str, duration: float, **kw):
+        if event == "/jax/core/compile/backend_compile_duration":
+            compiles.inc()
+            compile_secs.inc(max(duration, 0.0))
+
     try:
         _mon.register_event_listener(_on_event)
+        _mon.register_event_duration_secs_listener(_on_duration)
     except Exception:   # noqa: BLE001
         pass
+
+
+def xla_compile_count() -> float:
+    """Current process-wide XLA backend-compile count — the serving fast
+    path's regression metric (tests assert a warm bucket adds zero)."""
+    return REGISTRY.counter("h2o3_xla_compiles_total").value()
 
 
 def install_runtime_gauges():

@@ -1,0 +1,190 @@
+"""Serving fast path: shape-bucketed compiled-scorer cache + micro-batched
+REST scoring.
+
+Entry points:
+  * score_frame / score_frame_with_response — used by ModelBase.predict /
+    _compute_metrics: recompile-free bucketed scoring, or None → legacy.
+  * predict_via_rest — frame-based REST predictions routed through the
+    micro-batch queue (concurrent requests coalesce into one dispatch).
+  * score_payload — the lightweight row-payload scoring route: JSON rows
+    in, per-row prediction dicts out, no DKV frame round-trip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from h2o3_tpu.serving.scorer_cache import (     # noqa: F401
+    CACHE, FALLBACKS, Ineligible, model_token, row_bucket, score_frame,
+    score_frame_with_response, score_rows, stage_frame, stage_response,
+    _fastpath_reason)
+from h2o3_tpu.serving.microbatch import BATCHER, MicroBatcher  # noqa: F401
+
+
+def _microbatch_eligible(model, nrows: int) -> bool:
+    """Shared predicate for the two micro-batch entry points: models with
+    a custom predict (isofor score frames, GLRM archetypes, …) own their
+    output schema and must answer through model.predict; huge inputs,
+    strike-parked models and multihost clouds fall back too. Keep the
+    frame route and the row-payload route agreeing on this."""
+    from h2o3_tpu.serving import scorer_cache as _sc
+    from h2o3_tpu.models.model import ModelBase
+    return (type(model).predict is ModelBase.predict
+            and _fastpath_reason(model, nrows) is None
+            and not _sc._is_broken((model.key, model_token(model))))
+
+
+def predict_via_rest(model, frame):
+    """Micro-batched frame prediction for the REST layer. Ineligible
+    inputs (huge frames, untraceable models, multihost) fall back to
+    model.predict, which itself prefers the scorer cache."""
+    from h2o3_tpu.serving import scorer_cache as _sc
+    if not _microbatch_eligible(model, frame.nrows):
+        return model.predict(frame)
+    try:
+        di = model._dinfo
+        af = di.adapt(frame)
+        raw = stage_frame(di, af, frame.nrows)
+        out = BATCHER.score(model, raw, frame.nrows)
+    except Exception:   # noqa: BLE001 — serving must degrade, not 500
+        _sc._note_failure((model.key, model_token(model)))
+        FALLBACKS.inc(reason="trace-error")
+        return model.predict(frame)
+    return model._prediction_frame(out, frame.nrows)
+
+
+def _cat_code(v, lut):
+    if v is None or (isinstance(v, str) and v == ""):
+        return np.nan
+    if isinstance(v, str):
+        return lut.get(v, np.nan)
+    try:
+        code = int(v)
+    except (TypeError, ValueError):
+        return np.nan
+    return float(code) if 0 <= code < len(lut) else np.nan
+
+
+def _num(v):
+    if v is None or (isinstance(v, str) and v.strip() == ""):
+        return np.nan
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return np.nan
+
+
+def payload_to_raw(model, rows, columns=None) -> np.ndarray:
+    """JSON rows → (n, C_raw) staged f32 buffer in raw_columns() order.
+    Rows are dicts {col: value} or lists aligned with `columns` (or with
+    raw_columns() when columns is omitted). Categorical values may be
+    level strings or in-domain integer codes; anything else is NA."""
+    di = model._dinfo
+    raw_cols = di.raw_columns()
+    n = len(rows)
+    raw = np.full((n, len(raw_cols)), np.nan, np.float32)
+    if n == 0:
+        return raw
+    if isinstance(rows[0], dict):
+        cells = {c: [r.get(c) for r in rows] for c in raw_cols}
+    else:
+        names = [str(c) for c in (columns or raw_cols)]
+        pos = {c: names.index(c) for c in raw_cols if c in names}
+        cells = {c: ([r[pos[c]] if pos[c] < len(r) else None for r in rows]
+                     if c in pos else [None] * n)
+                 for c in raw_cols}
+    for j, c in enumerate(raw_cols):
+        dom = di.domains.get(c)
+        if dom is not None:
+            lut = {str(l): float(i) for i, l in enumerate(dom)}
+            raw[:, j] = [_cat_code(v, lut) for v in cells[c]]
+        else:
+            raw[:, j] = [_num(v) for v in cells[c]]
+    return raw
+
+
+def _payload_frame(model, raw: np.ndarray):
+    """Rebuild a typed Frame from a staged raw buffer — the fallback for
+    models the micro-batch fast path cannot serve (custom predict
+    schemas, untraceable scorers, multihost)."""
+    from h2o3_tpu.core.frame import Frame, Vec, T_CAT
+    di = model._dinfo
+    names, vecs = [], []
+    for j, c in enumerate(di.raw_columns()):
+        col = raw[:, j].astype(np.float64)
+        mask = np.isnan(col)
+        dom = di.domains.get(c)
+        if dom is not None:
+            vecs.append(Vec._from_floats(np.where(mask, 0.0, col), mask,
+                                         T_CAT, np.asarray(dom, object)))
+        else:
+            vecs.append(Vec.from_numpy(col))
+        names.append(c)
+    return Frame(names, vecs)
+
+
+def _frame_rows_to_dicts(pred) -> list:
+    """Generic per-row dicts from a predictions Frame (whatever columns
+    the model's predict emits: predict/p<level>, anomaly_score, Arch…)."""
+    from h2o3_tpu.core.frame import T_CAT
+    cols = []
+    for name, vec in zip(pred.names, pred.vecs):
+        vals = vec.to_numpy()
+        if vec.type == T_CAT:
+            dom = vec.domain
+            cols.append((name, [None if np.isnan(v) else str(dom[int(v)])
+                                for v in vals]))
+        else:
+            cols.append((name, [None if np.isnan(v) else float(v)
+                                for v in vals]))
+    return [{name: vals[i] for name, vals in cols}
+            for i in range(pred.nrows)]
+
+
+def score_payload(model, rows, columns=None) -> list:
+    """Score raw JSON rows; returns one prediction dict per row. Models
+    served by the base predict ride the micro-batch queue; custom-predict
+    models (isofor/EIF/GLRM output schemas), untraceable scorers and
+    multihost clouds go through a reconstructed Frame + model.predict so
+    the route's answer always matches frame-based scoring."""
+    from h2o3_tpu.serving import scorer_cache as _sc
+    from h2o3_tpu.core.kvstore import DKV
+    raw = payload_to_raw(model, rows, columns)
+    n = raw.shape[0]
+    if n == 0:
+        return []
+    use_fast = _microbatch_eligible(model, n)
+    if use_fast:
+        try:
+            out = BATCHER.score(model, raw, n)
+        except Exception:   # noqa: BLE001 — degrade to the frame path
+            _sc._note_failure((model.key, model_token(model)))
+            FALLBACKS.inc(reason="trace-error")
+            use_fast = False
+    if use_fast:
+        # same assembly as frame-based predict (_prediction_columns is
+        # the single source of truth), just formatted as dicts
+        cols = model._prediction_columns(np.asarray(out), n)
+        preds = []
+        for i in range(n):
+            d = {}
+            for name, vals, dom in cols:
+                v = vals[i]
+                if np.ndim(v):                      # multi-output rows
+                    d[name] = [float(x) for x in v]
+                elif np.isnan(v):
+                    d[name] = None
+                elif dom is not None:
+                    d[name] = str(dom[int(v)])
+                else:
+                    d[name] = float(v)
+            preds.append(d)
+        return preds
+    f = _payload_frame(model, raw)
+    try:
+        pred = model.predict(f)
+    finally:
+        DKV.remove(f.key)
+    out_rows = _frame_rows_to_dicts(pred)
+    DKV.remove(pred.key)
+    return out_rows

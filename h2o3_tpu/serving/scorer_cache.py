@@ -1,0 +1,383 @@
+"""Compiled-scorer cache — the recompile-free serving fast path.
+
+Problem: every `Model.predict` used to trace + XLA-compile a fresh program
+per unique row count (DataInfo.matrix jits a closure per call; several
+algos jitted per-call lambdas inside `_score_matrix`). Serving latency was
+dominated by compiles, not MXU time — the `h2o3_xla_compiles_total`
+counter climbed once per request.
+
+Design (hex/Model.java:1764 BigScore, re-keyed for XLA):
+  * Rows are padded up to POWER-OF-TWO buckets (then to the mesh row
+    granule), so any row count inside a bucket replays one resident
+    program. Padded rows carry NaN raw values; predictions for them are
+    garbage by construction and are trimmed host-side, while the metrics
+    path stages a weight vector that is 0 on padding — padded rows can
+    never poison predictions or aggregates.
+  * ONE jitted program per cache key compiles the whole pipeline:
+    raw staged columns → DataInfo.assemble_design (one-hot/standardize/
+    impute/interactions) → the algo's `_score_matrix` (tree gather loop,
+    GLM link, DL forward, KMeans assign, NB posterior, …).
+  * Cache key = (model key, model-object generation token, raw column
+    signature, dtype, bucket). The token is minted per model OBJECT
+    (weakref map), so overwriting a DKV key with a retrained model — a
+    different object — can never hit the old program, even when the
+    overwrite races an in-flight request holding the old object.
+  * Staging is HOST-side (numpy decode of the packed Vec codecs) into a
+    bucket-sized buffer + one `device_put` — neither ever compiles, which
+    is what makes "3 row counts in one bucket == 1 compile" hold.
+  * The staged device buffer is DONATED to the program (non-CPU backends),
+    so steady-state scoring reuses the same HBM for staging instead of
+    allocating fresh buffers per request.
+
+Env knobs:
+  H2O3_SCORER_CACHE_SIZE      max resident programs (LRU; default 64)
+  H2O3_SCORE_MIN_BUCKET       smallest row bucket (default 128)
+  H2O3_SCORE_FASTPATH_MAX_ROWS  row-count ceiling for the fast path
+                              (default 1<<20); larger batches take the
+                              legacy sharded path whose compile amortizes
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import weakref
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.parallel import mesh as _mesh
+from h2o3_tpu.parallel import mrtask as _mrt
+
+HITS = _om.counter("h2o3_scorer_cache_hits_total",
+                   "compiled-scorer cache hits (no trace, no compile)")
+MISSES = _om.counter("h2o3_scorer_cache_misses_total",
+                     "compiled-scorer cache misses (one trace+compile each)")
+EVICTIONS = _om.counter("h2o3_scorer_cache_evictions_total",
+                        "compiled scorers dropped by the LRU bound")
+FALLBACKS = _om.counter("h2o3_scorer_fallbacks_total",
+                        "scoring requests that took the legacy path, "
+                        "labeled by reason")
+ROWS_SCORED = _om.counter("h2o3_score_rows_total",
+                          "real (unpadded) rows scored via the fast path")
+
+
+def _cache_size() -> int:
+    return int(os.environ.get("H2O3_SCORER_CACHE_SIZE", "64"))
+
+
+def _min_bucket() -> int:
+    return int(os.environ.get("H2O3_SCORE_MIN_BUCKET", "128"))
+
+
+def _max_rows() -> int:
+    return int(os.environ.get("H2O3_SCORE_FASTPATH_MAX_ROWS", str(1 << 20)))
+
+
+def row_bucket(n: int) -> int:
+    """Power-of-two bucket ≥ n (≥ the min bucket), rounded to the mesh row
+    granule so the staged buffer row-shards evenly."""
+    b = _min_bucket()
+    while b < n:
+        b <<= 1
+    return _mesh.cloud().padded_rows(b)
+
+
+# ---------------------------------------------------------------------------
+# host-side decode of the packed Vec planes (no device programs, no compiles)
+class Ineligible(Exception):
+    """Raised during staging when a column cannot ride the fast path."""
+
+
+def _decode_host(vec) -> np.ndarray:
+    """(nrows,) f32 with NaN NAs decoded from a Vec's packed device plane.
+    One device→host copy of the PACKED dtype; the codec math runs in numpy.
+    """
+    from h2o3_tpu.core.frame import SparseVec
+    n = vec.nrows
+    if isinstance(vec, SparseVec):
+        out = np.zeros(n, np.float32)
+        rows = np.asarray(vec.nz_rows)
+        vals = np.asarray(vec.nz_vals)
+        keep = rows < n
+        out[rows[keep]] = vals[keep]
+        return out
+    if vec.data is None:
+        raise Ineligible(f"column type {vec.type!r} has no numeric staging")
+    data = np.asarray(vec.data)[:n]
+    c = vec.codec
+    if c.kind == "const":
+        out = np.full(n, np.float32(c.const_val), np.float32)
+    else:
+        out = data.astype(np.float32)
+        if c.bias:
+            out = out + np.float32(c.bias)
+    if vec.mask is not None:
+        m = np.asarray(vec.mask)[:n]
+        out = np.where(m != 0, np.float32(np.nan), out)
+    return out
+
+
+def stage_frame(dinfo, frame, rows: int) -> np.ndarray:
+    """(rows, C_raw) f32 staging buffer: the ADAPTED frame's raw predictor
+    columns in dinfo.raw_columns() order, NaN beyond frame.nrows."""
+    cols = dinfo.raw_columns()
+    raw = np.full((rows, len(cols)), np.nan, np.float32)
+    n = frame.nrows
+    for j, c in enumerate(cols):
+        raw[:n, j] = _decode_host(frame.vec(c))
+    return raw
+
+
+def stage_response(dinfo, frame, rows: int):
+    """(y, w) host vectors at bucket size: y NaN beyond n; w is 0 on
+    padding rows AND rows with missing response (the BigScore skip-NA
+    contract) so padded rows drop out of every weighted aggregate."""
+    n = frame.nrows
+    y = np.full(rows, np.nan, np.float32)
+    y[:n] = _decode_host(frame.vec(dinfo.response_name))
+    w = np.zeros(rows, np.float32)
+    if dinfo.weights_name and dinfo.weights_name in frame.names:
+        wv = _decode_host(frame.vec(dinfo.weights_name))
+        w[:n] = np.where(np.isnan(wv), 0.0, wv)
+    else:
+        w[:n] = 1.0
+    return y, np.where(np.isnan(y), 0.0, w)
+
+
+# ---------------------------------------------------------------------------
+# Per-model-object generation tokens. The cache key must pin the EXACT
+# model object a program closed over; re-reading a DKV version at lookup
+# time races with concurrent overwrites (thread A holds the old object,
+# thread B re-puts the key, A would cache the old model under the new
+# generation). A token minted per object travels with the object: an
+# overwritten DKV key maps to a different object, hence a different
+# token, and the stale program can never be hit again.
+_TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_TOKEN_COUNTER = itertools.count(1)
+_TOKEN_LOCK = threading.Lock()
+
+
+def model_token(model) -> int:
+    with _TOKEN_LOCK:
+        t = _TOKENS.get(model)
+        if t is None:
+            t = _TOKENS[model] = next(_TOKEN_COUNTER)
+        return t
+
+
+class ScorerCache:
+    """LRU of compiled scorer programs, keyed by
+    (model key, model-object token, raw column signature, dtype, bucket).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: OrderedDict = OrderedDict()
+        self._building: dict = {}   # key → per-key build lock
+        _om.gauge("h2o3_scorer_cache_entries",
+                  "compiled scorer programs currently resident",
+                  fn=lambda: float(len(self._entries)))
+
+    def program(self, model, bucket: int):
+        di = model._dinfo
+        key = (model.key, model_token(model),
+               tuple(di.raw_columns()), "float32", bucket)
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                HITS.inc()
+                return fn
+            # per-key build lock: concurrent cold misses for the same
+            # program must compile ONCE — the second caller waits for the
+            # first instead of paying a duplicate multi-second compile
+            build_lock = self._building.setdefault(key, threading.Lock())
+        with build_lock:
+            with self._lock:
+                fn = self._entries.get(key)
+                if fn is not None:
+                    self._entries.move_to_end(key)
+                    HITS.inc()
+                    return fn
+            MISSES.inc()
+            try:
+                fn = self._build(model)
+            except Exception:
+                with self._lock:
+                    self._building.pop(key, None)
+                raise
+            # publish while STILL holding the build lock: a queued
+            # cold-miss thread must find the entry on its double-check,
+            # not rebuild it
+            with self._lock:
+                self._building.pop(key, None)
+                # purge other generations of this DKV key NOW rather than
+                # waiting for LRU pressure: entries close over the model
+                # object, so a retrain loop would otherwise pin dead
+                # models (and their compiled executables) in memory
+                stale = [k for k in self._entries
+                         if k[0] == key[0] and k[1] != key[1]]
+                for k in stale:
+                    del self._entries[k]
+                    EVICTIONS.inc()
+                with _BROKEN_LOCK:
+                    for k in [b for b in _BROKEN
+                              if b[0] == key[0] and b[1] != key[1]]:
+                        _BROKEN.pop(k, None)
+                self._entries[key] = fn
+                while len(self._entries) > _cache_size():
+                    self._entries.popitem(last=False)
+                    EVICTIONS.inc()
+        return fn
+
+    @staticmethod
+    def _build(model):
+        di = model._dinfo
+
+        def _score(raw):
+            return model._score_matrix(di.assemble_design(raw))
+
+        # Known tradeoff: the model's parameters (tree arrays, net
+        # weights) are traced in as closure constants, so each bucket's
+        # executable embeds its own copy. Serving row counts cluster into
+        # a handful of buckets and the LRU bounds the total, but a
+        # huge-ensemble model served across many buckets pays the
+        # duplication; passing the arrays as shared device arguments is
+        # the follow-up if that bites (ROADMAP open item).
+        #
+        # donate the staged buffer: the program may alias its HBM for the
+        # design matrix / outputs, so steady-state scoring does no fresh
+        # allocation. CPU has no donation — gate it to avoid warnings.
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        return jax.jit(_score, donate_argnums=donate)
+
+    def invalidate_key(self, model_key: str):
+        """Drop every resident program (and failure strikes) for a DKV
+        model key — called on model deletion so the cache's closures stop
+        pinning the dead model. Other deletions are bounded by the LRU."""
+        with self._lock:
+            for k in [k for k in self._entries if k[0] == model_key]:
+                del self._entries[k]
+                EVICTIONS.inc()
+            with _BROKEN_LOCK:
+                for b in [b for b in _BROKEN if b[0] == model_key]:
+                    _BROKEN.pop(b, None)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+CACHE = ScorerCache()
+
+# (model key, token) → (consecutive failure count, last failure time).
+# Three consecutive strikes PARK the model on the legacy path for a
+# cooldown window rather than permanently: _is_broken short-circuits
+# before any attempt, so without the cooldown no _note_success could ever
+# run again and one bad burst (e.g. three co-batched timeouts during a
+# single device stall) would disable the model for the process lifetime.
+# After the cooldown one probe attempt is allowed — success clears the
+# record, failure re-arms the window. A retrain mints a new token and
+# starts clean; stale tokens are pruned on the next compile for the key.
+_BROKEN: dict = {}
+_BROKEN_LOCK = threading.Lock()
+_BROKEN_STRIKES = 3
+_BROKEN_COOLDOWN_S = 60.0
+
+
+def _note_failure(key: tuple):
+    import time as _time
+    with _BROKEN_LOCK:
+        count = _BROKEN.get(key, (0, 0.0))[0] + 1
+        _BROKEN[key] = (count, _time.monotonic())
+
+
+def _note_success(key: tuple):
+    with _BROKEN_LOCK:
+        _BROKEN.pop(key, None)
+
+
+def _is_broken(key: tuple) -> bool:
+    import time as _time
+    with _BROKEN_LOCK:
+        count, last = _BROKEN.get(key, (0, 0.0))
+    if count < _BROKEN_STRIKES:
+        return False
+    return _time.monotonic() - last < _BROKEN_COOLDOWN_S
+
+
+def _fastpath_reason(model, nrows: int):
+    """None when the fast path applies, else a fallback-counter label."""
+    if jax.process_count() > 1:
+        return "multihost"
+    di = getattr(model, "_dinfo", None)
+    if di is None or not getattr(model, "key", None):
+        return "no-dinfo"
+    if nrows <= 0:
+        return "empty"
+    if nrows > _max_rows():
+        return "too-large"
+    if getattr(model, "_serving_fastpath", True) is False:
+        return "model-opt-out"
+    return None
+
+
+def score_rows(model, raw: np.ndarray, n: int) -> np.ndarray:
+    """Dispatch a staged (bucket, C) host buffer through the cached
+    program. Returns the HOST result still at bucket length (rows beyond n
+    are garbage; callers trim)."""
+    fn = CACHE.program(model, raw.shape[0])
+    out = fn(_mrt.device_put_rows(raw))
+    ROWS_SCORED.inc(n)
+    return np.asarray(out)
+
+
+def _fast_scored(model, frame, with_response: bool):
+    """Shared eligibility + strike accounting + staged dispatch for the
+    two frame entry points. Returns the fast-path result or None (legacy
+    path)."""
+    reason = _fastpath_reason(model, frame.nrows)
+    if reason is not None:
+        FALLBACKS.inc(reason=reason)
+        return None
+    key = (model.key, model_token(model))
+    if _is_broken(key):
+        FALLBACKS.inc(reason="trace-error")
+        return None
+    try:
+        di = model._dinfo
+        af = di.adapt(frame)
+        bucket = row_bucket(frame.nrows)
+        raw = stage_frame(di, af, bucket)
+        yw = stage_response(di, af, bucket) if with_response else None
+        out = score_rows(model, raw, frame.nrows)
+        _note_success(key)
+        return (out, *yw) if with_response else out
+    except Exception:   # noqa: BLE001 — fast path must never break scoring
+        _note_failure(key)
+        FALLBACKS.inc(reason="trace-error")
+        from h2o3_tpu.utils import log as _log
+        import traceback
+        _log.warn(f"serving fast path failed for {key}: "
+                  f"{traceback.format_exc(limit=3)}")
+        return None
+
+
+def score_frame(model, frame):
+    """Fast-path scoring of a Frame: host result at bucket length, or None
+    when the caller must take the legacy sharded path."""
+    return _fast_scored(model, frame, with_response=False)
+
+
+def score_frame_with_response(model, frame):
+    """(out, y, w) at bucket length for the metrics path, or None for the
+    legacy path. w is 0 on padding and missing-response rows."""
+    di = getattr(model, "_dinfo", None)
+    if di is None or not di.response_name \
+            or di.response_name not in frame.names:
+        return None
+    return _fast_scored(model, frame, with_response=True)

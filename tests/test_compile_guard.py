@@ -1,0 +1,57 @@
+"""Tier-1 compile-count regression guard.
+
+The serving fast path's whole value is that repeated scoring NEVER
+recompiles: scoring one model at several row counts inside one row bucket
+must cost at most ONE XLA backend compile (the first trace of that
+bucket's program). A future change that sneaks a per-shape jit back into
+the predict path (a closure jit, an unbucketed matrix build, a per-call
+lambda) makes this test fail immediately.
+
+Compile observations come from jax.monitoring's
+/jax/core/compile/backend_compile_duration events, surfaced as the
+h2o3_xla_compiles_total counter by h2o3_tpu/obs/metrics.py.
+"""
+
+import numpy as np
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.models import ESTIMATORS
+from h2o3_tpu.obs import metrics as om
+from h2o3_tpu.serving import scorer_cache as sc
+
+RNG = np.random.default_rng(11)
+
+
+def _frame(n, with_resp=False):
+    cols = {"a": RNG.normal(size=n), "b": RNG.normal(size=n),
+            "c": RNG.choice(["u", "v"], size=n)}
+    if with_resp:
+        cols["resp"] = RNG.choice(["no", "yes"], size=n)
+    return Frame.from_dict(cols)
+
+
+def test_one_bucket_three_row_counts_at_most_one_compile():
+    fr = _frame(250, with_resp=True)
+    m = ESTIMATORS["glm"](family="binomial")
+    m.train(x=["a", "b", "c"], y="resp", training_frame=fr)
+
+    bucket = sc.row_bucket(1)
+    counts = [max(2, bucket - 40), max(3, bucket - 20), bucket]
+    assert len({sc.row_bucket(n) for n in counts}) == 1, \
+        "test row counts must share one bucket"
+
+    keys = [fr.key, m.key]
+    c0 = om.xla_compile_count()
+    for n in counts:
+        f = _frame(n)
+        p = m.predict(f)
+        assert p.nrows == n
+        keys += [f.key, p.key]
+    compiled = om.xla_compile_count() - c0
+    assert compiled <= 1, (
+        f"scoring 3 row counts in one bucket took {compiled} XLA compiles "
+        "(expected ≤1) — a per-shape recompile crept back into the "
+        "serving path")
+    for k in keys:
+        DKV.remove(k)

@@ -1,0 +1,256 @@
+"""Serving fast path: shape-bucketed compiled-scorer cache + micro-batched
+scoring (h2o3_tpu/serving). Covers the tentpole contract: warm buckets
+never recompile, padded rows never leak into predictions or metrics, DKV
+overwrites invalidate cached programs, and concurrent micro-batched
+requests each get their own rows back."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.models import ESTIMATORS
+from h2o3_tpu.obs import metrics as om
+from h2o3_tpu import serving
+from h2o3_tpu.serving import scorer_cache as sc
+
+RNG = np.random.default_rng(7)
+
+
+def _train_frame(n=300, key=None):
+    return Frame.from_dict(
+        {"a": RNG.normal(size=n), "b": RNG.normal(size=n),
+         "c": RNG.choice(["x", "y", "z"], size=n),
+         "resp": RNG.choice(["no", "yes"], size=n)}, key=key)
+
+
+def _test_frame(n):
+    return Frame.from_dict(
+        {"a": RNG.normal(size=n), "b": RNG.normal(size=n),
+         "c": RNG.choice(["x", "y", "z"], size=n)})
+
+
+@pytest.fixture(scope="module")
+def glm_model():
+    fr = _train_frame()
+    m = ESTIMATORS["glm"](family="binomial")
+    m.train(x=["a", "b", "c"], y="resp", training_frame=fr)
+    yield m
+    DKV.remove(fr.key)
+    DKV.remove(m.key)
+
+
+def _legacy_scores(m, f):
+    from h2o3_tpu.parallel import mrtask as mrt
+    X = m._dinfo.matrix(f)
+    return mrt.host_fetch(m._score_matrix(X))[: f.nrows]
+
+
+# ---------------------------------------------------------------------------
+def test_cache_hit_on_second_same_bucket_call(glm_model):
+    m = glm_model
+    f1, f2 = _test_frame(40), _test_frame(55)
+    m.predict(f1)                       # warm the bucket
+    hits0, miss0 = sc.HITS.value(), sc.MISSES.value()
+    c0 = om.xla_compile_count()
+    p = m.predict(f2)                   # same bucket, different row count
+    assert sc.HITS.value() == hits0 + 1
+    assert sc.MISSES.value() == miss0
+    # the warm call must not trigger a single XLA compile
+    assert om.xla_compile_count() == c0
+    assert p.nrows == 55
+    for k in (f1.key, f2.key, p.key):
+        DKV.remove(k)
+
+
+def test_bucket_boundary_correctness(glm_model):
+    m = glm_model
+    bucket = sc.row_bucket(1)
+    for n in (bucket - 1, bucket, bucket + 1):
+        f = _test_frame(n)
+        pred = m.predict(f)
+        assert pred.nrows == n
+        fast = np.column_stack([pred.vec("pno").to_numpy(),
+                                pred.vec("pyes").to_numpy()])
+        legacy = _legacy_scores(m, f)
+        np.testing.assert_allclose(fast, legacy, rtol=1e-5, atol=1e-6)
+        DKV.remove(f.key)
+        DKV.remove(pred.key)
+
+
+def test_padded_rows_excluded_from_metrics(glm_model, monkeypatch):
+    m = glm_model
+    n = 100                              # bucket 128 → 28 padded rows
+    f = Frame.from_dict(
+        {"a": RNG.normal(size=n), "b": RNG.normal(size=n),
+         "c": RNG.choice(["x", "y", "z"], size=n),
+         "resp": RNG.choice(["no", "yes"], size=n)})
+    fast = m.model_performance(f)
+    # force the legacy (mesh-padded, weight-masked) path and compare
+    monkeypatch.setenv("H2O3_SCORE_FASTPATH_MAX_ROWS", "0")
+    legacy = m.model_performance(f)
+    monkeypatch.delenv("H2O3_SCORE_FASTPATH_MAX_ROWS")
+    assert fast.logloss == pytest.approx(legacy.logloss, rel=1e-5)
+    assert fast.auc == pytest.approx(legacy.auc, rel=1e-5)
+    assert fast.mse == pytest.approx(legacy.mse, rel=1e-5)
+    DKV.remove(f.key)
+
+
+def test_padded_rows_excluded_even_at_tiny_n(glm_model):
+    """2 real rows in a ≥128 bucket: any padding leakage would swamp the
+    aggregates."""
+    m = glm_model
+    f = Frame.from_dict(
+        {"a": np.array([0.0, 1.0]), "b": np.array([1.0, -1.0]),
+         "c": np.array(["x", "y"]),
+         "resp": np.array(["no", "yes"])})
+    perf = m.model_performance(f)
+    legacy = _legacy_scores(m, f)
+    # logloss over exactly the 2 real rows
+    y = np.array([0.0, 1.0])
+    p = np.clip(legacy[:, 1], 1e-15, 1 - 1e-15)
+    want = float(-(y * np.log(p) + (1 - y) * np.log(1 - p)).mean())
+    assert perf.logloss == pytest.approx(want, rel=1e-4)
+    DKV.remove(f.key)
+
+
+def test_cache_invalidation_on_dkv_overwrite():
+    fr = _train_frame(200, key="inval_train")
+    key = "inval_model"
+    m1 = ESTIMATORS["glm"](family="binomial", model_id=key)
+    m1.train(x=["a", "b"], y="resp", training_frame=fr)
+    f = _test_frame(30)
+    p1 = m1.predict(f)
+    probs1 = p1.vec("pyes").to_numpy()
+
+    # overwrite the SAME DKV key with a different model; the cached
+    # program for (key, old generation) must never serve it
+    fr2 = Frame.from_dict(
+        {"a": RNG.normal(size=200) * 3 + 1, "b": RNG.normal(size=200),
+         "resp": RNG.choice(["no", "yes"], size=200)}, key="inval_train2")
+    m2 = ESTIMATORS["glm"](family="binomial", model_id=key)
+    m2.train(x=["a", "b"], y="resp", training_frame=fr2)   # DKV.put(key, m2)
+    assert DKV.get(key) is m2
+    miss0 = sc.MISSES.value()
+    p2 = m2.predict(f)
+    assert sc.MISSES.value() == miss0 + 1   # fresh program, not m1's
+    probs2 = p2.vec("pyes").to_numpy()
+    legacy2 = _legacy_scores(m2, f)[:, 1]
+    np.testing.assert_allclose(probs2, legacy2, rtol=1e-5, atol=1e-6)
+    assert not np.allclose(probs1, probs2)
+    for k in (fr.key, fr2.key, f.key, p1.key, p2.key, key):
+        DKV.remove(k)
+
+
+def test_concurrent_microbatch_per_request_rows(glm_model, monkeypatch):
+    m = glm_model
+    monkeypatch.setenv("H2O3_SCORE_LINGER_MS", "150")
+    rows = {
+        t: [{"a": float(t), "b": float(-t), "c": "x"},
+            {"a": float(t) / 2, "b": 0.0, "c": "y"}]
+        for t in range(4)
+    }
+    # singleton baseline (no concurrency): per-row expected predictions
+    monkeypatch.setenv("H2O3_SCORE_LINGER_MS", "0")
+    want = {t: serving.score_payload(m, r) for t, r in rows.items()}
+    monkeypatch.setenv("H2O3_SCORE_LINGER_MS", "150")
+
+    from h2o3_tpu.serving import microbatch as mb
+    req0 = mb.REQUESTS.value()
+    disp0 = mb.DISPATCHES.value()
+    got = {}
+    errs = []
+    barrier = threading.Barrier(len(rows))
+
+    def worker(t):
+        try:
+            barrier.wait(timeout=10)
+            got[t] = serving.score_payload(m, rows[t])
+        except Exception as ex:   # noqa: BLE001
+            errs.append(ex)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in rows]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errs
+    for t in rows:
+        assert got[t] == want[t], f"thread {t} got another request's rows"
+    assert mb.REQUESTS.value() - req0 == len(rows)
+    # coalescing: 4 concurrent requests must not take 4 dispatches
+    assert mb.DISPATCHES.value() - disp0 < len(rows)
+
+
+def test_gbm_tree_scorer_rides_cache_with_parity():
+    """The tree-engine gather-loop scorer (the headline serving case)
+    through the bucketed cache: warm same-bucket predict adds zero
+    compiles and matches the legacy sharded path exactly."""
+    fr = _train_frame(150)
+    m = ESTIMATORS["gbm"](ntrees=2, max_depth=2, seed=1,
+                          histogram_type="UniformAdaptive")
+    m.train(x=["a", "b", "c"], y="resp", training_frame=fr)
+    f1, f2 = _test_frame(30), _test_frame(45)
+    p1 = m.predict(f1)                    # warm the bucket
+    c0 = om.xla_compile_count()
+    p2 = m.predict(f2)
+    assert om.xla_compile_count() == c0, \
+        "warm same-bucket GBM predict recompiled"
+    fast = np.column_stack([p2.vec("pno").to_numpy(),
+                            p2.vec("pyes").to_numpy()])
+    np.testing.assert_allclose(fast, _legacy_scores(m, f2),
+                               rtol=1e-5, atol=1e-6)
+    for k in (fr.key, f1.key, f2.key, p1.key, p2.key, m.key):
+        DKV.remove(k)
+
+
+def test_fallback_reasons_counted(glm_model, monkeypatch):
+    m = glm_model
+    f = _test_frame(10)
+    monkeypatch.setenv("H2O3_SCORE_FASTPATH_MAX_ROWS", "1")
+    fb0 = sc.FALLBACKS.value(reason="too-large")
+    out = serving.score_frame(m, f)
+    assert out is None
+    assert sc.FALLBACKS.value(reason="too-large") == fb0 + 1
+    # legacy path still serves the prediction
+    pred = m.predict(f)
+    assert pred.nrows == 10
+    DKV.remove(f.key)
+    DKV.remove(pred.key)
+
+
+def test_payload_custom_predict_schema_preserved():
+    """Models with a custom predict (isofor's anomaly-score frame) must
+    answer the row-payload route with THAT schema, not raw _score_matrix
+    output — the route reconstructs a frame and calls model.predict."""
+    rng = np.random.default_rng(5)
+    fr = Frame.from_dict({"a": rng.normal(size=80),
+                          "b": rng.normal(size=80)})
+    m = ESTIMATORS["isolationforest"](ntrees=3, max_depth=3, seed=1,
+                                      sample_size=64)
+    m.train(x=["a", "b"], training_frame=fr)
+    preds = serving.score_payload(m, [{"a": 0.0, "b": 0.0},
+                                      {"a": 4.0, "b": -4.0}])
+    assert len(preds) == 2
+    assert set(preds[0]) == {"predict", "mean_length"}
+    # the outlier must look more anomalous than the inlier
+    assert preds[1]["predict"] > preds[0]["predict"]
+    DKV.remove(fr.key)
+    DKV.remove(m.key)
+
+
+def test_payload_scoring_matches_frame_scoring(glm_model):
+    m = glm_model
+    f = _test_frame(8)
+    pred = m.predict(f)
+    via_frame = pred.vec("pyes").to_numpy()
+    cols = f.to_numpy()
+    dom = f.vec("c").domain
+    payload = [{"a": float(cols[i, 0]), "b": float(cols[i, 1]),
+                "c": str(dom[int(cols[i, 2])])} for i in range(8)]
+    via_rows = [p["pyes"] for p in serving.score_payload(m, payload)]
+    np.testing.assert_allclose(via_rows, via_frame, rtol=1e-5, atol=1e-6)
+    DKV.remove(f.key)
+    DKV.remove(pred.key)
