@@ -1,0 +1,30 @@
+"""h2o3_tpu.analysis — JAX-aware static analyzer + runtime sanitizers.
+
+The reference gates its Java tree with findbugs/error-prone; this package
+is the analog for a JAX serving runtime, with rules distilled from defect
+classes this repo actually shipped:
+
+  R001 jit-in-hot-path   jax.jit on a lambda/closure built per call →
+                         recompiles every invocation
+  R002 host-sync         np.asarray/.item()/.tolist()/block_until_ready
+                         under trace or inside timeline.span hot paths
+  R003 lock-discipline   self.X mutated both under `with self._lock` and
+                         bare
+  R004 impure-jit        time.*/random.*/global mutation captured at
+                         trace time
+  R005 metric-name drift h2o3_* metric declared twice / non-literal name /
+                         inconsistent label sets (census: obs/METRICS.md)
+  R006 route drift       REST route capture groups vs handler signatures
+
+Run `python -m h2o3_tpu.analysis --baseline analysis_baseline.json`; the
+tier-1 suite enforces zero unsuppressed findings. Runtime sanitizers
+(transfer_guard / debug_nans) live in .sanitizers.
+"""
+
+from h2o3_tpu.analysis.engine import (   # noqa: F401
+    Finding, analyze_paths, analyze_source, apply_baseline, load_baseline,
+    package_root, repo_root, run, unsuppressed, write_baseline)
+from h2o3_tpu.analysis.sanitizers import (   # noqa: F401
+    debug_nans, install_from_env, transfer_guard)
+
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006")

@@ -1,0 +1,90 @@
+"""CLI: python -m h2o3_tpu.analysis [paths] [options].
+
+Exit status is the contract: 0 when every finding is suppressed or
+baselined, 1 otherwise — so the tier-1 test and any pre-commit hook can
+shell out to the same entry point the developer runs locally.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from h2o3_tpu.analysis import engine
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m h2o3_tpu.analysis",
+        description="JAX-aware static analyzer (rules R001-R006)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the h2o3_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=None, metavar="JSON",
+                    help="baseline file of grandfathered findings "
+                         "(e.g. analysis_baseline.json)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset, e.g. R001,R003")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--all", action="store_true",
+                    help="also print suppressed/baselined findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings into --baseline")
+    ap.add_argument("--write-census", nargs="?", metavar="PATH",
+                    const="__default__", default=None,
+                    help="write the metric census markdown (default: "
+                         "h2o3_tpu/obs/METRICS.md)")
+    args = ap.parse_args(argv)
+
+    rules = [r.strip().upper() for r in args.rules.split(",")] \
+        if args.rules else None
+    paths = args.paths or [engine.package_root()]
+    mods = engine.load_modules(paths)
+    findings = engine.analyze_modules(mods, rules=rules)
+
+    if args.write_census is not None:
+        from h2o3_tpu.analysis import rules_metrics
+        out = args.write_census
+        if out == "__default__":
+            out = os.path.join(engine.package_root(), "obs", "METRICS.md")
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(rules_metrics.census_markdown(mods))
+        print(f"census written: {out}", file=sys.stderr)
+
+    if args.baseline and not args.write_baseline:
+        engine.apply_baseline(findings, engine.load_baseline(args.baseline))
+    if args.write_baseline:
+        path = args.baseline or "analysis_baseline.json"
+        engine.write_baseline(findings, path)
+        print(f"baseline written: {path} "
+              f"({len([f for f in findings if not f.suppressed])} findings "
+              "grandfathered)", file=sys.stderr)
+        return 0
+
+    bad = engine.unsuppressed(findings)
+    shown = findings if args.all else bad
+    if args.as_json:
+        print(json.dumps({"findings": [f.to_dict() for f in shown],
+                          "unsuppressed": len(bad),
+                          "total": len(findings)}, indent=2))
+    else:
+        for f in shown:
+            tag = ""
+            if f.suppressed:
+                tag = " [suppressed]"
+            elif f.baselined:
+                tag = " [baselined]"
+            print(f"{f}{tag}")
+        n_sup = sum(1 for f in findings if f.suppressed)
+        n_base = sum(1 for f in findings if f.baselined)
+        print(f"{len(findings)} finding(s): {len(bad)} unsuppressed, "
+              f"{n_sup} suppressed inline, {n_base} baselined",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""Analysis engine — findings, suppressions, baseline, orchestration.
+
+The analyzer is AST-based and zero-dependency (stdlib only): it must run
+in CI images and pre-commit hooks without importing jax or the package
+under analysis. Every rule is derived from a defect class this repo
+actually shipped (see rules_*.py docstrings); the engine is the part that
+turns rule hits into actionable, machine-readable findings:
+
+  * Finding — rule id, file:line, message, plus a line-content fingerprint
+    so baselines survive unrelated edits shifting line numbers.
+  * Inline suppression — a `# h2o3-ok: R003 <reason>` comment on the
+    flagged line (or the line above, for multi-line statements) waives the
+    listed rules at that site. The reason is mandatory by convention: a
+    waiver without a why is a finding waiting to regress.
+  * Baseline — grandfathered findings recorded in a JSON file
+    (analysis_baseline.json); the tier-1 gate fails only on findings that
+    are neither suppressed nor baselined, so new debt cannot land while
+    old debt is paid down incrementally.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    rule: str                 # "R001".."R006"
+    file: str                 # repo-relative path
+    line: int
+    message: str
+    snippet: str = ""         # stripped source line (fingerprint input)
+    suppressed: bool = False  # inline `# h2o3-ok:` waiver
+    baselined: bool = False   # matched an analysis_baseline.json entry
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity across line-number drift: rule + file + the
+        normalized content of the flagged line."""
+        basis = f"{self.rule}:{self.file}:{' '.join(self.snippet.split())}"
+        return hashlib.sha1(basis.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "fingerprint": self.fingerprint,
+                "suppressed": self.suppressed, "baselined": self.baselined}
+
+    def __str__(self):
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Module:
+    """One parsed source file handed to the rules."""
+    path: str                 # absolute
+    rel: str                  # repo-relative (finding/baseline identity)
+    source: str
+    tree: ast.AST
+    lines: list = field(default_factory=list)
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+_SUPPRESS_RE = re.compile(r"#\s*h2o3-ok:\s*([A-Z0-9,\s]+?)(?:\s+\S.*)?$")
+
+
+def _suppressions(lines: list) -> dict:
+    """{lineno: {rule, ...}} from `# h2o3-ok: R001[,R002] reason` comments.
+    A waiver covers its own line and the line below it, so it can sit
+    above a multi-line statement whose node starts on the next line."""
+    out: dict = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def package_root() -> str:
+    """The h2o3_tpu package directory (default analysis target)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def _iter_py_files(paths) -> list:
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def load_modules(paths) -> list:
+    root = repo_root()
+    mods = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError) as ex:
+            # an unparseable file is itself a finding-worthy defect, but
+            # the compiler owns syntax errors; report and move on
+            mods.append(Module(path, os.path.relpath(path, root),
+                               "", ast.Module(body=[], type_ignores=[])))
+            mods[-1].lines = [f"<unreadable: {ex}>"]
+            continue
+        m = Module(path, os.path.relpath(path, root), src, tree)
+        m.lines = src.splitlines()
+        mods.append(m)
+    return mods
+
+
+def analyze_modules(mods: list, rules=None) -> list:
+    """Run every rule over the parsed modules; returns findings with
+    inline suppressions already applied (but baseline NOT applied)."""
+    from h2o3_tpu.analysis import rules_jax, rules_locks, rules_metrics, \
+        rules_routes
+    findings: list = []
+    per_file = [rules_jax.check, rules_locks.check]
+    project = [rules_metrics.check, rules_routes.check]
+    if rules:
+        wanted = set(rules)
+        per_file = [f for f in per_file if f.RULES & wanted]
+        project = [f for f in project if f.RULES & wanted]
+    for m in mods:
+        for rule_fn in per_file:
+            findings.extend(rule_fn(m))
+    for rule_fn in project:
+        findings.extend(rule_fn(mods))
+    if rules:
+        findings = [f for f in findings if f.rule in set(rules)]
+    # attach snippets + inline suppressions
+    by_path = {m.rel: m for m in mods}
+    sup_cache: dict = {}
+    for f in findings:
+        m = by_path.get(f.file)
+        if m is None:
+            continue
+        f.snippet = f.snippet or m.snippet(f.line)
+        if f.file not in sup_cache:
+            sup_cache[f.file] = _suppressions(m.lines)
+        if f.rule in sup_cache[f.file].get(f.line, ()):
+            f.suppressed = True
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def analyze_paths(paths, rules=None) -> list:
+    return analyze_modules(load_modules(paths), rules=rules)
+
+
+def analyze_source(src: str, filename: str = "<fixture>",
+                   rules=None) -> list:
+    """Analyze a source string — the seeded-defect test entry point."""
+    tree = ast.parse(src, filename=filename)
+    m = Module(filename, filename, src, tree)
+    m.lines = src.splitlines()
+    return analyze_modules([m], rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+def load_baseline(path: str) -> dict:
+    """{fingerprint: note} from an analysis_baseline.json file."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e.get("note", "")
+            for e in data.get("findings", [])}
+
+
+def apply_baseline(findings: list, baseline: dict) -> list:
+    for f in findings:
+        if not f.suppressed and f.fingerprint in baseline:
+            f.baselined = True
+    return findings
+
+
+def write_baseline(findings: list, path: str):
+    """Grandfather every currently-unsuppressed finding (the one-time
+    bootstrap; new findings after this still fail the gate)."""
+    entries = []
+    seen = set()
+    for f in findings:
+        if f.suppressed or f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        entries.append({"rule": f.rule, "file": f.file,
+                        "fingerprint": f.fingerprint,
+                        "snippet": f.snippet, "note": ""})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def unsuppressed(findings: list) -> list:
+    return [f for f in findings if not f.suppressed and not f.baselined]
+
+
+def run(paths=None, baseline_path=None, rules=None) -> list:
+    """Full pipeline: parse, analyze, suppress, baseline. The tier-1 gate
+    asserts `not unsuppressed(run(...))`."""
+    if not paths:
+        paths = [package_root()]
+    findings = analyze_paths(paths, rules=rules)
+    if baseline_path:
+        apply_baseline(findings, load_baseline(baseline_path))
+    return findings

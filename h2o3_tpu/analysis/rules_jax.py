@@ -1,0 +1,311 @@
+"""JAX-aware rules: R001 jit-in-hot-path, R002 host-sync, R004 impure-jit.
+
+All three rules share one observation about jax.jit's caching contract:
+the trace/compile cache is keyed on the *function object*, so
+
+  * a `jax.jit(lambda ...)` or `jax.jit(<nested def>)(...)` inside a
+    function body mints a fresh function identity per call and recompiles
+    every time (R001 — the exact bug class killed one-by-one in
+    engine.predict_ensemble, GLM/DL _score_matrix and DataInfo.weights);
+  * code lexically inside a traced function runs at TRACE time, so host
+    syncs (np.asarray/.item()/.tolist()/device_get — R002) and impure
+    calls (time.*/random.*/global mutation — R004) either crash on
+    tracers or silently bake a trace-time value into the compiled
+    program.
+
+R002 additionally covers `timeline.span`-instrumented hot paths: a
+`block_until_ready` (or float() of a jnp expression) inside a span block
+is a device sync on a path we explicitly measure — it must be intentional
+(suppressed with a reason) or gone.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.analysis.engine import Finding, Module
+
+RULES = {"R001", "R002", "R004"}
+
+# names that wrap jax.jit (call makes a fresh jit wrapper per evaluation)
+_JIT_MAKERS = {"jit", "pjit", "jit_rows", "mr_define"}
+# transform entry points whose function args run under trace
+_TRACED_ARG_FNS = _JIT_MAKERS | {
+    "shard_map", "vmap", "pmap", "grad", "value_and_grad", "hessian",
+    "jacfwd", "jacrev", "checkpoint", "remat", "custom_jvp", "custom_vjp",
+    "scan", "while_loop", "fori_loop", "cond", "switch",
+}
+# the sanctioned fix: a code-object-keyed wrapper cache (parallel/mrtask)
+_CACHED_JIT = {"cached_jit"}
+
+_MUT_NP = {"asarray", "array"}
+_NP_NAMES = {"np", "numpy", "_np", "onp"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_HOST_SYNC_FNS = {"device_get", "host_fetch", "block_until_ready"}
+_TIME_NAMES = {"time", "_time", "_time_mod"}
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time", "sleep"}
+
+
+def _terminal_name(fn: ast.AST):
+    """'jax.jit' -> 'jit'; 'jit' -> 'jit'; anything else -> None."""
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an Attribute/Name chain ('' when not a plain chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = _terminal_name(node.func)
+    return name in _JIT_MAKERS and name not in _CACHED_JIT
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing_function(node: ast.AST, parents: dict):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _decorator_is_traced(dec: ast.AST) -> bool:
+    """@jax.jit, @jit, @functools.partial(jax.jit, ...), @jit_rows(...)"""
+    if _terminal_name(dec) in _JIT_MAKERS:
+        return True
+    if isinstance(dec, ast.Call):
+        if _terminal_name(dec.func) in _JIT_MAKERS:
+            return True
+        if _terminal_name(dec.func) == "partial" and dec.args \
+                and _terminal_name(dec.args[0]) in _JIT_MAKERS:
+            return True
+    return False
+
+
+def _traced_functions(tree: ast.AST, parents: dict) -> set:
+    """Every FunctionDef/Lambda whose body runs under jax tracing:
+    jit-decorated defs, and function-valued args to jit/shard_map/vmap/
+    grad/lax-control-flow calls (resolved to same-scope nested defs)."""
+    traced: set = set()
+    # name -> def node, per enclosing scope, for resolving jit(fn_name)
+    defs_by_scope: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = _enclosing_function(node, parents)
+            defs_by_scope.setdefault(scope, {})[node.name] = node
+            if any(_decorator_is_traced(d) for d in node.decorator_list):
+                traced.add(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _terminal_name(node.func)
+        if callee not in _TRACED_ARG_FNS and callee not in _CACHED_JIT:
+            continue
+        scope = _enclosing_function(node, parents)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                traced.add(arg)
+            elif isinstance(arg, ast.Name):
+                # walk outward through enclosing scopes for the def
+                s = scope
+                while True:
+                    d = defs_by_scope.get(s, {}).get(arg.id)
+                    if d is not None:
+                        traced.add(d)
+                        break
+                    if s is None:
+                        break
+                    s = _enclosing_function(s, parents)
+    # close over nesting: a def/lambda inside a traced function traces too
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node not in traced:
+                enc = _enclosing_function(node, parents)
+                if enc in traced:
+                    traced.add(node)
+                    changed = True
+    return traced
+
+
+def _in_traced(node: ast.AST, parents: dict, traced: set) -> bool:
+    enc = _enclosing_function(node, parents)
+    return enc in traced
+
+
+def _span_blocks(tree: ast.AST) -> list:
+    """With-statements whose context manager is a timeline span() call."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Call) \
+                        and _terminal_name(ctx.func) in ("span", "_span"):
+                    out.append(node)
+                    break
+    return out
+
+
+def _contains_jnp_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            chain = _attr_chain(sub.func)
+            if chain.startswith(("jnp.", "jax.numpy.")):
+                return True
+        elif isinstance(sub, ast.Attribute) and \
+                _attr_chain(sub).startswith(("jnp.", "jax.numpy.")):
+            return True
+    return False
+
+
+def check(mod: Module) -> list:
+    findings: list = []
+    tree = mod.tree
+    parents = _parent_map(tree)
+    traced = _traced_functions(tree, parents)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+
+        # ---- R001: fresh jit identity per call ------------------------
+        if _is_jit_call(node) and \
+                _enclosing_function(node, parents) is not None:
+            callee = _terminal_name(node.func)
+            parent = parents.get(node)
+            immediate = isinstance(parent, ast.Call) and parent.func is node
+            first_lambda = bool(node.args) \
+                and isinstance(node.args[0], ast.Lambda)
+            if first_lambda:
+                findings.append(Finding(
+                    "R001", mod.rel, node.lineno,
+                    f"{callee}(<lambda>) inside a function body: the "
+                    "lambda is a fresh function identity per call, so "
+                    "this re-traces and recompiles every invocation — "
+                    "hoist to module level or use cached_jit"))
+            elif immediate:
+                findings.append(Finding(
+                    "R001", mod.rel, node.lineno,
+                    f"{callee}(...)(...) built and invoked per call: the "
+                    "wrapper (and for closures the compiled program) is "
+                    "rebuilt on every invocation — bind the jitted "
+                    "function once at module/instance level or use "
+                    "cached_jit"))
+
+        # ---- R002: host sync under trace ------------------------------
+        if _in_traced(node, parents, traced):
+            fn = node.func
+            term = _terminal_name(fn)
+            if isinstance(fn, ast.Attribute):
+                base = _attr_chain(fn.value)
+                if fn.attr in _MUT_NP and base in _NP_NAMES:
+                    findings.append(Finding(
+                        "R002", mod.rel, node.lineno,
+                        f"{base}.{fn.attr}() inside a traced function: "
+                        "forces a device→host sync at trace time (or a "
+                        "TracerArrayConversionError) — keep the value on "
+                        "device (jnp) or move the readback outside jit"))
+                elif fn.attr in _HOST_SYNC_METHODS and not node.args \
+                        and not node.keywords:
+                    findings.append(Finding(
+                        "R002", mod.rel, node.lineno,
+                        f".{fn.attr}() inside a traced function: "
+                        "device→host sync at trace time — hoist out of "
+                        "the jitted body"))
+                elif fn.attr in _HOST_SYNC_FNS:
+                    findings.append(Finding(
+                        "R002", mod.rel, node.lineno,
+                        f"{_attr_chain(fn) or fn.attr}() inside a traced "
+                        "function: explicit host sync has no meaning "
+                        "under trace — move it to the caller"))
+            elif isinstance(fn, ast.Name) and term in _HOST_SYNC_FNS:
+                findings.append(Finding(
+                    "R002", mod.rel, node.lineno,
+                    f"{term}() inside a traced function: host sync "
+                    "under trace — move it to the caller"))
+            elif isinstance(fn, ast.Name) and term in ("float", "int") \
+                    and node.args and _contains_jnp_call(node.args[0]):
+                findings.append(Finding(
+                    "R002", mod.rel, node.lineno,
+                    f"{term}(<jnp expression>) inside a traced function: "
+                    "concretizes a tracer (device sync / TracerError) — "
+                    "keep the math in jnp"))
+
+            # ---- R004: impurity under trace ---------------------------
+            chain = _attr_chain(node.func)
+            root = chain.split(".", 1)[0] if chain else ""
+            if root in _TIME_NAMES and term in _TIME_FNS:
+                findings.append(Finding(
+                    "R004", mod.rel, node.lineno,
+                    f"{chain}() inside a traced function: evaluated once "
+                    "at trace time and baked into the compiled program — "
+                    "pass timestamps in as arguments"))
+            elif chain.startswith(("random.", "np.random.",
+                                   "numpy.random.")):
+                findings.append(Finding(
+                    "R004", mod.rel, node.lineno,
+                    f"{chain}() inside a traced function: host RNG runs "
+                    "at trace time (same 'random' draw replayed every "
+                    "call) — use jax.random with an explicit key"))
+
+    # R004: global-mutation capture
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global) and _in_traced(node, parents,
+                                                       traced):
+            findings.append(Finding(
+                "R004", mod.rel, node.lineno,
+                f"global {', '.join(node.names)} inside a traced "
+                "function: the mutation runs at trace time only — "
+                "thread state through function arguments/outputs"))
+
+    # R002: device syncs inside span-instrumented hot paths
+    traced_lines = {f.line for f in findings}
+    for block in _span_blocks(tree):
+        for node in ast.walk(block):
+            if not isinstance(node, ast.Call) \
+                    or node.lineno in traced_lines:
+                continue
+            term = _terminal_name(node.func)
+            if term == "block_until_ready":
+                findings.append(Finding(
+                    "R002", mod.rel, node.lineno,
+                    "block_until_ready inside a timeline.span block: a "
+                    "device barrier on an instrumented hot path — make "
+                    "it intentional (suppress with a reason) or remove"))
+            elif isinstance(node.func, ast.Name) \
+                    and term in ("float", "int") and node.args \
+                    and _contains_jnp_call(node.args[0]):
+                findings.append(Finding(
+                    "R002", mod.rel, node.lineno,
+                    f"{term}(<jnp expression>) inside a timeline.span "
+                    "block: hidden device→host sync on an instrumented "
+                    "hot path — fetch once outside the span or batch "
+                    "the readback"))
+    return findings
+
+
+check.RULES = RULES

@@ -1,0 +1,68 @@
+"""Runtime sanitizers — dynamic counterparts of the static rules.
+
+Static analysis proves the code doesn't *write* a stray host↔device
+transfer; the transfer guard proves the runtime doesn't *do* one. The two
+compose: R002 keeps `np.asarray`-style implicit syncs out of hot paths,
+and `transfer_guard("disallow")` makes any survivor raise instead of
+silently eating PCIe/ICI bandwidth. The warm-cache scoring path is held
+to exactly this standard in tier-1 (tests/test_static_analysis.py): every
+transfer it performs is explicit (`device_put` staging in,
+`jax.device_get` results out), so the whole warm request runs under
+`disallow`.
+
+Env gates (read by install_from_env, called at server start):
+  H2O3_DEBUG_NANS=1          jax_debug_nans — every jitted function
+                             re-runs un-jitted on NaN output and pinpoints
+                             the producing primitive
+  H2O3_TRANSFER_GUARD=LEVEL  jax_transfer_guard for the whole process
+                             (log | disallow | log_explicit |
+                             disallow_explicit)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def transfer_guard(level: str = "disallow"):
+    """Scoped jax.transfer_guard: implicit transfers inside the block
+    raise (or log). Explicit device_put/device_get stay allowed under
+    "disallow" — which is the point: intended transfers are spelled out,
+    stray ones crash."""
+    import jax
+    with jax.transfer_guard(level):
+        yield
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Scoped jax_debug_nans — expensive (re-runs producers un-jitted on
+    NaN), so scoped rather than global by default."""
+    import jax
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def install_from_env() -> dict:
+    """Apply env-gated sanitizers process-wide; returns what was enabled.
+    Called by H2OServer.start() so a deployment can flip them without a
+    code change; a no-op when the env vars are unset."""
+    enabled = {}
+    try:
+        import jax
+    except Exception:   # noqa: BLE001 — no jax, nothing to sanitize
+        return enabled
+    if os.environ.get("H2O3_DEBUG_NANS", "") in ("1", "true", "yes"):
+        jax.config.update("jax_debug_nans", True)
+        enabled["debug_nans"] = True
+    guard = os.environ.get("H2O3_TRANSFER_GUARD", "").strip()
+    if guard:
+        jax.config.update("jax_transfer_guard", guard)
+        enabled["transfer_guard"] = guard
+    return enabled

@@ -63,19 +63,27 @@ def _h_jstack(h):
              "traces": traces})
 
 
+def _nt_sum(a):
+    return a.sum()
+
+
 def _h_network_test(h):
     """NetworkTestHandler (water/init/NetworkBench.java analog): time a
     round of mesh collectives instead of UDP all-to-alls."""
-    import jax
     import jax.numpy as jnp
     from h2o3_tpu.parallel import mesh as MESH
+    from h2o3_tpu.parallel import mrtask as _mrt
     cl = MESH.cloud()
     sizes = [1 << 10, 1 << 16, 1 << 20]
     results = []
     for sz in sizes:
         x = jnp.ones(sz // 4, jnp.float32)
+        # cached_jit: the old per-call jit(lambda) timed a fresh XLA
+        # compile on every scrape instead of the collective (R001)
+        red = _mrt.cached_jit(_nt_sum)
+        float(red(x))                        # warm: compile outside timer
         t0 = time.time()
-        y = jax.jit(lambda a: a.sum())(x)
+        y = red(x)
         float(y)
         results.append({"bytes": sz, "collective": "reduce",
                         "micros": (time.time() - t0) * 1e6})

@@ -96,10 +96,12 @@ class _Handler(BaseHTTPRequestHandler):
         return False
 
     # ---- plumbing -------------------------------------------------------
-    def _send(self, obj, code=200):
+    def _send(self, obj, code=200, extra_headers=None):
         body = json.dumps(obj, default=_json_default).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if getattr(self, "command", "") != "HEAD":   # RFC 9110: no body
@@ -108,6 +110,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, msg, code=400):
         self._send({"__meta": {"schema_type": "H2OError"},
                     "msg": str(msg), "http_status": code}, code)
+
+    def _unavailable(self, qf):
+        """503 + Retry-After for micro-batch queue-depth backpressure:
+        well-behaved clients (and load balancers) back off instead of
+        re-queueing onto a stalled accelerator."""
+        self._send({"__meta": {"schema_type": "H2OError"},
+                    "msg": str(qf), "http_status": 503}, 503,
+                   extra_headers={"Retry-After":
+                                  str(getattr(qf, "retry_after_s", 1))})
 
     def _params(self) -> dict:
         cached = getattr(self, "_cached_params", None)
@@ -422,7 +433,10 @@ def _h_predict(h: _Handler, mid, fid):
     # micro-batched serving fast path: concurrent predictions against the
     # same model coalesce into one padded device dispatch per bucket
     from h2o3_tpu import serving
-    pred = serving.predict_via_rest(m, f)
+    try:
+        pred = serving.predict_via_rest(m, f)
+    except serving.QueueFull as qf:
+        return h._unavailable(qf)
     if dest:
         DKV.remove(pred.key)
         pred.key = dest
@@ -465,7 +479,10 @@ def _h_predict_rows(h: _Handler, mid):
     if isinstance(cols, str) and cols:
         cols = json.loads(cols)
     from h2o3_tpu import serving
-    preds = serving.score_payload(m, rows, cols)
+    try:
+        preds = serving.score_payload(m, rows, cols)
+    except serving.QueueFull as qf:
+        return h._unavailable(qf)
     h._send({"__meta": {"schema_type": "PredictionsRowsV3"},
              "model": {"name": mid}, "predictions": preds,
              "row_count": len(preds)})
@@ -833,6 +850,10 @@ class H2OServer:
         h2o3_tpu.cloud()  # form the device mesh before serving
         from h2o3_tpu.obs import metrics as _obs_m
         _obs_m.install_runtime_gauges()
+        # env-gated runtime sanitizers (H2O3_DEBUG_NANS,
+        # H2O3_TRANSFER_GUARD) — no-op unless a deployment flips them
+        from h2o3_tpu.analysis import sanitizers as _san
+        _san.install_from_env()
         if background:
             self.thread = threading.Thread(target=self.httpd.serve_forever,
                                            daemon=True, name="h2o3-rest")

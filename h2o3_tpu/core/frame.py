@@ -107,6 +107,11 @@ def _decode_f32(data: jax.Array, codec: Codec, mask: Optional[jax.Array]):
     return x
 
 
+# one resident wrapper: a per-call jax.jit(_decode_f32) in as_f32 rebuilt
+# the wrapper on every decoded read (R001)
+_DECODE_F32_JIT = jax.jit(_decode_f32, static_argnums=1)
+
+
 # ---------------------------------------------------------------------------
 @dataclasses.dataclass
 class Rollups:
@@ -180,7 +185,6 @@ class Vec:
         n = int(col_j.shape[0])
         pad = c.padded_rows(n)
 
-        @jax.jit
         def pack(col_j):
             full = jnp.full(pad, jnp.nan, jnp.float32) \
                 .at[:n].set(col_j.astype(jnp.float32))
@@ -188,7 +192,9 @@ class Vec:
             return jnp.where(mask, 0.0, full), mask.astype(jnp.uint8)
 
         sh = c.rows_sharding(1)
-        packed, dmask = jax.jit(pack, out_shardings=(sh, sh))(col_j)
+        # cached_jit: pack's closure is (pad, n) ints, so repeated
+        # device-munger hand-offs at one size reuse one program
+        packed, dmask = _mr.cached_jit(pack, out_shardings=(sh, sh))(col_j)
         dom = np.asarray(domain, dtype=object) if domain is not None else None
         return Vec(packed, Codec("f32"), dmask, n, vtype, dom)
 
@@ -223,7 +229,7 @@ class Vec:
         Frame.matrix() for multi-column consumers."""
         if self.type == T_STR:
             raise TypeError("string Vec has no numeric view")
-        return jax.jit(_decode_f32, static_argnums=1)(self.data, self.codec, self.mask)
+        return _DECODE_F32_JIT(self.data, self.codec, self.mask)
 
     def to_numpy(self) -> np.ndarray:
         if self.type == T_STR:
@@ -294,12 +300,14 @@ def _rollup_kernel_impl(x):
 
 
 def _rollup_kernel(data, codec, mask):
+    # cached_jit: the closures capture only the (frozen, hashable) codec,
+    # so every vec sharing a codec replays one resident program per shape
     def f(d, m):
         return _rollup_kernel_impl(_decode_f32(d, codec, m))
-    m = mask if mask is not None else jnp.zeros((), jnp.uint8)
     if mask is None:
-        return jax.jit(lambda d: _rollup_kernel_impl(_decode_f32(d, codec, None)))(data)
-    return jax.jit(f)(data, mask)
+        return _mr.cached_jit(
+            lambda d: _rollup_kernel_impl(_decode_f32(d, codec, None)))(data)
+    return _mr.cached_jit(f)(data, mask)
 
 
 @functools.partial(jax.jit, static_argnames=("pad", "n"))
@@ -729,7 +737,9 @@ class Frame:
             return jnp.stack(cols_f32, axis=1).astype(dtype)
 
         out_sh = _mesh.cloud().rows_sharding(2)
-        m = jax.jit(build, out_shardings=out_sh)(datas, masks)
+        # cached_jit: build captures (codecs, dtype) — both hashable — so
+        # re-materializing a same-schema matrix reuses one program
+        m = _mr.cached_jit(build, out_shardings=out_sh)(datas, masks)
         self._matrix_cache[ck] = m
         return m
 

@@ -195,11 +195,14 @@ class _ReplayHandler:
     def _params(self):
         return dict(self._p)
 
-    def _send(self, obj, code=200):
+    def _send(self, obj, code=200, extra_headers=None):
         self.out = obj
 
     def _error(self, msg, code=400):
         self.out = {"error": str(msg), "code": code}
+
+    def _unavailable(self, qf):
+        self.out = {"error": str(qf), "code": 503}
 
     def send_response(self, code):
         pass
@@ -309,6 +312,7 @@ class Broadcaster:
         while self._owed[i] > 0:
             if self._recv_frame_at(i) is None:   # peer gone: stop spinning
                 break
+            # h2o3-ok: R003 only reachable from broadcast(), which holds self._lock for the whole send+drain sequence
             self._owed[i] -= 1
 
     def broadcast(self, method: str, path: str, params: dict):

@@ -30,6 +30,13 @@ def _gram(Xz, w):
     return Xz.T @ Xw, w.sum()
 
 
+@jax.jit
+def _project(Xz, R):
+    """Score projection — module-level so repeated predicts replay one
+    program (a per-call jit(lambda) here recompiled every request: R001)."""
+    return Xz @ R
+
+
 class H2OPrincipalComponentAnalysisEstimator(ModelBase):
     algo = "pca"
     supervised = False
@@ -99,7 +106,7 @@ class H2OPrincipalComponentAnalysisEstimator(ModelBase):
 
     def _score_matrix(self, X):
         R = jnp.asarray(self._rotation, jnp.float32)
-        return jax.jit(lambda x: x @ R)(self._apply_transform(X))
+        return _project(self._apply_transform(X), R)
 
     def predict(self, test_data: Frame) -> Frame:
         X = self._dinfo.matrix(test_data)

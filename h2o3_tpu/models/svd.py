@@ -18,6 +18,18 @@ from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.model import ModelBase
 
 
+@jax.jit
+def _gram_xtx(X):
+    return X.T @ X
+
+
+@jax.jit
+def _right_multiply(X, M):
+    """U = X·(V·σ⁻¹) as one resident program — the per-call jit(lambda)
+    it replaces recompiled on every fit (R001)."""
+    return X @ M
+
+
 class H2OSingularValueDecompositionEstimator(ModelBase):
     algo = "svd"
     supervised = False
@@ -47,7 +59,7 @@ class H2OSingularValueDecompositionEstimator(ModelBase):
             Xz = Xz - jnp.asarray(mean, jnp.float32) * (w[:, None] > 0)
         if transform in ("DESCALE", "STANDARDIZE", "NORMALIZE"):
             Xz = Xz / jnp.asarray(sd, jnp.float32)
-        G = jax.jit(lambda X: X.T @ X)(Xz)
+        G = _gram_xtx(Xz)
         Gn = np.asarray(G, np.float64)
         evals, evecs = np.linalg.eigh(Gn)
         order = np.argsort(-evals)
@@ -60,8 +72,9 @@ class H2OSingularValueDecompositionEstimator(ModelBase):
         self._mean, self._sd = mean, sd
         if self.params.get("keep_u"):
             dinv = np.where(d > 1e-12, 1.0 / np.maximum(d, 1e-12), 0.0)
-            U = np.asarray(jax.jit(lambda X: X @ jnp.asarray(
-                V * dinv[None, :], jnp.float32))(Xz))[: frame.nrows]
+            U = np.asarray(_right_multiply(
+                Xz, jnp.asarray(V * dinv[None, :],
+                                jnp.float32)))[: frame.nrows]
             uf = Frame([f"u{j+1}" for j in range(k)],
                        [Vec.from_numpy(U[:, j].astype(np.float64))
                         for j in range(k)])

@@ -495,11 +495,13 @@ class TreeGrower:
                     # unbalanced limits (max_depth 15+ on small data) would
                     # otherwise compile and run for nothing. TPU stays
                     # fully async at fixed depth.
+                    # h2o3-ok: R002 intentional per-level drain barrier (CPU collective flakiness), gated to the CPU backend
                     jax.block_until_ready(valA)
                     if not bool(jnp.any(active)):
                         return colA, thrA, nalA, valA, heap, gains
             valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
             if _cpu_backend():
+                # h2o3-ok: R002 same intentional CPU-only drain barrier as above
                 jax.block_until_ready(valA)
         return colA, thrA, nalA, valA, heap, gains
 

@@ -362,8 +362,11 @@ def _install_jax_listeners():
 
 def xla_compile_count() -> float:
     """Current process-wide XLA backend-compile count — the serving fast
-    path's regression metric (tests assert a warm bucket adds zero)."""
-    return REGISTRY.counter("h2o3_xla_compiles_total").value()
+    path's regression metric (tests assert a warm bucket adds zero).
+    Reads via get(): counter() here would be a second declaration site
+    for the name (R005), racing the listener's help text."""
+    m = REGISTRY.get("h2o3_xla_compiles_total")
+    return m.value() if m is not None else 0.0
 
 
 def install_runtime_gauges():

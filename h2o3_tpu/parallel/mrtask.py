@@ -22,12 +22,100 @@ and any cross-shard reduction (sum/min/max/…) lowers to an ICI collective
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.parallel import mesh as _mesh
+
+# ---------------------------------------------------------------------------
+# cached_jit: jax.jit keyed by CODE + closure VALUES, not function identity.
+#
+# jax's trace/compile cache is keyed on the function object, so
+# `jax.jit(lambda x: ...)` (or a nested def) inside a function body mints a
+# fresh identity per call and recompiles every invocation — the R001 bug
+# class the static analyzer (h2o3_tpu/analysis) now rejects. A lambda
+# EXPRESSION, however, compiles to one code object shared by every
+# evaluation; keying the wrapper on (code, defaults, closure values) makes
+# call-site closures hit one resident wrapper as long as their captured
+# values are equal. Unhashable captures (arrays, models) fall back to a
+# plain uncached jit — exactly today's behavior, never worse.
+_JIT_CACHE: OrderedDict = OrderedDict()
+_JIT_CACHE_MAX = 512
+_JIT_CACHE_LOCK = threading.Lock()
+
+
+class _Uncacheable(Exception):
+    """Function cannot be keyed safely — caller must fall back to a
+    plain (uncached) jax.jit."""
+
+
+def _typed(v):
+    """Cell/default values keyed WITH their type: 1, 1.0 and True hash
+    equal but trace to different programs."""
+    return (type(v), v)
+
+
+def _fn_key(fn, _seen=None):
+    """Identity-free cache key for a function: code + defaults + closure
+    cell values, resolving function-valued cells recursively (a per-call
+    lambda captured by another per-call closure must not leak identity
+    back into the key). Raises _Uncacheable for bound methods (two
+    instances share code + cells, but trace different state) and for
+    cyclic closures (recursive nested defs)."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn                      # builtin / C function: stable object
+    if getattr(fn, "__self__", None) is not None:
+        # bound method: two instances share code + cells but trace
+        # different state — cannot be keyed identity-free
+        raise _Uncacheable("bound method: state lives on __self__")
+    if _seen is None:
+        _seen = set()
+    if id(fn) in _seen:
+        raise _Uncacheable("cyclic closure")
+    _seen.add(id(fn))
+    cells = tuple(
+        _fn_key(c.cell_contents, _seen) if callable(c.cell_contents)
+        else _typed(c.cell_contents)
+        for c in (fn.__closure__ or ()))
+    defaults = tuple(_typed(v) for v in (fn.__defaults__ or ()))
+    kwdefaults = tuple(sorted((k, _typed(v)) for k, v in
+                              (fn.__kwdefaults__ or {}).items()))
+    return (code, defaults, kwdefaults, cells)
+
+
+def cached_jit(fn, **jit_kwargs):
+    """jax.jit with a wrapper cache keyed by _fn_key + jit kwargs.
+
+    The per-call-closure fix: `cached_jit(lambda x: x @ R)` at a call site
+    re-evaluated per request resolves to ONE wrapper (and one compiled
+    program per shape) as long as the captured values hash equal.
+    """
+    try:
+        key = (_fn_key(fn),
+               tuple(sorted(jit_kwargs.items())))
+        hash(key)
+    except (TypeError, ValueError, _Uncacheable):
+        # unhashable captures, bound methods, cyclic closures, or an
+        # uninitialized cell (ValueError): uncached fallback — exactly
+        # the pre-cached_jit behavior, never wrong results
+        return jax.jit(fn, **jit_kwargs)
+    with _JIT_CACHE_LOCK:
+        jfn = _JIT_CACHE.get(key)
+        if jfn is not None:
+            _JIT_CACHE.move_to_end(key)
+            return jfn
+    jfn = jax.jit(fn, **jit_kwargs)
+    with _JIT_CACHE_LOCK:
+        cur = _JIT_CACHE.setdefault(key, jfn)
+        _JIT_CACHE.move_to_end(key)
+        while len(_JIT_CACHE) > _JIT_CACHE_MAX:
+            _JIT_CACHE.popitem(last=False)
+    return cur
 
 
 def map_reduce(fn, *arrays, donate=()):
@@ -36,7 +124,7 @@ def map_reduce(fn, *arrays, donate=()):
 
     `fn` is traced once and cached per shape/dtype signature by jax.jit.
     """
-    jfn = jax.jit(fn, donate_argnums=donate)
+    jfn = cached_jit(fn, donate_argnums=donate)
     return jfn(*arrays)
 
 
@@ -44,17 +132,35 @@ def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False):
     """shard_map `fn` over the rows axis: fn runs once per shard ("node"),
     seeing only its local rows, and may use lax.psum/ppermute over "rows".
 
-    in_specs/out_specs default to row-sharded in, replicated out.
+    in_specs/out_specs default to row-sharded in, replicated out. The
+    jitted shard_map wrapper is cached by (fn code+closure, mesh, specs):
+    shard_map returns a fresh object per call, so an uncached jit here
+    re-traced on every invocation (R001).
     """
     c = _mesh.cloud()
     if in_specs is None:
         in_specs = tuple(P(_mesh.ROWS, *([None] * (a.ndim - 1))) for a in arrays)
-    if out_specs is None:
-        out_specs = P()
-    smapped = jax.shard_map(
-        fn, mesh=c.mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=check_vma)
-    return jax.jit(smapped)(*arrays)
+    in_specs = tuple(in_specs)
+
+    def smapped(*arrs):
+        return jax.shard_map(fn, mesh=c.mesh, in_specs=in_specs,
+                             out_specs=out_specs if out_specs is not None
+                             else P(), check_vma=check_vma)(*arrs)
+
+    try:
+        key = ("map_chunks", _fn_key(fn), c.mesh, in_specs,
+               out_specs, check_vma)
+        hash(key)
+    except (TypeError, ValueError, _Uncacheable):
+        return jax.jit(smapped)(*arrays)   # h2o3-ok: R001 unhashable specs fall back to the uncached legacy path
+    with _JIT_CACHE_LOCK:
+        jfn = _JIT_CACHE.get(key)
+        if jfn is None:
+            jfn = _JIT_CACHE[key] = jax.jit(smapped)
+        _JIT_CACHE.move_to_end(key)
+        while len(_JIT_CACHE) > _JIT_CACHE_MAX:
+            _JIT_CACHE.popitem(last=False)
+    return jfn(*arrays)
 
 
 def shard_sum(x, axis_name=_mesh.ROWS):

@@ -31,6 +31,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec, T_CAT, T_NUM, T_STR, T_TIME
 from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.parallel import mrtask as _mrt
 from h2o3_tpu.rapids.rapids import (PRIMS, prim, _eval, _new_frame,
                                     _numeric_cols, _col_np, _unary_op,
                                     _reduce_op)
@@ -1129,7 +1130,7 @@ def _mmult(a, e):
     f2 = _eval(a[1], e)
     A = f1.matrix(_numeric_cols(f1))[: f1.nrows]
     B = f2.matrix(_numeric_cols(f2))[: f2.nrows]
-    out = np.asarray(jax.jit(jnp.matmul)(A, B), np.float64)
+    out = np.asarray(_mrt.cached_jit(jnp.matmul)(A, B), np.float64)
     return _new_frame([f"C{j+1}" for j in range(out.shape[1])],
                       [out[:, j] for j in range(out.shape[1])])
 

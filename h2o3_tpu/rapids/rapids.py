@@ -29,6 +29,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec, T_CAT, T_NUM, T_STR, T_TIME
 from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.parallel import mrtask as _mrt
 
 
 # ===========================================================================
@@ -282,7 +283,7 @@ def _broadcast_op(args, env, fn, str_ok=False):
         return jnp.float32(x)
 
     A, B = get(a), get(b)
-    out = jax.jit(fn)(A, B)
+    out = _mrt.cached_jit(fn)(A, B)
     out_np = np.asarray(out, np.float64)[: base.nrows]
     return _new_frame(names, [out_np[:, j] for j in range(out_np.shape[1])])
 
@@ -292,7 +293,7 @@ def _unary_op(args, env, fn):
     if not isinstance(a, Frame):
         return float(fn(jnp.float32(a)))
     A = a.matrix(_numeric_cols(a))
-    out = np.asarray(jax.jit(fn)(A), np.float64)[: a.nrows]
+    out = np.asarray(_mrt.cached_jit(fn)(A), np.float64)[: a.nrows]
     return _new_frame(a.names, [out[:, j] for j in range(out.shape[1])])
 
 
@@ -304,13 +305,14 @@ def _reduce_op(args, env, fn, na_rm_idx=None):
     A = a.matrix(_numeric_cols(a))
     n = a.nrows
 
-    @jax.jit
     def red(A):
         idx = jnp.arange(A.shape[0])[:, None]
         live = idx < n
         return fn(A, live)
 
-    return float(red(A))
+    # cached_jit resolves fn's identity down to its code object, so the
+    # per-call reducer lambdas each keep one resident program per shape
+    return float(_mrt.cached_jit(red)(A))
 
 
 # ===========================================================================
@@ -682,7 +684,7 @@ def _ifelse(a, e):
     C = c.matrix(_numeric_cols(c))
     X = x.matrix(_numeric_cols(x)) if isinstance(x, Frame) else jnp.float32(x)
     Y = y.matrix(_numeric_cols(y)) if isinstance(y, Frame) else jnp.float32(y)
-    out = np.asarray(jax.jit(f)(C, X, Y), np.float64)[: c.nrows]
+    out = np.asarray(_mrt.cached_jit(f)(C, X, Y), np.float64)[: c.nrows]
     return _new_frame(c.names, [out[:, j] for j in range(out.shape[1])])
 
 
@@ -1129,7 +1131,6 @@ def _scale(a, e):
     A = f.matrix(_numeric_cols(f))
     n = f.nrows
 
-    @jax.jit
     def sc(A):
         live = jnp.arange(A.shape[0])[:, None] < n
         ok = ~jnp.isnan(A) & live
@@ -1139,7 +1140,7 @@ def _scale(a, e):
         sd = jnp.sqrt(jnp.where(ok, x * x, 0).sum(0) / jnp.maximum(cnt - 1, 1))
         return x / jnp.where(sd > 0, sd, 1.0) if scale_ else x
 
-    out = np.asarray(sc(A), np.float64)[:n]
+    out = np.asarray(_mrt.cached_jit(sc)(A), np.float64)[:n]
     return _new_frame(f.names, [out[:, j] for j in range(out.shape[1])])
 
 

@@ -18,7 +18,8 @@ from h2o3_tpu.serving.scorer_cache import (     # noqa: F401
     CACHE, FALLBACKS, Ineligible, model_token, row_bucket, score_frame,
     score_frame_with_response, score_rows, stage_frame, stage_response,
     _fastpath_reason)
-from h2o3_tpu.serving.microbatch import BATCHER, MicroBatcher  # noqa: F401
+from h2o3_tpu.serving.microbatch import (   # noqa: F401
+    BATCHER, MicroBatcher, QueueFull)
 
 
 def _microbatch_eligible(model, nrows: int) -> bool:
@@ -41,11 +42,19 @@ def predict_via_rest(model, frame):
     from h2o3_tpu.serving import scorer_cache as _sc
     if not _microbatch_eligible(model, frame.nrows):
         return model.predict(frame)
+    # shed BEFORE staging: a 503-bound request must not pay the
+    # per-column decode + device_put only to be rejected at enqueue
+    BATCHER.check_capacity()
     try:
         di = model._dinfo
         af = di.adapt(frame)
         raw = stage_frame(di, af, frame.nrows)
         out = BATCHER.score(model, raw, frame.nrows)
+    except QueueFull:
+        # backpressure is NOT degradation: falling back to model.predict
+        # here would put the shed load right back on the stalled device.
+        # Propagate so the REST layer answers 503 + Retry-After.
+        raise
     except Exception:   # noqa: BLE001 — serving must degrade, not 500
         _sc._note_failure((model.key, model_token(model)))
         FALLBACKS.inc(reason="trace-error")
@@ -149,14 +158,19 @@ def score_payload(model, rows, columns=None) -> list:
     the route's answer always matches frame-based scoring."""
     from h2o3_tpu.serving import scorer_cache as _sc
     from h2o3_tpu.core.kvstore import DKV
+    use_fast = _microbatch_eligible(model, len(rows))
+    if use_fast:
+        # shed before decoding the payload into a staging buffer
+        BATCHER.check_capacity()
     raw = payload_to_raw(model, rows, columns)
     n = raw.shape[0]
     if n == 0:
         return []
-    use_fast = _microbatch_eligible(model, n)
     if use_fast:
         try:
             out = BATCHER.score(model, raw, n)
+        except QueueFull:
+            raise       # shed load at the REST edge (503), don't reroute
         except Exception:   # noqa: BLE001 — degrade to the frame path
             _sc._note_failure((model.key, model_token(model)))
             FALLBACKS.inc(reason="trace-error")

@@ -28,6 +28,9 @@ REQUESTS = _om.counter("h2o3_score_microbatch_requests_total",
                        "scoring requests entering the micro-batch queue")
 DISPATCHES = _om.counter("h2o3_score_microbatch_dispatches_total",
                          "coalesced device dispatches leaving the queue")
+REJECTED = _om.counter("h2o3_microbatch_rejected_total",
+                       "scoring requests rejected by queue-depth "
+                       "backpressure (HTTP 503 + Retry-After)")
 BATCH_ROWS = _om.histogram("h2o3_score_microbatch_rows",
                            "real rows per coalesced dispatch",
                            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
@@ -36,8 +39,29 @@ BATCH_ROWS = _om.histogram("h2o3_score_microbatch_rows",
 _WAIT_S = 120.0     # follower safety timeout; dispatch failures set errors
 
 
+class QueueFull(Exception):
+    """Queue-depth backpressure: the caller should answer 503 with
+    Retry-After rather than stacking another blocked thread. Raised
+    instead of queueing so an overloaded accelerator sheds load at the
+    REST edge (bounded memory, bounded thread count) — the ROADMAP's
+    "micro-batch queue depth limit" gap."""
+
+    def __init__(self, depth: int, limit: int, retry_after_s: int = 1):
+        super().__init__(
+            f"micro-batch queue full ({depth} pending >= limit {limit})")
+        self.retry_after_s = retry_after_s
+
+
 def _linger_s() -> float:
     return max(0.0, float(os.environ.get("H2O3_SCORE_LINGER_MS", "2"))) / 1e3
+
+
+def _queue_depth_limit() -> int:
+    """Max in-flight requests across all models (0 disables the bound).
+    Default 512: at the default 2ms linger a healthy queue drains in a
+    couple of dispatches, so hundreds of waiters means the device is
+    stalled — shed rather than queue."""
+    return int(os.environ.get("H2O3_SCORE_QUEUE_DEPTH", "512"))
 
 
 class _Request:
@@ -55,10 +79,22 @@ class MicroBatcher:
     def __init__(self):
         self._lock = threading.Lock()
         self._pending: dict = {}
+        self._depth = 0       # in-flight requests (entered, not yet woken)
+
+    def check_capacity(self):
+        """Raise QueueFull when the in-flight bound is already hit — for
+        callers to shed load BEFORE paying frame adaptation + staging.
+        Advisory (no reservation): score() re-checks authoritatively."""
+        limit = _queue_depth_limit()
+        with self._lock:
+            if limit > 0 and self._depth >= limit:
+                REJECTED.inc()
+                raise QueueFull(self._depth, limit)
 
     def score(self, model, raw: np.ndarray, n: int) -> np.ndarray:
         """Submit (n, C) staged raw rows; returns the (n, ...) host result
         for exactly these rows. Blocks until the coalesced dispatch lands.
+        Raises QueueFull (→ HTTP 503) when the in-flight bound is hit.
         """
         REQUESTS.inc()
         # token (not DKV version): requests only coalesce when they hold
@@ -66,12 +102,24 @@ class MicroBatcher:
         # two generations in one dispatch
         key = (model.key, _sc.model_token(model), raw.shape[1])
         req = _Request(np.asarray(raw[:n], np.float32), n)
+        limit = _queue_depth_limit()
         with self._lock:
+            if limit > 0 and self._depth >= limit:
+                REJECTED.inc()
+                raise QueueFull(self._depth, limit)
+            self._depth += 1
             group = self._pending.get(key)
             leader = group is None
             if leader:
                 group = self._pending[key] = []
             group.append(req)
+        try:
+            return self._await_result(model, key, req, leader)
+        finally:
+            with self._lock:
+                self._depth -= 1
+
+    def _await_result(self, model, key, req, leader) -> np.ndarray:
         if leader:
             batch = None
             try:
@@ -147,3 +195,11 @@ class MicroBatcher:
 
 
 BATCHER = MicroBatcher()
+
+# module-level registration reading the module global: bound to whatever
+# BATCHER currently is, not to the first instance ever constructed (the
+# registry keeps the first fn per name, so an instance-bound closure
+# would pin a replaced batcher and report its dead depth forever)
+_om.gauge("h2o3_microbatch_queue_depth",
+          "scoring requests currently inside the micro-batch queue",
+          fn=lambda: float(BATCHER._depth))

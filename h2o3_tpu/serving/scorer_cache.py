@@ -95,19 +95,25 @@ class Ineligible(Exception):
 def _decode_host(vec) -> np.ndarray:
     """(nrows,) f32 with NaN NAs decoded from a Vec's packed device plane.
     One device→host copy of the PACKED dtype; the codec math runs in numpy.
+
+    Every device read here is jax.device_get — an EXPLICIT transfer — so
+    the whole warm scoring path runs clean under
+    jax.transfer_guard("disallow"), which only admits spelled-out
+    transfers. The tier-1 sanitizer test holds the path to that bar; an
+    np.asarray sneaking back in fails it.
     """
     from h2o3_tpu.core.frame import SparseVec
     n = vec.nrows
     if isinstance(vec, SparseVec):
         out = np.zeros(n, np.float32)
-        rows = np.asarray(vec.nz_rows)
-        vals = np.asarray(vec.nz_vals)
+        rows = np.asarray(jax.device_get(vec.nz_rows))
+        vals = np.asarray(jax.device_get(vec.nz_vals))
         keep = rows < n
         out[rows[keep]] = vals[keep]
         return out
     if vec.data is None:
         raise Ineligible(f"column type {vec.type!r} has no numeric staging")
-    data = np.asarray(vec.data)[:n]
+    data = np.asarray(jax.device_get(vec.data))[:n]
     c = vec.codec
     if c.kind == "const":
         out = np.full(n, np.float32(c.const_val), np.float32)
@@ -116,7 +122,7 @@ def _decode_host(vec) -> np.ndarray:
         if c.bias:
             out = out + np.float32(c.bias)
     if vec.mask is not None:
-        m = np.asarray(vec.mask)[:n]
+        m = np.asarray(jax.device_get(vec.mask))[:n]
         out = np.where(m != 0, np.float32(np.nan), out)
     return out
 
@@ -333,7 +339,10 @@ def score_rows(model, raw: np.ndarray, n: int) -> np.ndarray:
     fn = CACHE.program(model, raw.shape[0])
     out = fn(_mrt.device_put_rows(raw))
     ROWS_SCORED.inc(n)
-    return np.asarray(out)
+    # device_get, not np.asarray: the result fetch is the one intended
+    # device→host transfer on this path — keep it explicit so the
+    # transfer-guard sanitizer admits it
+    return np.asarray(jax.device_get(out))
 
 
 def _fast_scored(model, frame, with_response: bool):

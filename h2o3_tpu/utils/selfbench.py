@@ -102,16 +102,22 @@ def publish(results: dict) -> dict:
     from h2o3_tpu.obs import metrics as om
     g = om.gauge("h2o3_selfbench", "in-product hardware self-benchmarks "
                  "(linpack gflops, HBM triad GB/s, ICI collectives)")
+    # one label schema for every probe (R005): absent dimensions are "",
+    # so the series aggregate instead of splitting per probe family
     lp = results.get("linpack")
     if lp:
-        g.set(lp["gflops"], probe="linpack_gflops", dtype=lp["dtype"])
+        g.set(lp["gflops"], probe="linpack_gflops", dtype=lp["dtype"],
+              payload_bytes="")
     mb = results.get("memory_bandwidth")
     if mb:
-        g.set(mb["gbps"], probe="hbm_triad_gbps")
+        g.set(mb["gbps"], probe="hbm_triad_gbps", dtype="",
+              payload_bytes="")
     for row in results.get("network") or []:
         pb = str(row["payload_bytes_per_device"])
-        g.set(row["latency_us"], probe="ici_latency_us", payload_bytes=pb)
-        g.set(row["algo_bw_gbps"], probe="ici_bw_gbps", payload_bytes=pb)
+        g.set(row["latency_us"], probe="ici_latency_us", dtype="",
+              payload_bytes=pb)
+        g.set(row["algo_bw_gbps"], probe="ici_bw_gbps", dtype="",
+              payload_bytes=pb)
     return results
 
 

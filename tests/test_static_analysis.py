@@ -1,0 +1,415 @@
+"""Static analyzer + runtime sanitizers — seeded defects, the package
+gate, and the transfer-guard proof for the warm scoring path.
+
+Three tiers of assurance, mirroring how the reference gates its Java tree
+with findbugs/error-prone:
+  1. each rule R001-R006 detects a seeded defect (the rule works);
+  2. the whole package reports zero unsuppressed findings against
+     analysis_baseline.json (the codebase is clean, and stays clean:
+     a new finding fails tier-1);
+  3. the warm-cache scoring path runs under
+     jax.transfer_guard("disallow") — every transfer it performs is
+     explicit, so the recompile-free fast path is also stray-sync-free.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.analysis import engine
+from h2o3_tpu.analysis import sanitizers
+
+REPO = engine.repo_root()
+BASELINE = os.path.join(REPO, "analysis_baseline.json")
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded defects — one per rule
+def test_r001_detects_jit_lambda_in_function_body():
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    return jax.jit(lambda a: a + 1)(x)\n")
+    found = engine.analyze_source(src)
+    assert "R001" in _rules_of(found)
+    assert any(f.line == 3 for f in found if f.rule == "R001")
+
+
+def test_r001_detects_per_call_jit_of_nested_def():
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    def body(a):\n"
+        "        return a * 2\n"
+        "    return jax.jit(body)(x)\n")
+    assert "R001" in _rules_of(engine.analyze_source(src))
+
+
+def test_r001_clean_on_module_level_jit_and_cached_jit():
+    src = (
+        "import jax\n"
+        "from h2o3_tpu.parallel.mrtask import cached_jit\n"
+        "@jax.jit\n"
+        "def fine(a):\n"
+        "    return a + 1\n"
+        "def also_fine(x):\n"
+        "    return cached_jit(lambda a: a + 1)(x)\n")
+    assert "R001" not in _rules_of(engine.analyze_source(src))
+
+
+def test_r002_detects_host_sync_inside_traced_fn():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x).sum()\n")
+    assert "R002" in _rules_of(engine.analyze_source(src))
+
+
+def test_r002_detects_barrier_inside_span_block():
+    src = (
+        "import jax\n"
+        "from h2o3_tpu.obs.timeline import span\n"
+        "def hot(x):\n"
+        "    with span('score.dispatch'):\n"
+        "        jax.block_until_ready(x)\n"
+        "    return x\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R002"]
+    assert found and found[0].line == 5
+
+
+def test_r003_detects_bare_mutation_of_locked_attr():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "    def safe(self, v):\n"
+        "        with self._lock:\n"
+        "            self._items.append(v)\n"
+        "    def racy(self, v):\n"
+        "        self._items.append(v)\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R003"]
+    assert len(found) == 1 and found[0].line == 10
+    assert "racy" in found[0].message
+
+
+def test_r004_detects_impurity_under_trace():
+    src = (
+        "import jax\n"
+        "import time\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x * time.time()\n")
+    assert "R004" in _rules_of(engine.analyze_source(src))
+    src2 = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + np.random.normal()\n")
+    assert "R004" in _rules_of(engine.analyze_source(src2))
+
+
+def test_r005_detects_duplicate_and_nonliteral_declarations():
+    src = (
+        "from h2o3_tpu.obs import metrics as _om\n"
+        "A = _om.counter('h2o3_fixture_dup_total', 'first')\n"
+        "B = _om.counter('h2o3_fixture_dup_total', 'second')\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R005"]
+    assert len(found) == 1 and found[0].line == 3
+    src2 = (
+        "from h2o3_tpu.obs import metrics as _om\n"
+        "def make(suffix):\n"
+        "    return _om.counter('h2o3_' + suffix)\n")
+    assert "R005" in _rules_of(engine.analyze_source(src2))
+
+
+def test_r005_detects_inconsistent_label_sets():
+    src = (
+        "from h2o3_tpu.obs import metrics as _om\n"
+        "C = _om.counter('h2o3_fixture_labels_total', 'x')\n"
+        "def a():\n"
+        "    C.inc(reason='x')\n"
+        "def b():\n"
+        "    C.inc(reason='x')\n"
+        "def c():\n"
+        "    C.inc()\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R005"]
+    assert len(found) == 1 and found[0].line == 8
+
+
+def test_r006_detects_group_signature_drift():
+    src = (
+        "import re\n"
+        "def _h_one(h, a):\n"
+        "    pass\n"
+        "ROUTES = [\n"
+        "    (re.compile(r'/3/Thing/([^/]+)/([^/]+)'), 'GET', _h_one),\n"
+        "]\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/api/fixture_routes.py")
+        if f.rule == "R006"]
+    assert len(found) == 1 and "captures 2 group" in found[0].message
+
+
+def test_r006_detects_duplicate_and_missing_handler():
+    src = (
+        "import re\n"
+        "def _h_ok(h):\n"
+        "    pass\n"
+        "ROUTES = [\n"
+        "    (re.compile(r'/3/Same'), 'GET', _h_ok),\n"
+        "    (re.compile(r'/3/Same'), 'GET', _h_ok),\n"
+        "    (re.compile(r'/3/Gone'), 'GET', _h_missing),\n"
+        "]\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/api/fixture_routes.py")
+        if f.rule == "R006"]
+    msgs = " | ".join(f.message for f in found)
+    assert "duplicate route" in msgs and "not defined" in msgs
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+def test_inline_suppression_waives_finding():
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    # h2o3-ok: R001 fixture: intentionally waived\n"
+        "    return jax.jit(lambda a: a + 1)(x)\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R001"]
+    assert found and all(f.suppressed for f in found)
+    assert not engine.unsuppressed(found)
+
+
+def test_baseline_grandfathers_by_fingerprint(tmp_path):
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    return jax.jit(lambda a: a + 1)(x)\n")
+    found = engine.analyze_source(src)
+    bl = tmp_path / "bl.json"
+    engine.write_baseline(found, str(bl))
+    again = engine.analyze_source(src)
+    engine.apply_baseline(again, engine.load_baseline(str(bl)))
+    assert not engine.unsuppressed(again)
+    data = json.loads(bl.read_text())
+    assert data["findings"] and all("fingerprint" in e
+                                    for e in data["findings"])
+
+
+# ---------------------------------------------------------------------------
+# 2. the package gate (tier-1): zero unsuppressed findings
+def test_package_has_zero_unsuppressed_findings():
+    findings = engine.run(baseline_path=BASELINE)
+    bad = engine.unsuppressed(findings)
+    assert not bad, (
+        "static analysis found new defects (fix them, or suppress with "
+        "`# h2o3-ok: Rnnn <reason>` / baseline via --write-baseline):\n"
+        + "\n".join(str(f) for f in bad))
+
+
+def test_cli_entry_point_exit_codes():
+    out = subprocess.run(
+        [sys.executable, "-m", "h2o3_tpu.analysis",
+         "--baseline", BASELINE, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["unsuppressed"] == 0
+
+
+def test_metric_census_is_committed_and_current():
+    """obs/METRICS.md must match a fresh census — renaming or adding a
+    metric without regenerating fails here, keeping dashboards honest."""
+    from h2o3_tpu.analysis import rules_metrics
+    mods = engine.load_modules([engine.package_root()])
+    want = rules_metrics.census_markdown(mods)
+    path = os.path.join(engine.package_root(), "obs", "METRICS.md")
+    assert os.path.exists(path), \
+        "run: python -m h2o3_tpu.analysis --write-census"
+    with open(path, encoding="utf-8") as fh:
+        have = fh.read()
+    assert have == want, \
+        "stale metric census — run: python -m h2o3_tpu.analysis " \
+        "--write-census"
+
+
+# ---------------------------------------------------------------------------
+# 3. runtime sanitizers on the real serving path
+RNG = np.random.default_rng(77)
+
+
+def _frame(n, resp=False):
+    from h2o3_tpu.core.frame import Frame
+    cols = {"a": RNG.normal(size=n), "b": RNG.normal(size=n)}
+    if resp:
+        cols["y"] = RNG.normal(size=n)
+    return Frame.from_dict(cols)
+
+
+@pytest.fixture(scope="module")
+def glm():
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu.models import ESTIMATORS
+    tr = _frame(220, resp=True)
+    m = ESTIMATORS["glm"]()
+    m.train(x=["a", "b"], y="y", training_frame=tr)
+    yield m
+    DKV.remove(tr.key)
+    DKV.remove(m.key)
+
+
+def test_warm_scoring_path_is_transfer_guard_clean(glm):
+    """The ISSUE 2 fast path does no stray transfers: after warming a
+    bucket, scoring under jax.transfer_guard('disallow') — which rejects
+    every IMPLICIT transfer — must succeed without falling back, because
+    staging uses device_put and readback uses device_get (explicit)."""
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu.serving import scorer_cache as sc
+    warm = _frame(64)
+    p0 = glm.predict(warm)                    # compile + warm the bucket
+    trace_errors0 = sc.FALLBACKS.value(reason="trace-error")
+    f = _frame(57)                            # same bucket, new row count
+    with sanitizers.transfer_guard("disallow"):
+        p = glm.predict(f)
+    assert p.nrows == 57
+    assert sc.FALLBACKS.value(reason="trace-error") == trace_errors0, \
+        "warm scoring fell back under transfer_guard('disallow') — an " \
+        "implicit host↔device transfer crept into the fast path"
+    for k in (warm.key, p0.key, f.key, p.key):
+        DKV.remove(k)
+
+
+def test_debug_nans_scoped_toggle():
+    import jax
+    prev = jax.config.jax_debug_nans
+    with sanitizers.debug_nans(True):
+        assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_debug_nans == prev
+
+
+def test_install_from_env_is_gated(monkeypatch):
+    monkeypatch.delenv("H2O3_DEBUG_NANS", raising=False)
+    monkeypatch.delenv("H2O3_TRANSFER_GUARD", raising=False)
+    assert sanitizers.install_from_env() == {}
+
+
+# ---------------------------------------------------------------------------
+# micro-batch backpressure (bounded queue depth → 503 + Retry-After)
+def test_cached_jit_key_hardening():
+    """Bound methods and cyclic closures must fall back to an uncached
+    jit (never share a key); hash-equal captures of different types must
+    key apart (1 vs 1.0 traces different programs)."""
+    from h2o3_tpu.parallel import mrtask as mrt
+
+    class M:
+        def __init__(self, k):
+            self.k = k
+
+        def score(self, x):
+            return x * self.k
+
+    a, b = M(2.0), M(3.0)
+    assert float(mrt.cached_jit(a.score)(np.float32(1.0))) == 2.0
+    assert float(mrt.cached_jit(b.score)(np.float32(1.0))) == 3.0
+
+    def outer():
+        def g(x):
+            return g(x)
+        return g
+
+    mrt.cached_jit(outer())        # cyclic closure: must not recurse
+
+    def mk(c):
+        return lambda x: x + c
+
+    one = mrt._fn_key(mk(1))
+    one_f = mrt._fn_key(mk(1.0))
+    assert one != one_f            # int vs float capture → distinct keys
+    assert mrt._fn_key(mk(1)) == one
+
+
+def test_queue_full_rejects_before_staging(glm, monkeypatch):
+    """check_capacity sheds at the entry point — before payload decode /
+    frame staging burns CPU on a request that will be 503'd anyway."""
+    from h2o3_tpu import serving
+    from h2o3_tpu.serving import microbatch as mb
+    monkeypatch.setenv("H2O3_SCORE_QUEUE_DEPTH", "1")
+    monkeypatch.setattr(mb.BATCHER, "_depth", 1)
+    called = []
+    monkeypatch.setattr(serving, "payload_to_raw",
+                        lambda *a, **k: called.append(1) or (_ for _ in ()).throw(
+                            AssertionError("staged a doomed request")))
+    with pytest.raises(serving.QueueFull):
+        serving.score_payload(glm, [{"a": 0.1, "b": 0.2}])
+    assert not called
+
+
+def test_queue_full_rejects_and_recovers(glm, monkeypatch):
+    from h2o3_tpu import serving
+    from h2o3_tpu.serving import microbatch as mb
+    monkeypatch.setenv("H2O3_SCORE_QUEUE_DEPTH", "1")
+    rejected0 = mb.REJECTED.value()
+    monkeypatch.setattr(mb.BATCHER, "_depth", 1)
+    with pytest.raises(serving.QueueFull) as ei:
+        serving.score_payload(glm, [{"a": 0.1, "b": 0.2}])
+    assert ei.value.retry_after_s >= 1
+    assert mb.REJECTED.value() == rejected0 + 1
+    monkeypatch.setattr(mb.BATCHER, "_depth", 0)
+    out = serving.score_payload(glm, [{"a": 0.1, "b": 0.2}])
+    assert len(out) == 1 and "predict" in out[0]
+
+
+def test_queue_depth_tracks_inflight_requests(glm, monkeypatch):
+    """_depth rises while a request lingers in the queue and returns to
+    zero afterwards (the gauge the 503 decision reads)."""
+    from h2o3_tpu import serving
+    from h2o3_tpu.serving import microbatch as mb
+    monkeypatch.setenv("H2O3_SCORE_LINGER_MS", "30")
+    seen = []
+    t = threading.Thread(target=lambda: seen.append(
+        serving.score_payload(glm, [{"a": 0.3, "b": 0.4}])))
+    t.start()
+    t.join(timeout=30)
+    assert seen and len(seen[0]) == 1
+    assert mb.BATCHER._depth == 0
+
+
+def test_rest_returns_503_with_retry_after(glm, monkeypatch):
+    """Full REST stack: queue-full answers 503 + Retry-After, not 500."""
+    import urllib.error
+    import urllib.request
+    from h2o3_tpu.api.server import H2OServer
+    from h2o3_tpu.serving import microbatch as mb
+    s = H2OServer(port=0).start()
+    try:
+        monkeypatch.setenv("H2O3_SCORE_QUEUE_DEPTH", "1")
+        monkeypatch.setattr(mb.BATCHER, "_depth", 1)
+        body = json.dumps({"rows": [{"a": 0.1, "b": 0.2}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s.port}/3/Predictions/models/{glm.key}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "1"
+        monkeypatch.setattr(mb.BATCHER, "_depth", 0)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["row_count"] == 1
+    finally:
+        s.stop()
