@@ -16,15 +16,32 @@ classes this repo actually shipped:
                          inconsistent label sets (census: obs/METRICS.md)
   R006 route drift       REST route capture groups vs handler signatures
 
+Interprocedural concurrency rules (callgraph.py: project-wide call graph
++ lock-acquisition graph):
+
+  R007 lock-order cycle  holding A while taking B (directly or via any
+                         call chain) vs. B-then-A anywhere else
+  R008 blocking-while-locked  device syncs, socket/HTTP/subprocess waits,
+                         timeout-less .wait()/.get()/.join() reachable
+                         with a lock held
+  R009 use-after-donate  a donate_argnums buffer read after the jitted
+                         call that consumed it
+  R010 thread/exec leak  Thread without daemon/join; executor futures
+                         discarded; un-shutdown ThreadPoolExecutor
+
 Run `python -m h2o3_tpu.analysis --baseline analysis_baseline.json`; the
-tier-1 suite enforces zero unsuppressed findings. Runtime sanitizers
-(transfer_guard / debug_nans) live in .sanitizers.
+tier-1 suite enforces zero unsuppressed findings over BOTH the package
+and tests/ (tests run the relaxed profile: R001/R004 waived). Runtime
+sanitizers (transfer_guard / debug_nans) live in .sanitizers; the
+runtime lock-order checker (H2O3_LOCKDEP) in .lockdep.
 """
 
 from h2o3_tpu.analysis.engine import (   # noqa: F401
-    Finding, analyze_paths, analyze_source, apply_baseline, load_baseline,
-    package_root, repo_root, run, unsuppressed, write_baseline)
+    Finding, analyze_paths, analyze_source, analyze_sources,
+    apply_baseline, load_baseline, package_root, repo_root, run,
+    tests_root, unsuppressed, write_baseline)
 from h2o3_tpu.analysis.sanitizers import (   # noqa: F401
     debug_nans, install_from_env, transfer_guard)
 
-ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006")
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006",
+             "R007", "R008", "R009", "R010")

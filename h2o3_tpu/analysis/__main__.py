@@ -18,7 +18,7 @@ from h2o3_tpu.analysis import engine
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m h2o3_tpu.analysis",
-        description="JAX-aware static analyzer (rules R001-R006)")
+        description="JAX-aware static analyzer (rules R001-R010)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the h2o3_tpu "
                          "package)")
@@ -37,6 +37,9 @@ def main(argv=None) -> int:
                     const="__default__", default=None,
                     help="write the metric census markdown (default: "
                          "h2o3_tpu/obs/METRICS.md)")
+    ap.add_argument("--check-census", action="store_true",
+                    help="exit 1 when h2o3_tpu/obs/METRICS.md is stale "
+                         "(pre-commit freshness gate)")
     args = ap.parse_args(argv)
 
     rules = [r.strip().upper() for r in args.rules.split(",")] \
@@ -45,14 +48,39 @@ def main(argv=None) -> int:
     mods = engine.load_modules(paths)
     findings = engine.analyze_modules(mods, rules=rules)
 
-    if args.write_census is not None:
+    if args.write_census is not None or args.check_census:
         from h2o3_tpu.analysis import rules_metrics
-        out = args.write_census
-        if out == "__default__":
-            out = os.path.join(engine.package_root(), "obs", "METRICS.md")
-        with open(out, "w", encoding="utf-8") as fh:
-            fh.write(rules_metrics.census_markdown(mods))
-        print(f"census written: {out}", file=sys.stderr)
+        # the census is PACKAGE metrics by definition — independent of
+        # which paths this invocation analyzes (the hook passes tests/
+        # too, which must not leak fixture metrics into the census).
+        # When the analyzed paths cover the whole package (the hook's
+        # `h2o3_tpu tests` spelling), filter the already-parsed modules
+        # instead of re-reading the tree; re-load only for partial runs.
+        pkg_root = engine.package_root()
+        if any(os.path.abspath(p) == pkg_root for p in paths):
+            pkg_mods = [m for m in mods
+                        if m.path.startswith(pkg_root + os.sep)]
+        else:
+            pkg_mods = engine.load_modules([pkg_root])
+        body = rules_metrics.census_markdown(pkg_mods)
+        default_path = os.path.join(engine.package_root(), "obs",
+                                    "METRICS.md")
+        if args.write_census is not None:
+            out = args.write_census
+            if out == "__default__":
+                out = default_path
+            with open(out, "w", encoding="utf-8") as fh:
+                fh.write(body)
+            print(f"census written: {out}", file=sys.stderr)
+        if args.check_census:
+            have = ""
+            if os.path.exists(default_path):
+                with open(default_path, encoding="utf-8") as fh:
+                    have = fh.read()
+            if have != body:
+                print("stale metric census — run: python -m "
+                      "h2o3_tpu.analysis --write-census", file=sys.stderr)
+                return 1
 
     if args.baseline and not args.write_baseline:
         engine.apply_baseline(findings, engine.load_baseline(args.baseline))

@@ -1,0 +1,822 @@
+"""R007-R010 — interprocedural concurrency rules over a project-wide
+call graph + lock-acquisition graph.
+
+ISSUE 3's per-file rules caught the lock bugs a single screenful shows
+(R003 found the real Broadcaster._drain_owed case), but H2O-3's hardest
+bugs were CROSS-file: the DKV, the replay channel and the scoring queues
+nest each other's locks, and a lock-order cycle or a device wait under a
+lock only exists in the composition. This module builds the composition:
+
+  * a CALL GRAPH over every module handed to the analyzer — module-level
+    functions, methods (`self.m()`, `Cls.m()`, same-module singleton
+    `OBJ.m()`), and cross-module calls resolved through `import`/`from`
+    aliases and module-level singletons (`DKV = _DKV()` makes `DKV.put`
+    resolve to `_DKV.put` from any importer);
+  * a LOCK-ACQUISITION GRAPH: lock identities are class attributes
+    assigned a Lock/RLock/Condition/Semaphore (or an analysis.lockdep
+    make_lock/make_rlock/DepLock) — id `module.Class.attr` — and
+    module-level lock globals — id `module.NAME`. `with <lock>:` blocks
+    are tracked lexically; a `with` on something unresolvable holds
+    nothing (conservative: silence over noise). Bare `.acquire()` calls
+    are NOT modeled — the codebase convention is `with`.
+
+Per-function summaries (locks acquired, blocking ops, out-calls, each
+with the lexically-held lock set) are closed over the call graph to a
+fixpoint, then feed four rule families:
+
+  R007 lock-order cycles  holding A while taking B (directly, or via any
+                          call chain that takes B) adds edge A→B; a cycle
+                          in the global edge set is a deadlock schedule
+                          waiting for its interleaving. One finding per
+                          cycle, at the edge site that closes it.
+  R008 blocking-while-locked  a blocking operation reachable while a lock
+                          is held: device syncs (block_until_ready /
+                          device_get / host_fetch), replay-channel
+                          collect, socket recv/accept/connect/sendall,
+                          HTTP (urlopen), subprocess, time.sleep, and
+                          timeout-less `.wait()` / `.get()` / `.join()` /
+                          `.result()`. A stalled device or peer then
+                          freezes every thread that touches the lock —
+                          the "one wedged worker stops /metrics" class.
+                          A call carrying a `timeout=`/`deadline=` kwarg
+                          is treated as bounded and not descended into.
+  R009 use-after-donate   an argument buffer donated to a jitted call
+                          (donate_argnums) is read after the call: XLA
+                          may already have aliased its memory, so the
+                          read returns garbage (or raises under jax
+                          buffer-donation checking). Tracks jit(...,
+                          donate_argnums=...) values AND factory
+                          functions that return them (scorer_cache
+                          _build → program → score_rows chain).
+  R010 thread/executor leaks  threading.Thread started with neither
+                          daemon=True nor a reachable .join() — the
+                          process can't exit and failures vanish;
+                          ThreadPoolExecutor neither context-managed nor
+                          .shutdown(); an executor .submit() whose future
+                          is discarded (its exception is silently lost).
+
+Suppress a verified-safe site with `# h2o3-ok: R00n <why>` as usual.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from h2o3_tpu.analysis.engine import Finding, Module
+
+RULES = {"R007", "R008", "R009", "R010"}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_LOCK_FACTORIES = {"make_lock", "make_rlock", "DepLock"}
+_REENTRANT_CTORS = {"RLock", "make_rlock"}
+_TIME_ROOTS = {"time", "_time", "_time_mod"}
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+def _terminal(fn: ast.AST):
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _chain(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _mod_key(rel: str) -> str:
+    """'h2o3_tpu/core/kvstore.py' -> 'h2o3_tpu.core.kvstore'."""
+    r = rel.replace("\\", "/")
+    if r.endswith(".py"):
+        r = r[:-3]
+    if r.endswith("/__init__"):
+        r = r[: -len("/__init__")]
+    return r.replace("/", ".")
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    return {c: p for p in ast.walk(tree) for c in ast.iter_child_nodes(p)}
+
+
+def _has_bound(call: ast.Call) -> bool:
+    """True when the call carries a non-None timeout/deadline kwarg —
+    treated as a bounded wait (the sanctioned R008 fix shape)."""
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "deadline", "timeout_s"):
+            if isinstance(kw.value, ast.Constant) and kw.value.value is None:
+                return False
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# project index: classes, functions, singletons, locks, imports
+@dataclass
+class _ClassInfo:
+    name: str
+    methods: dict = field(default_factory=dict)   # name -> qual
+    lock_attrs: dict = field(default_factory=dict)  # attr -> (id, reentrant)
+    bases: list = field(default_factory=list)     # base names (same module)
+
+
+@dataclass
+class _ModInfo:
+    key: str
+    mod: Module
+    defs: dict = field(default_factory=dict)        # fn name -> qual
+    classes: dict = field(default_factory=dict)     # cls name -> _ClassInfo
+    singletons: dict = field(default_factory=dict)  # var -> cls name
+    locks: dict = field(default_factory=dict)       # var -> (id, reentrant)
+    imports: dict = field(default_factory=dict)     # alias -> (modkey, sym)
+
+
+@dataclass
+class _FnInfo:
+    qual: str
+    mod: _ModInfo
+    cls: str            # "" for module-level functions
+    node: ast.AST
+    # summaries (filled by _summarize)
+    acquires: list = field(default_factory=list)   # (lock_id, line, held fs)
+    calls: list = field(default_factory=list)      # (qual, line, held, bound)
+    blocking: list = field(default_factory=list)   # (desc, line, held)
+    # closures (filled by fixpoint)
+    locks_in: set = field(default_factory=set)     # {(lock_id, rel, line)}
+    blocks_in: set = field(default_factory=set)    # {(desc, rel, line)}
+
+
+def _lock_ctor(value: ast.AST):
+    """(is_lock, reentrant) for `threading.Lock()`-shaped values."""
+    if isinstance(value, ast.Call):
+        t = _terminal(value.func)
+        if t in _LOCK_CTORS or t in _LOCK_FACTORIES:
+            return True, t in _REENTRANT_CTORS
+    return False, False
+
+
+def _index_module(mod: Module) -> _ModInfo:
+    mi = _ModInfo(key=_mod_key(mod.rel), mod=mod)
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mi.defs[node.name] = f"{mi.key}.{node.name}"
+        elif isinstance(node, ast.ClassDef):
+            ci = _ClassInfo(name=node.name)
+            ci.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[sub.name] = f"{mi.key}.{node.name}.{sub.name}"
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    is_lock, reent = _lock_ctor(sub.value)
+                    if not is_lock:
+                        continue
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            ci.lock_attrs[t.attr] = (
+                                f"{mi.key}.{node.name}.{t.attr}", reent)
+            mi.classes[node.name] = ci
+        elif isinstance(node, ast.Assign):
+            is_lock, reent = _lock_ctor(node.value)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if is_lock:
+                    mi.locks[t.id] = (f"{mi.key}.{t.id}", reent)
+                elif isinstance(node.value, ast.Call):
+                    ctor = _terminal(node.value.func)
+                    if ctor in mi.classes:
+                        mi.singletons[t.id] = ctor
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mi.imports[a.asname or a.name.split(".")[0]] = (a.name, None)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                mi.imports[a.asname or a.name] = (node.module, a.name)
+    return mi
+
+
+def _class_lock(ci: _ClassInfo, mi: _ModInfo, attr: str, depth=0):
+    """Resolve a lock attribute through same-module base classes."""
+    if attr in ci.lock_attrs:
+        return ci.lock_attrs[attr]
+    if depth < 4:
+        for b in ci.bases:
+            base = mi.classes.get(b)
+            if base is not None:
+                got = _class_lock(base, mi, attr, depth + 1)
+                if got is not None:
+                    return got
+    return None
+
+
+def _class_method(ci: _ClassInfo, mi: _ModInfo, name: str, depth=0):
+    if name in ci.methods:
+        return ci.methods[name]
+    if depth < 4:
+        for b in ci.bases:
+            base = mi.classes.get(b)
+            if base is not None:
+                got = _class_method(base, mi, name, depth + 1)
+                if got is not None:
+                    return got
+    return None
+
+
+class _Project:
+    def __init__(self, mods: list):
+        self.mods = [_index_module(m) for m in mods
+                     if m.source]          # skip unreadable stubs
+        self.by_key = {mi.key: mi for mi in self.mods}
+        self.fns: dict = {}                # qual -> _FnInfo
+        for mi in self.mods:
+            for node in mi.mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = mi.defs[node.name]
+                    self.fns[q] = _FnInfo(q, mi, "", node)
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            q = f"{mi.key}.{node.name}.{sub.name}"
+                            self.fns[q] = _FnInfo(q, mi, node.name, sub)
+        self.lock_reentrant: dict = {}     # lock_id -> bool
+        for mi in self.mods:
+            for lid, reent in mi.locks.values():
+                self.lock_reentrant[lid] = reent
+            for ci in mi.classes.values():
+                for lid, reent in ci.lock_attrs.values():
+                    self.lock_reentrant[lid] = reent
+
+    # -- symbol resolution ------------------------------------------------
+    def _import_target(self, mi: _ModInfo, alias: str):
+        """(target_module_info, symbol_or_None) for an imported alias."""
+        got = mi.imports.get(alias)
+        if got is None:
+            return None, None
+        modkey, sym = got
+        tgt = self.by_key.get(modkey)
+        if sym is None:
+            return tgt, None
+        if tgt is None:
+            # `from pkg import module` — the alias IS a module
+            sub = self.by_key.get(f"{modkey}.{sym}")
+            if sub is not None:
+                return sub, None
+            return None, None
+        return tgt, sym
+
+    def resolve_lock(self, mi: _ModInfo, cls: str, expr: ast.AST):
+        """Lock id for a `with <expr>:` context, or None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            recv, attr = expr.value.id, expr.attr
+            if recv == "self" and cls:
+                ci = mi.classes.get(cls)
+                if ci is not None:
+                    got = _class_lock(ci, mi, attr)
+                    if got is not None:
+                        return got[0]
+                return None
+            if recv in mi.singletons:
+                ci = mi.classes.get(mi.singletons[recv])
+                if ci is not None:
+                    got = _class_lock(ci, mi, attr)
+                    if got is not None:
+                        return got[0]
+                return None
+            tgt, sym = self._import_target(mi, recv)
+            if tgt is not None and sym is None and attr in tgt.locks:
+                return tgt.locks[attr][0]
+            if tgt is not None and sym is not None \
+                    and sym in tgt.singletons:
+                ci = tgt.classes.get(tgt.singletons[sym])
+                if ci is not None:
+                    got = _class_lock(ci, tgt, attr)
+                    if got is not None:
+                        return got[0]
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in mi.locks:
+                return mi.locks[expr.id][0]
+            tgt, sym = self._import_target(mi, expr.id)
+            if tgt is not None and sym is not None and sym in tgt.locks:
+                return tgt.locks[sym][0]
+        return None
+
+    def resolve_call(self, mi: _ModInfo, cls: str, call: ast.Call):
+        """Qualified name of the callee, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in mi.defs:
+                return mi.defs[fn.id]
+            if fn.id in mi.classes:          # constructor
+                return _class_method(mi.classes[fn.id], mi, "__init__")
+            tgt, sym = self._import_target(mi, fn.id)
+            if tgt is not None and sym is not None:
+                if sym in tgt.defs:
+                    return tgt.defs[sym]
+                if sym in tgt.classes:
+                    return _class_method(tgt.classes[sym], tgt, "__init__")
+            return None
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)):
+            return None
+        recv, meth = fn.value.id, fn.attr
+        if recv == "self" and cls:
+            ci = mi.classes.get(cls)
+            if ci is not None:
+                return _class_method(ci, mi, meth)
+            return None
+        if recv in mi.classes:               # Cls.static(...)
+            return _class_method(mi.classes[recv], mi, meth)
+        if recv in mi.singletons:
+            ci = mi.classes.get(mi.singletons[recv])
+            if ci is not None:
+                return _class_method(ci, mi, meth)
+            return None
+        tgt, sym = self._import_target(mi, recv)
+        if tgt is not None:
+            if sym is None:                  # module alias: mod.f()
+                if meth in tgt.defs:
+                    return tgt.defs[meth]
+                if meth in tgt.singletons or meth in tgt.classes:
+                    return None
+                return None
+            if sym in tgt.singletons:        # from m import OBJ; OBJ.f()
+                ci = tgt.classes.get(tgt.singletons[sym])
+                if ci is not None:
+                    return _class_method(ci, tgt, meth)
+            if sym in tgt.classes:
+                return _class_method(tgt.classes[sym], tgt, meth)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# blocking-operation classification (R008)
+def _blocking_desc(call: ast.Call):
+    """Human-readable description when `call` is a potentially-unbounded
+    blocking operation, else None."""
+    fn = call.func
+    bounded = _has_bound(call)
+    term = _terminal(fn)
+    chain = _chain(fn)
+    root = chain.split(".", 1)[0] if chain else ""
+    if isinstance(fn, ast.Attribute):
+        if term in ("wait", "get", "join", "result") and not call.args \
+                and not bounded:
+            what = {"wait": "Event/Condition.wait",
+                    "get": "queue.get", "join": "join",
+                    "result": "future.result"}[term]
+            return f".{term}() [{what} with no timeout]"
+        if term in ("recv", "recv_into", "accept", "getresponse"):
+            return f"socket .{term}()"
+        if term in ("connect", "sendall") and root not in ("self",):
+            return f"socket .{term}()"
+        if term == "collect" and "broadcast" in chain.lower():
+            return "replay-channel collect()"
+        if term == "block_until_ready":
+            return "block_until_ready (device barrier)"
+        if term in ("device_get", "host_fetch"):
+            return f"{term} (device→host sync)"
+        if term == "sleep" and root in _TIME_ROOTS:
+            return "time.sleep"
+        if term == "urlopen":
+            return "HTTP urlopen"
+        if root in ("requests", "httpx") and \
+                term in ("get", "post", "put", "delete", "request"):
+            return f"HTTP {chain}"
+        if root == "subprocess" and term in ("run", "check_call",
+                                             "check_output", "call"):
+            return f"subprocess.{term}"
+        if term == "communicate" and not bounded:
+            return "subprocess .communicate() with no timeout"
+    elif isinstance(fn, ast.Name):
+        if term in ("block_until_ready", "device_get", "host_fetch"):
+            return f"{term} (device sync)"
+        if term == "urlopen":
+            return "HTTP urlopen"
+        if term == "sleep":
+            return "time.sleep"
+        if term == "create_connection" and not bounded:
+            return "socket create_connection with no timeout"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-function lexical summary
+def _summarize(fi: _FnInfo, proj: _Project):
+    mi, cls = fi.mod, fi.cls
+
+    def visit(node, held: tuple):
+        if isinstance(node, ast.With):
+            ids = []
+            for item in node.items:
+                lid = proj.resolve_lock(mi, cls, item.context_expr)
+                if lid is not None:
+                    fi.acquires.append((lid, node.lineno, frozenset(held)))
+                    ids.append(lid)
+                visit(item.context_expr, held)
+            inner = tuple(held) + tuple(i for i in ids if i not in held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return      # nested scope: summarized separately (module defs)
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(node)
+            if desc is not None:
+                fi.blocking.append((desc, node.lineno, frozenset(held)))
+            callee = proj.resolve_call(mi, cls, node)
+            if callee is not None and callee in proj.fns:
+                fi.calls.append((callee, node.lineno, frozenset(held),
+                                 _has_bound(node)))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    body = fi.node.body if hasattr(fi.node, "body") else []
+    for child in body:
+        visit(child, ())
+
+
+def _fixpoint(proj: _Project):
+    """Close locks_in / blocks_in over the call graph. blocks_in does not
+    propagate through bounded (timeout-kwarg) calls; locks_in always
+    propagates (a bounded wait still nests the callee's locks)."""
+    for fi in proj.fns.values():
+        fi.locks_in = {(lid, fi.mod.mod.rel, ln)
+                       for lid, ln, _ in fi.acquires}
+        fi.blocks_in = {(d, fi.mod.mod.rel, ln)
+                        for d, ln, _ in fi.blocking}
+    changed = True
+    guard = 0
+    while changed and guard < 50:
+        changed = False
+        guard += 1
+        for fi in proj.fns.values():
+            for callee, _ln, _held, bound in fi.calls:
+                cf = proj.fns.get(callee)
+                if cf is None:
+                    continue
+                if not cf.locks_in <= fi.locks_in:
+                    fi.locks_in |= cf.locks_in
+                    changed = True
+                if not bound and not cf.blocks_in <= fi.blocks_in:
+                    fi.blocks_in |= cf.blocks_in
+                    changed = True
+
+
+# ---------------------------------------------------------------------------
+# R007: lock-order cycles
+def _lock_edges(proj: _Project):
+    """{(a, b): (rel, line, note)} — first site seen for each order edge."""
+    edges: dict = {}
+
+    def add(a, b, rel, line, note):
+        if a == b:
+            return              # re-entry: handled by reentrancy, not order
+        edges.setdefault((a, b), (rel, line, note))
+
+    for fi in proj.fns.values():
+        rel = fi.mod.mod.rel
+        for lid, line, held in fi.acquires:
+            for h in held:
+                add(h, lid, rel, line, f"{_short(h)} → {_short(lid)}")
+        for callee, line, held, _bound in fi.calls:
+            if not held:
+                continue
+            cf = proj.fns.get(callee)
+            if cf is None:
+                continue
+            for (lid, orel, oline) in cf.locks_in:
+                for h in held:
+                    add(h, lid, rel, line,
+                        f"{_short(h)} → {_short(lid)} via {callee}() "
+                        f"(acquired at {orel}:{oline})")
+    return edges
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split(".", 2)[-1] if lock_id.count(".") > 2 else lock_id
+
+
+def _find_cycles(edges: dict) -> list:
+    """Minimal cycles as lists of (a, b) edges, one per cycle set."""
+    succ: dict = {}
+    for (a, b) in edges:
+        succ.setdefault(a, set()).add(b)
+    cycles = []
+    seen_cycle_keys = set()
+    for start in sorted(succ):
+        # BFS back to start
+        prev = {start: None}
+        queue = [start]
+        found = None
+        while queue and found is None:
+            cur = queue.pop(0)
+            for nxt in sorted(succ.get(cur, ())):
+                if nxt == start:
+                    found = cur
+                    break
+                if nxt not in prev:
+                    prev[nxt] = cur
+                    queue.append(nxt)
+        if found is None:
+            continue
+        path = [found]
+        while prev[path[-1]] is not None:
+            path.append(prev[path[-1]])
+        path.reverse()              # start ... found
+        nodes = [start] if path == [start] else path
+        cyc = [(nodes[i], nodes[(i + 1) % len(nodes)])
+               for i in range(len(nodes))]
+        if len(nodes) == 1:
+            continue
+        key = frozenset(nodes)
+        if key not in seen_cycle_keys:
+            seen_cycle_keys.add(key)
+            cycles.append(cyc)
+    return cycles
+
+
+def _check_r007(proj: _Project) -> list:
+    findings = []
+    edges = _lock_edges(proj)
+    for cyc in _find_cycles(edges):
+        sites = [edges[e] for e in cyc]
+        rel, line, _ = sites[0]
+        desc = " ; ".join(
+            f"{_short(a)}→{_short(b)} ({edges[(a, b)][0]}:"
+            f"{edges[(a, b)][1]})" for a, b in cyc)
+        findings.append(Finding(
+            "R007", rel, line,
+            f"lock-order cycle: {desc} — two threads taking these locks "
+            "in opposing order deadlock; pick one global order (or merge "
+            "the critical sections)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R008: blocking while holding a lock
+def _check_r008(proj: _Project) -> list:
+    findings = []
+    for fi in proj.fns.values():
+        rel = fi.mod.mod.rel
+        for desc, line, held in fi.blocking:
+            if held:
+                findings.append(Finding(
+                    "R008", rel, line,
+                    f"{desc} while holding {_short(sorted(held)[0])}: a "
+                    "stall here wedges every thread touching the lock — "
+                    "bound the wait (timeout=) or move it outside the "
+                    "critical section"))
+        for callee, line, held, bound in fi.calls:
+            if not held or bound:
+                continue
+            cf = proj.fns.get(callee)
+            if cf is None or not cf.blocks_in:
+                continue
+            desc, orel, oline = sorted(cf.blocks_in)[0]
+            findings.append(Finding(
+                "R008", rel, line,
+                f"call into {callee}() while holding "
+                f"{_short(sorted(held)[0])}: it reaches {desc} "
+                f"({orel}:{oline}) — a stall there wedges the lock; "
+                "bound the wait or hoist the call out of the critical "
+                "section"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R009: donated-buffer use-after-donate
+def _donate_positions(call: ast.Call):
+    """Donated arg positions of a jax.jit(...) call, or None if not a
+    donating jit. Non-literal donate_argnums conservatively means 'all'."""
+    if _terminal(call.func) not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = set()
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, int):
+                        out.add(e.value)
+                return out if out else set()
+            return None if isinstance(v, ast.Constant) and v.value is None \
+                else {"*"}          # computed: any positional arg
+    return None
+
+
+def _donating_factories(proj: _Project) -> dict:
+    """{qual: positions} for functions that RETURN a donating jit —
+    directly, via a local var, or via a call to another donating factory
+    (fixpoint, so scorer_cache's _build → program chain resolves)."""
+    out: dict = {}
+    changed = True
+    guard = 0
+    while changed and guard < 10:
+        changed = False
+        guard += 1
+        for fi in proj.fns.values():
+            if fi.qual in out:
+                continue
+            # local name -> positions (assigned from jit or factory call)
+            local: dict = {}
+            pos = None
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    p = _donate_positions(node.value)
+                    if p is None:
+                        callee = proj.resolve_call(fi.mod, fi.cls,
+                                                   node.value)
+                        p = out.get(callee)
+                    if p:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local[t.id] = p
+                if isinstance(node, ast.Return) and node.value is not None:
+                    v = node.value
+                    if isinstance(v, ast.Call):
+                        p = _donate_positions(v)
+                        if p is None:
+                            callee = proj.resolve_call(fi.mod, fi.cls, v)
+                            p = out.get(callee)
+                        if p:
+                            pos = (pos or set()) | p
+                    elif isinstance(v, ast.Name) and v.id in local:
+                        pos = (pos or set()) | local[v.id]
+            if pos:
+                out[fi.qual] = pos
+                changed = True
+    return out
+
+
+def _check_r009(proj: _Project) -> list:
+    findings = []
+    factories = _donating_factories(proj)
+    for fi in proj.fns.values():
+        rel = fi.mod.mod.rel
+        # donating callables visible in this function body: local vars
+        donating: dict = {}        # var name -> positions
+        calls = []                 # (lineno, donated arg Name -> str)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                p = _donate_positions(node.value)
+                if p is None:
+                    callee = proj.resolve_call(fi.mod, fi.cls, node.value)
+                    p = factories.get(callee)
+                if p:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            donating[t.id] = p
+        if not donating:
+            continue
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in donating:
+                pos = donating[node.func.id]
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and \
+                            ("*" in pos or i in pos):
+                        calls.append((node.lineno, arg.id, node.func.id))
+        if not calls:
+            continue
+        stores: dict = {}          # name -> sorted store linenos after def
+        loads: dict = {}
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name):
+                d = stores if isinstance(node.ctx, ast.Store) else loads
+                d.setdefault(node.id, []).append(node.lineno)
+        for call_line, buf, fname in calls:
+            rebinds = [ln for ln in stores.get(buf, []) if ln > call_line]
+            kill = min(rebinds) if rebinds else None
+            for ln in sorted(loads.get(buf, [])):
+                if ln <= call_line:
+                    continue
+                if kill is not None and ln > kill:
+                    break
+                findings.append(Finding(
+                    "R009", rel, ln,
+                    f"{buf!r} is read after being donated to {fname}() at "
+                    f"line {call_line}: donate_argnums lets XLA alias the "
+                    "buffer, so this read returns garbage — copy before "
+                    "the call or drop the donation"))
+                break              # one finding per donated call is enough
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R010: thread / executor leaks
+def _check_r010_module(mod: Module) -> list:
+    findings = []
+    parents = _parent_map(mod.tree)
+    src = mod.source
+
+    def _kw(call, name):
+        for kw in call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        term = _terminal(node.func)
+        chain = _chain(node.func)
+        if term == "Thread" and (chain in ("Thread", "threading.Thread")
+                                 or chain.endswith(".Thread")):
+            d = _kw(node, "daemon")
+            if isinstance(d, ast.Constant) and d.value:
+                continue
+            parent = parents.get(node)
+            target = None
+            if isinstance(parent, ast.Attribute) and parent.attr == "start":
+                # Thread(...).start(): no handle survives to join
+                findings.append(Finding(
+                    "R010", mod.rel, node.lineno,
+                    "Thread(...).start() without daemon=True and without "
+                    "keeping a handle: the thread can never be joined, "
+                    "and a non-daemon leak blocks interpreter exit"))
+                continue
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        target = t.id
+                    elif isinstance(t, ast.Attribute):
+                        target = t.attr
+            if target is None:
+                continue            # handed elsewhere: give benefit of doubt
+            if f"{target}.join" in src or f"{target}.daemon" in src:
+                continue
+            findings.append(Finding(
+                "R010", mod.rel, node.lineno,
+                f"thread {target!r} is started with neither daemon=True "
+                "nor any .join() in this module: it leaks past its owner "
+                "(failures vanish, exit hangs) — join it, or mark daemon "
+                "with a reason"))
+        elif term == "ThreadPoolExecutor":
+            parent = parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue
+            target = None
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        target = t.id
+                    elif isinstance(t, ast.Attribute):
+                        target = t.attr
+            if target is not None and (f"{target}.shutdown" in src
+                                       or f"with {target}" in src):
+                continue
+            findings.append(Finding(
+                "R010", mod.rel, node.lineno,
+                "ThreadPoolExecutor neither context-managed nor "
+                ".shutdown(): worker threads outlive the work — use "
+                "`with ThreadPoolExecutor(...) as pool:`"))
+        elif term == "submit" and isinstance(node.func, ast.Attribute):
+            parent = parents.get(node)
+            if isinstance(parent, ast.Expr):
+                findings.append(Finding(
+                    "R010", mod.rel, node.lineno,
+                    "executor .submit() with the future discarded: the "
+                    "task's exception is silently lost — keep the future "
+                    "and .result() it (or collect via as_completed)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+def check(mods: list) -> list:
+    proj = _Project(mods)
+    for fi in proj.fns.values():
+        _summarize(fi, proj)
+    _fixpoint(proj)
+    findings = []
+    findings.extend(_check_r007(proj))
+    findings.extend(_check_r008(proj))
+    findings.extend(_check_r009(proj))
+    for mi in proj.mods:
+        findings.extend(_check_r010_module(mi.mod))
+    return findings
+
+
+check.RULES = RULES

@@ -296,6 +296,14 @@ def check(mod: Module) -> list:
                     "block_until_ready inside a timeline.span block: a "
                     "device barrier on an instrumented hot path — make "
                     "it intentional (suppress with a reason) or remove"))
+            elif term in ("host_fetch", "device_get"):
+                findings.append(Finding(
+                    "R002", mod.rel, node.lineno,
+                    f"{term} inside a timeline.span block: a device→host "
+                    "sync on an instrumented hot path, so the span "
+                    "measures the transfer, not the work — fetch outside "
+                    "the span, or suppress with the reason the sync IS "
+                    "the work"))
             elif isinstance(node.func, ast.Name) \
                     and term in ("float", "int") and node.args \
                     and _contains_jnp_call(node.args[0]):

@@ -31,7 +31,9 @@ from h2o3_tpu.analysis.engine import Finding, Module
 RULES = {"R003"}
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
-               "BoundedSemaphore"}
+               "BoundedSemaphore",
+               # analysis.lockdep instrumented wrappers count as locks
+               "make_lock", "make_rlock", "DepLock"}
 _MUTATORS = {"append", "extend", "insert", "add", "remove", "discard",
              "pop", "popitem", "clear", "update", "setdefault",
              "move_to_end", "appendleft", "popleft", "extendleft",

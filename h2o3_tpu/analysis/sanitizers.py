@@ -17,6 +17,12 @@ Env gates (read by install_from_env, called at server start):
   H2O3_TRANSFER_GUARD=LEVEL  jax_transfer_guard for the whole process
                              (log | disallow | log_explicit |
                              disallow_explicit)
+  H2O3_LOCKDEP=1|raise|log   runtime lock-order checking on the
+                             instrumented subsystem locks (see
+                             analysis/lockdep.py) — "raise" turns an
+                             inversion into LockOrderInversion at the
+                             acquisition that proves it, "log" only
+                             counts h2o3_lockdep_inversions_total
 """
 
 from __future__ import annotations
@@ -54,9 +60,15 @@ def install_from_env() -> dict:
     Called by H2OServer.start() so a deployment can flip them without a
     code change; a no-op when the env vars are unset."""
     enabled = {}
+    from h2o3_tpu.analysis import lockdep
+    lockdep_mode = lockdep._mode_from_env(
+        os.environ.get("H2O3_LOCKDEP", ""))
+    if lockdep_mode:
+        lockdep.enable(lockdep_mode)
+        enabled["lockdep"] = lockdep_mode
     try:
         import jax
-    except Exception:   # noqa: BLE001 — no jax, nothing to sanitize
+    except Exception:   # noqa: BLE001 — no jax, nothing else to sanitize
         return enabled
     if os.environ.get("H2O3_DEBUG_NANS", "") in ("1", "true", "yes"):
         jax.config.update("jax_debug_nans", True)

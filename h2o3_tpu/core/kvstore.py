@@ -16,16 +16,19 @@ What survives from DKV's design:
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any
+
+from h2o3_tpu.analysis.lockdep import make_rlock
 
 
 class _DKV:
     def __init__(self):
         self._store: dict[str, Any] = {}
         self._locks: dict[str, str] = {}  # key -> job/owner name holding write lock
-        self._mutex = threading.RLock()
+        # lockdep class "dkv": the registry mutex nests inside nearly
+        # every subsystem, so it is the lock the order graph must see
+        self._mutex = make_rlock("dkv")
         self._counter = 0
 
     # ---- basic ops (DKV.put/get/remove) ---------------------------------

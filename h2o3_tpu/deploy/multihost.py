@@ -116,6 +116,24 @@ _BCAST_PORT_OFFSET = 2
 _MAX_FRAME = 64 * 1024 * 1024
 
 
+def _ack_timeout() -> float:
+    """Upper bound (seconds) on any single wait the Broadcaster performs
+    under its lock. The broadcast ack barrier is intentionally lockstep —
+    but an UNBOUNDED lockstep wait means one wedged worker freezes every
+    REST thread behind the broadcast lock forever (the R008 class the
+    static analyzer flags). Bounded, the failure is a loud RuntimeError
+    after this deadline instead of a silent server freeze."""
+    return float(os.environ.get("H2O3_REPLAY_ACK_TIMEOUT_S", "120") or 120)
+
+
+def _ack_timeouts_counter():
+    from h2o3_tpu.obs import metrics as _om
+    return _om.counter("h2o3_replay_ack_timeouts_total",
+                       "replay-channel ack waits that hit the "
+                       "H2O3_REPLAY_ACK_TIMEOUT_S deadline (a worker "
+                       "stopped acking: SPMD replay is wedged)")
+
+
 def _cluster_secret() -> bytes:
     s = os.environ.get("H2O3_CLUSTER_SECRET", "")
     if not s:
@@ -126,14 +144,23 @@ def _cluster_secret() -> bytes:
     return s.encode()
 
 
-def _send_frame(sock, key: bytes, obj) -> None:
+def _send_frame(sock, key: bytes, obj, timeout=None) -> None:
+    """Send one HMAC frame. `timeout` bounds the send: a peer that
+    stopped reading (full TCP window) raises socket.timeout instead of
+    blocking the caller — required wherever the caller holds a lock."""
     import hashlib
     import hmac
     import json
     import struct
     payload = json.dumps(obj).encode()
     tag = hmac.new(key, payload, hashlib.sha256).digest()
-    sock.sendall(struct.pack("!I", len(payload)) + tag + payload)
+    if timeout is not None:
+        sock.settimeout(timeout)
+    try:
+        sock.sendall(struct.pack("!I", len(payload)) + tag + payload)
+    finally:
+        if timeout is not None:
+            sock.settimeout(None)
 
 
 def _decode_frame(buf: bytes, key: bytes):
@@ -237,9 +264,9 @@ class Broadcaster:
     def __init__(self, n_workers: int, port: int):
         import secrets as _secrets
         import socket
-        import threading
+        from h2o3_tpu.analysis.lockdep import make_lock
         secret = _cluster_secret()
-        self._lock = threading.Lock()
+        self._lock = make_lock("replay_channel")
         self._conns = []          # [(sock, session_key)]
         self._owed: list = []     # per-conn acks abandoned by a timed-out
         self._bufs: list = []     # collect; drained before the next send
@@ -304,28 +331,74 @@ class Broadcaster:
         finally:
             c.settimeout(None)
 
-    def _drain_owed(self, i: int):
+    def _drain_owed(self, i: int, deadline: float):
         """Consume acks a timed-out collect left in flight, so the next
         broadcast's ack barrier lines up with its own sequence number.
         Used by the (intentionally lockstep) broadcast path only; collect
-        absorbs stale acks inside its own bounded recv loop."""
+        absorbs stale acks inside its own bounded recv loop. `deadline`
+        (monotonic) bounds the whole drain: the caller holds the
+        broadcast lock, so spinning past it would wedge every thread."""
+        import time as _time
         while self._owed[i] > 0:
-            if self._recv_frame_at(i) is None:   # peer gone: stop spinning
-                break
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                self._raise_wedged(i, "owed-ack drain")
+            if self._recv_frame_at(i, timeout=remaining) is None:
+                break                            # peer gone: stop spinning
             # h2o3-ok: R003 only reachable from broadcast(), which holds self._lock for the whole send+drain sequence
             self._owed[i] -= 1
 
+    def _raise_wedged(self, i: int, what: str):
+        """A worker blew the ack deadline while the broadcast lock is
+        held: count it and fail LOUDLY. SPMD replay cannot continue with
+        a desynced worker, and an unbounded wait here would freeze every
+        REST thread — a RuntimeError surfaces as a 500 on this request
+        while /metrics keeps answering."""
+        _ack_timeouts_counter().inc()
+        raise RuntimeError(
+            f"replay channel: worker {i} unresponsive for "
+            f"{_ack_timeout():g}s during {what} — SPMD replay is wedged "
+            "(H2O3_REPLAY_ACK_TIMEOUT_S bounds this wait)")
+
     def broadcast(self, method: str, path: str, params: dict):
+        import socket as _socket
+        import time as _time
         with self._lock:
             self._seq += 1
+            deadline = _time.monotonic() + _ack_timeout()
             msg = {"seq": self._seq, "method": method, "path": path,
                    "params": params}
-            for i, (c, key) in enumerate(self._conns):
-                self._drain_owed(i)
-                _send_frame(c, key, msg)
-            for i in range(len(self._conns)):
-                ack = self._recv_frame_at(i)  # receipt ack: order barrier
-                assert ack and ack.get("ack") == self._seq
+            try:
+                for i, (c, key) in enumerate(self._conns):
+                    self._drain_owed(i, deadline)
+                    # deduct from the SHARED deadline: N workers each
+                    # granted a fresh full timeout would stretch the
+                    # lock-hold bound to N×timeout
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        self._raise_wedged(i, "broadcast send")
+                    _send_frame(c, key, msg, timeout=remaining)
+                for i in range(len(self._conns)):
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        self._raise_wedged(i, "broadcast ack barrier")
+                    # receipt ack: order barrier. Explicit check, not an
+                    # assert: a peer dying mid-broadcast (EOF → None) or
+                    # answering the wrong seq must fail identically under
+                    # python -O, and desynced replay may not continue
+                    ack = self._recv_frame_at(i, timeout=remaining)
+                    if not ack or ack.get("ack") != self._seq:
+                        raise RuntimeError(
+                            f"replay channel: bad broadcast ack from "
+                            f"worker {i} (got {ack!r}, want seq "
+                            f"{self._seq}) — SPMD replay is desynced")
+            except (_socket.timeout, TimeoutError):
+                _ack_timeouts_counter().inc()
+                raise RuntimeError(
+                    f"replay channel: broadcast seq {self._seq} not "
+                    f"acked within {_ack_timeout():g}s — SPMD replay is "
+                    "wedged (H2O3_REPLAY_ACK_TIMEOUT_S bounds this "
+                    "wait)") from None
 
     def collect(self, op: str, timeout: float = 2.0) -> list:
         """Gather per-worker observability state (TimelineSnapshot's
@@ -357,7 +430,9 @@ class Broadcaster:
                 # timed-out collects are absorbed in the recv phase below,
                 # inside this round's deadline.
                 try:
-                    _send_frame(c, key, msg)
+                    # bounded send: a peer that stopped reading must not
+                    # block the scrape (we hold the broadcast lock here)
+                    _send_frame(c, key, msg, timeout=timeout)
                     sent[i] = True
                 except Exception:   # noqa: BLE001 — peer broken, isolate it
                     self._dead[i] = True

@@ -215,7 +215,13 @@ class Histogram(_Metric):
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        # lockdep-instrumented (lock class "metrics.registry"): the
+        # registry nests under every subsystem that declares or scrapes.
+        # Local import — lockdep's own counters import THIS module, so a
+        # top-level import would cycle; per-series _Metric._lock objects
+        # stay plain threading.Lock (leaf locks on the counter hot path).
+        from h2o3_tpu.analysis.lockdep import make_lock
+        self._lock = make_lock("metrics.registry")
         self._metrics: dict[str, _Metric] = {}
 
     def _get_or_make(self, cls, name, help, **kw):

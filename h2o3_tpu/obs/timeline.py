@@ -27,6 +27,8 @@ import time
 from dataclasses import dataclass, field
 from collections import deque
 
+from h2o3_tpu.analysis.lockdep import make_lock
+
 
 def host_id() -> int:
     """This process' rank in the cloud. Env-derived (the multihost
@@ -70,7 +72,7 @@ class SpanTimeline:
                                           "4096") or 4096)
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("timeline.ring")
         self._ids = itertools.count(1)
         self._tls = threading.local()
 
@@ -124,7 +126,7 @@ SPANS = SpanTimeline()
 
 # ---------------------------------------------------------------------------
 # xprof bridge (env-gated; one capture at a time)
-_TRACE_LOCK = threading.Lock()
+_TRACE_LOCK = make_lock("timeline.trace")
 _TRACE_ACTIVE = False
 
 

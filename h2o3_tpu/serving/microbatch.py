@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.serving import scorer_cache as _sc
 
@@ -31,12 +32,22 @@ DISPATCHES = _om.counter("h2o3_score_microbatch_dispatches_total",
 REJECTED = _om.counter("h2o3_microbatch_rejected_total",
                        "scoring requests rejected by queue-depth "
                        "backpressure (HTTP 503 + Retry-After)")
+WAIT_TIMEOUTS = _om.counter("h2o3_microbatch_wait_timeouts_total",
+                            "follower requests whose bounded wait on the "
+                            "batch leader expired (H2O3_SCORE_WAIT_S) — "
+                            "a nonzero rate means dispatches are stalling")
 BATCH_ROWS = _om.histogram("h2o3_score_microbatch_rows",
                            "real rows per coalesced dispatch",
                            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
                                     1024, 4096, 16384, 65536))
 
-_WAIT_S = 120.0     # follower safety timeout; dispatch failures set errors
+def _wait_s() -> float:
+    """Follower safety timeout (seconds): the R008 rule forbids an
+    unbounded Event.wait on the serving path — a leader that died between
+    registration and dispatch must strand followers for a bounded time,
+    not forever. Dispatch failures set per-request errors well before
+    this fires; it is the backstop, not the control path."""
+    return max(1.0, float(os.environ.get("H2O3_SCORE_WAIT_S", "120")))
 
 
 class QueueFull(Exception):
@@ -77,7 +88,7 @@ class _Request:
 
 class MicroBatcher:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("microbatch")
         self._pending: dict = {}
         self._depth = 0       # in-flight requests (entered, not yet woken)
 
@@ -144,8 +155,10 @@ class MicroBatcher:
                         r.error = r.error or err
                         r.event.set()
                 raise
-        elif not req.event.wait(timeout=_WAIT_S):
-            raise TimeoutError("micro-batched scoring dispatch timed out")
+        elif not req.event.wait(timeout=_wait_s()):
+            WAIT_TIMEOUTS.inc()
+            raise TimeoutError("micro-batched scoring dispatch timed out "
+                               f"after {_wait_s():g}s (H2O3_SCORE_WAIT_S)")
         if req.error is not None:
             raise req.error
         return req.result

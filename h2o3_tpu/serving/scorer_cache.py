@@ -41,13 +41,13 @@ from __future__ import annotations
 
 import itertools
 import os
-import threading
 import weakref
 from collections import OrderedDict
 
 import jax
 import numpy as np
 
+from h2o3_tpu.analysis.lockdep import make_lock, make_rlock
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.parallel import mesh as _mesh
 from h2o3_tpu.parallel import mrtask as _mrt
@@ -164,7 +164,7 @@ def stage_response(dinfo, frame, rows: int):
 # token, and the stale program can never be hit again.
 _TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _TOKEN_COUNTER = itertools.count(1)
-_TOKEN_LOCK = threading.Lock()
+_TOKEN_LOCK = make_lock("scorer_cache.tokens")
 
 
 def model_token(model) -> int:
@@ -181,7 +181,7 @@ class ScorerCache:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("scorer_cache")
         self._entries: OrderedDict = OrderedDict()
         self._building: dict = {}   # key → per-key build lock
         _om.gauge("h2o3_scorer_cache_entries",
@@ -201,7 +201,10 @@ class ScorerCache:
             # per-key build lock: concurrent cold misses for the same
             # program must compile ONCE — the second caller waits for the
             # first instead of paying a duplicate multi-second compile
-            build_lock = self._building.setdefault(key, threading.Lock())
+            # one lockdep class for every per-key build lock: instances
+            # differ, the ordering discipline is shared
+            build_lock = self._building.setdefault(
+                key, make_lock("scorer_cache.build"))
         with build_lock:
             with self._lock:
                 fn = self._entries.get(key)
@@ -290,7 +293,7 @@ CACHE = ScorerCache()
 # record, failure re-arms the window. A retrain mints a new token and
 # starts clean; stale tokens are pruned on the next compile for the key.
 _BROKEN: dict = {}
-_BROKEN_LOCK = threading.Lock()
+_BROKEN_LOCK = make_lock("scorer_cache.broken")
 _BROKEN_STRIKES = 3
 _BROKEN_COOLDOWN_S = 60.0
 

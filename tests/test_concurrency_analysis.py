@@ -1,0 +1,310 @@
+"""Interprocedural concurrency rules R007-R010 — seeded defects, the
+clean-package gate, and the relaxed test profile.
+
+Mirrors tests/test_static_analysis.py: each rule must (a) fire on a
+seeded defect that reproduces the bug class it encodes, (b) stay quiet on
+the sanctioned fix shape, and (c) report zero unsuppressed findings over
+the real package + tests tree."""
+
+import json
+import subprocess
+import sys
+
+from h2o3_tpu.analysis import engine
+
+REPO = engine.repo_root()
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# R007 — lock-order cycles
+def test_r007_detects_single_module_ab_ba_cycle():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def m2(self):\n"
+        "        with self._lb:\n"
+        "            with self._la:\n"
+        "                pass\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_ab.py")
+             if f.rule == "R007"]
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+
+
+def test_r007_detects_cross_module_cycle_via_call_graph():
+    """The case ISSUE 3's per-file R003 was blind to: each module is
+    locally consistent, the cycle only exists in the composition."""
+    srcs = {
+        "h2o3_tpu/x/aa.py": (
+            "import threading\n"
+            "from h2o3_tpu.x import bb\n"
+            "_LA = threading.Lock()\n"
+            "def fa():\n"
+            "    with _LA:\n"
+            "        bb.fb_inner()\n"
+            "def fa_inner():\n"
+            "    with _LA:\n"
+            "        pass\n"),
+        "h2o3_tpu/x/bb.py": (
+            "import threading\n"
+            "from h2o3_tpu.x import aa\n"
+            "_LB = threading.Lock()\n"
+            "def fb():\n"
+            "    with _LB:\n"
+            "        aa.fa_inner()\n"
+            "def fb_inner():\n"
+            "    with _LB:\n"
+            "        pass\n"),
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R007"]
+    assert len(found) == 1
+    assert "aa._LA" in found[0].message and "bb._LB" in found[0].message
+
+
+def test_r007_clean_on_consistent_global_order():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def m2(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n")
+    assert "R007" not in _rules_of(
+        engine.analyze_source(src, "h2o3_tpu/fix_ok.py"))
+
+
+# ---------------------------------------------------------------------------
+# R008 — blocking while holding a lock
+def test_r008_detects_timeoutless_queue_get_under_lock():
+    src = (
+        "import threading\n"
+        "import queue\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get()\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_q.py")
+             if f.rule == "R008"]
+    assert len(found) == 1 and found[0].line == 9
+    assert "queue.get" in found[0].message
+
+
+def test_r008_bounded_wait_is_clean():
+    src = (
+        "import threading\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._ev = threading.Event()\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            return self._ev.wait(timeout=2.0)\n")
+    assert "R008" not in _rules_of(
+        engine.analyze_source(src, "h2o3_tpu/fix_b.py"))
+
+
+def test_r008_detects_blocking_reached_through_call_chain():
+    """The multihost bug shape this PR fixed: the lock and the socket
+    recv live in different functions."""
+    src = (
+        "import threading\n"
+        "class B:\n"
+        "    def __init__(self, sock):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._sock = sock\n"
+        "    def _pump(self):\n"
+        "        return self._sock.recv(65536)\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            return self._pump()\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_c.py")
+             if f.rule == "R008"]
+    assert len(found) == 1 and found[0].line == 10
+    assert "recv" in found[0].message
+
+
+def test_r008_detects_device_sync_under_lock():
+    src = (
+        "import threading\n"
+        "import jax\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def bad(self, x):\n"
+        "        with self._lock:\n"
+        "            return jax.device_get(x)\n")
+    assert "R008" in _rules_of(
+        engine.analyze_source(src, "h2o3_tpu/fix_d.py"))
+
+
+# ---------------------------------------------------------------------------
+# R009 — donated-buffer use-after-donate
+def test_r009_detects_read_after_donate():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "def hot(buf):\n"
+        "    g = jax.jit(f, donate_argnums=(0,))\n"
+        "    out = g(buf)\n"
+        "    return out + buf.sum()\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_e.py")
+             if f.rule == "R009"]
+    assert len(found) == 1 and found[0].line == 7
+    assert "donated" in found[0].message
+
+
+def test_r009_rebind_after_donate_is_clean():
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "def fine(buf):\n"
+        "    g = jax.jit(f, donate_argnums=(0,))\n"
+        "    out = g(buf)\n"
+        "    buf = out * 1\n"
+        "    return buf\n")
+    assert "R009" not in _rules_of(
+        engine.analyze_source(src, "h2o3_tpu/fix_f.py"))
+
+
+def test_r009_tracks_donating_factory_functions():
+    """The scorer_cache shape: the jit(donate_argnums=...) is built in a
+    factory; the call site only sees the returned callable."""
+    src = (
+        "import jax\n"
+        "def _build():\n"
+        "    def _score(raw):\n"
+        "        return raw + 1\n"
+        "    return jax.jit(_score, donate_argnums=(0,))\n"
+        "def serve(staged):\n"
+        "    fn = _build()\n"
+        "    out = fn(staged)\n"
+        "    return out, staged.shape\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_g.py")
+             if f.rule == "R009"]
+    assert len(found) == 1 and found[0].line == 9
+
+
+# ---------------------------------------------------------------------------
+# R010 — thread / executor leaks
+def test_r010_detects_non_daemon_unjoined_thread():
+    src = (
+        "import threading\n"
+        "def leak():\n"
+        "    threading.Thread(target=print).start()\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_h.py")
+             if f.rule == "R010"]
+    assert len(found) == 1 and found[0].line == 3
+
+
+def test_r010_daemon_or_joined_thread_is_clean():
+    src = (
+        "import threading\n"
+        "def ok():\n"
+        "    threading.Thread(target=print, daemon=True).start()\n"
+        "def ok2():\n"
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n"
+        "    t.join(timeout=5)\n")
+    assert "R010" not in _rules_of(
+        engine.analyze_source(src, "h2o3_tpu/fix_i.py"))
+
+
+def test_r010_detects_discarded_future_and_unmanaged_executor():
+    src = (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def pool_leak():\n"
+        "    pool = ThreadPoolExecutor(2)\n"
+        "    pool.submit(print)\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_j.py")
+             if f.rule == "R010"]
+    msgs = " | ".join(f.message for f in found)
+    assert "shutdown" in msgs and "discarded" in msgs
+
+
+# ---------------------------------------------------------------------------
+# R002 follow-up — host_fetch / device_get inside timeline.span blocks
+def test_r002_detects_host_fetch_inside_span_block():
+    src = (
+        "from h2o3_tpu.obs.timeline import span\n"
+        "from h2o3_tpu.parallel.mrtask import host_fetch\n"
+        "def hot(x):\n"
+        "    with span('score.dispatch'):\n"
+        "        return host_fetch(x)\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R002"]
+    assert found and found[0].line == 5
+    assert "host_fetch" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# relaxed test profile: R001/R004 off under tests/, all else on
+def test_relaxed_profile_waives_r001_r004_in_tests_only():
+    src = (
+        "import jax\n"
+        "import time\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x * time.time()\n"
+        "def hot(x):\n"
+        "    return jax.jit(lambda a: a + 1)(x)\n")
+    as_pkg = _rules_of(engine.analyze_source(src, "h2o3_tpu/fix_k.py"))
+    assert {"R001", "R004"} <= as_pkg
+    as_test = _rules_of(engine.analyze_source(src, "tests/fix_k.py"))
+    assert not ({"R001", "R004"} & as_test)
+
+
+def test_relaxed_profile_keeps_concurrency_rules_in_tests():
+    src = (
+        "import threading\n"
+        "def leak():\n"
+        "    threading.Thread(target=print).start()\n")
+    assert "R010" in _rules_of(
+        engine.analyze_source(src, "tests/fix_l.py"))
+
+
+# ---------------------------------------------------------------------------
+# the package + tests gate and the acceptance CLI
+def test_package_and_tests_clean_under_concurrency_rules():
+    findings = engine.run(paths=[engine.package_root(),
+                                 engine.tests_root()],
+                          rules=["R007", "R008", "R009", "R010"])
+    bad = engine.unsuppressed(findings)
+    assert not bad, "\n".join(str(f) for f in bad)
+
+
+def test_cli_concurrency_rules_exit_zero_on_package():
+    out = subprocess.run(
+        [sys.executable, "-m", "h2o3_tpu.analysis",
+         "--rules", "R007,R008,R009,R010", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert json.loads(out.stdout)["unsuppressed"] == 0
+
+
+def test_cli_check_census_fresh():
+    out = subprocess.run(
+        [sys.executable, "-m", "h2o3_tpu.analysis", "--check-census"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr

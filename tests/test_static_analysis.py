@@ -211,9 +211,15 @@ def test_baseline_grandfathers_by_fingerprint(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# 2. the package gate (tier-1): zero unsuppressed findings
-def test_package_has_zero_unsuppressed_findings():
-    findings = engine.run(baseline_path=BASELINE)
+# 2. the package + tests gate (tier-1): zero unsuppressed findings.
+# tests/ rides along under the relaxed profile (R001/R004 waived — test
+# code jits lambdas and calls time() on purpose; every other rule,
+# including the R007-R010 concurrency pass, applies in full: a racy
+# harness or leaked test thread flakes the suite like any product bug).
+def test_package_and_tests_have_zero_unsuppressed_findings():
+    findings = engine.run(paths=[engine.package_root(),
+                                 engine.tests_root()],
+                          baseline_path=BASELINE)
     bad = engine.unsuppressed(findings)
     assert not bad, (
         "static analysis found new defects (fix them, or suppress with "
@@ -304,7 +310,27 @@ def test_debug_nans_scoped_toggle():
 def test_install_from_env_is_gated(monkeypatch):
     monkeypatch.delenv("H2O3_DEBUG_NANS", raising=False)
     monkeypatch.delenv("H2O3_TRANSFER_GUARD", raising=False)
+    monkeypatch.delenv("H2O3_LOCKDEP", raising=False)
     assert sanitizers.install_from_env() == {}
+    # explicit "off" spellings must DISABLE, not fall through to raise
+    from h2o3_tpu.analysis import lockdep
+    for off in ("0", "false", "off", "no"):
+        monkeypatch.setenv("H2O3_LOCKDEP", off)
+        assert sanitizers.install_from_env() == {}, off
+        assert lockdep._mode_from_env(off) == ""
+
+
+def test_install_from_env_enables_lockdep(monkeypatch):
+    from h2o3_tpu.analysis import lockdep
+    monkeypatch.setenv("H2O3_DEBUG_NANS", "")
+    monkeypatch.setenv("H2O3_TRANSFER_GUARD", "")
+    monkeypatch.setenv("H2O3_LOCKDEP", "log")
+    try:
+        out = sanitizers.install_from_env()
+        assert out.get("lockdep") == "log"
+        assert lockdep.enabled()
+    finally:
+        lockdep.disable()
 
 
 # ---------------------------------------------------------------------------
