@@ -139,13 +139,18 @@ def scoring_bench() -> dict:
     compiled-scorer cache (h2o3_tpu/serving) scoring a GBM at a
     serving-sized bucketed batch. The first call compiles the one resident
     program; the timed loop re-stages + dispatches it with zero compiles —
-    what a steady-state /3/Predictions stream sees."""
+    what a steady-state /3/Predictions stream sees. Timed twice — without
+    and WITH an active trace id (what a real REST request carries) — and
+    the headline number is the traced run, so the reported throughput is
+    what production serving actually sees; the delta is
+    tracing_overhead_pct."""
     import numpy as np
     from h2o3_tpu.core.frame import Frame
     from h2o3_tpu.core.kvstore import DKV
     from h2o3_tpu.models import ESTIMATORS
     from h2o3_tpu import serving
     from h2o3_tpu.obs import metrics as om
+    from h2o3_tpu.obs import tracing
 
     rng = np.random.default_rng(3)
     ntr, batch, iters = 20_000, 4096, 25
@@ -161,19 +166,39 @@ def scoring_bench() -> dict:
     for _ in range(2):                     # warm: compile + settle
         serving.score_frame(m, sf)
     c0 = om.xla_compile_count()
-    t0 = time.time()
-    for _ in range(iters):
-        out = serving.score_frame(m, sf)
-    dt = time.time() - t0
+
+    def timed_loop():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = serving.score_frame(m, sf)
+        return time.perf_counter() - t0, r
+
+    # alternating best-of-3 per mode: one span per iteration costs
+    # microseconds, so a naive single pair of loops measures scheduler
+    # jitter, not tracing — min-of-N against interleaved runs cancels it
+    prev_trace = tracing.set_current(None)
+    dt_off = dt_on = float("inf")
+    out = None
+    for _ in range(3):
+        tracing.set_current(None)                    # tracing off
+        dt, out = timed_loop()
+        dt_off = min(dt_off, dt)
+        tracing.set_current(tracing.new_trace_id())  # traced, like REST
+        dt, out = timed_loop()
+        dt_on = min(dt_on, dt)
+    tracing.set_current(prev_trace)
     assert out is not None and len(out) >= batch
     warm_compiles = om.xla_compile_count() - c0
-    rows_per_sec = batch * iters / dt
+    rows_per_sec = batch * iters / dt_on
+    overhead_pct = 100.0 * (dt_on - dt_off) / dt_off
     om.REGISTRY.gauge("h2o3_bench_scoring_rows_per_sec",
                       "warm-cache bucketed serving throughput"
                       ).set(rows_per_sec)
     for k in (fr.key, sf.key, m.key):
         DKV.remove(k)
     return {"rows_per_sec": round(rows_per_sec),
+            "rows_per_sec_untraced": round(batch * iters / dt_off),
+            "tracing_overhead_pct": round(overhead_pct, 2),
             "batch_rows": batch, "iters": iters,
             "bucket": serving.row_bucket(batch),
             "warm_compiles": int(warm_compiles)}
@@ -196,6 +221,13 @@ def main():
 
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    # the bench run carries its OWN trace id: every span it opens (tree
+    # levels, parse stages, scoring dispatches) is fetchable afterward via
+    # GET /3/Trace/{id} on a server scraping this process
+    from h2o3_tpu.obs import tracing as _tracing
+    bench_trace = _tracing.new_trace_id()
+    _tracing.set_current(bench_trace)
 
     from h2o3_tpu.models.tree import binned as BN
 
@@ -395,6 +427,8 @@ def main():
         "hbm_frac": round(g.value(stat="hbm_frac"), 4),
         "radix_shallow": bool(HP.radix_supported()),
         "scoring_rows_per_sec": (scoring or {}).get("rows_per_sec"),
+        "tracing_overhead_pct": (scoring or {}).get("tracing_overhead_pct"),
+        "trace_id": bench_trace,
         "paths": paths,
         "ingest": ingest,
         "scoring": scoring,

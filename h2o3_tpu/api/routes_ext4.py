@@ -239,7 +239,9 @@ def _h_nps_put_auto(h, categ):
 # Diagnostics: Profiler, WaterMeterIo
 def _h_profiler(h):
     """GET /3/Profiler (water/util/JProfile): stack samples aggregated
-    across this runtime's threads — the py analog of the JVM profile."""
+    across this runtime's threads — the py analog of the JVM profile.
+    Also reports the on-demand session state (obs/profiler, driven by
+    POST /3/Profiler): active/kind/dir ride alongside nodes[]."""
     p = h._params()
     depth = int(p.get("depth") or 10)
     import traceback
@@ -253,7 +255,9 @@ def _h_profiler(h):
     nodes = [{"node_name": "this", "entries": [
         {"stacktrace": k, "count": v}
         for k, v in sorted(counts.items(), key=lambda kv: -kv[1])[:25]]}]
-    h._send({"__meta": {"schema_type": "ProfilerV3"}, "nodes": nodes})
+    from h2o3_tpu.obs import profiler as _prof
+    h._send({"__meta": {"schema_type": "ProfilerV3"}, "nodes": nodes,
+             **_prof.PROFILER.status()})
 
 
 def _h_watermeter_io(h, node=None):

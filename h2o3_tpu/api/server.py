@@ -16,6 +16,7 @@ reference.
 from __future__ import annotations
 
 import json
+import os as _os_mod
 import re
 import threading
 import time as _time_mod
@@ -30,6 +31,8 @@ from h2o3_tpu.core.jobs import Job, jobs_list
 from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.io import parser as io_parser
 from h2o3_tpu.obs import metrics as _obs_metrics
+from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.rapids import rapids_exec, Session
 
 # per-request REST latency, labeled by ROUTE PATTERN (bounded cardinality),
@@ -64,6 +67,15 @@ class _Handler(BaseHTTPRequestHandler):
         # remember the status for the request-latency histogram labels
         self._status = code
         super().send_response(code, message)
+
+    def end_headers(self):
+        # echo the request's trace id on EVERY response path (JSON,
+        # errors, auth challenges, byte downloads) — the client-side
+        # handle for GET /3/Trace/{id}
+        tid = getattr(self, "_trace_id", None)
+        if tid:
+            self.send_header("X-H2O3-Trace-Id", tid)
+        super().end_headers()
 
     # ---- security (water/H2OSecurityManager.java + webserver auth) ------
     def _check_auth(self) -> bool:
@@ -166,9 +178,25 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = _time_mod.perf_counter()
         self._status = 0
         self._route_label = "unmatched"
+        # distributed tracing: honor the caller's X-H2O3-Trace-Id, mint
+        # one otherwise; current for the whole dispatch so every span the
+        # request opens (and every job/broadcast it starts) carries it
+        tid = None
+        if _tracing.enabled():
+            tid = _tracing.sanitize(self.headers.get("X-H2O3-Trace-Id")) \
+                or _tracing.new_trace_id()
+        self._trace_id = tid
+        prev_trace = _tracing.set_current(tid)
         try:
-            self._route_inner(method)
+            if tid is not None:
+                with _span("rest.request", method=method) as sp:
+                    self._route_inner(method)
+                    sp.attrs["route"] = self._route_label
+                    sp.attrs["status"] = self._status or 0
+            else:
+                self._route_inner(method)
         finally:
+            _tracing.set_current(prev_trace)
             REQUEST_SECONDS.observe(
                 _time_mod.perf_counter() - t0,
                 route=self._route_label, method=method,
@@ -187,15 +215,22 @@ class _Handler(BaseHTTPRequestHandler):
         # multi-controller runtime those launches must be collective too;
         # replaying an idempotent GET is free, deadlocking the cloud isn't.
         bc = getattr(self.server, "broadcaster", None)
-        if bc is not None and not _is_static_path(path) \
-                and not _is_obs_path(path) \
-                and not path.startswith("/3/PostFile"):
-            # PostFile is excluded: its body is raw (often binary) bytes
-            # that neither parse as params nor replay through the channel
-            params = self._params()
-            self._cached_params = params
-            bc.broadcast(method, path, params)
         try:
+            if bc is not None and not _is_static_path(path) \
+                    and not _is_obs_path(path) \
+                    and not path.startswith("/3/PostFile"):
+                # PostFile is excluded: its body is raw (often binary)
+                # bytes that neither parse as params nor replay through
+                # the channel. Inside the try: a wedged replay channel
+                # (broadcast RuntimeError after the ack deadline) must
+                # answer a 500 H2OError, not drop the connection.
+                params = self._params()
+                self._cached_params = params
+                # the trace id rides the replay channel so every worker
+                # tags its replayed spans with the ORIGINATING request's
+                # trace
+                bc.broadcast(method, path, params,
+                             trace=getattr(self, "_trace_id", None))
             for pat, m, fn in ROUTES:
                 if m != method:
                     continue
@@ -217,11 +252,15 @@ def _is_static_path(path: str) -> bool:
 
 def _is_obs_path(path: str) -> bool:
     """Observability endpoints launch no device programs (registry reads +
-    memory_stats are host-local), and /3/Timeline does its own explicit
-    cloud-wide collect — replaying them would put every Prometheus scrape
-    behind the replay barrier."""
-    return path in ("/metrics", "/3/Timeline", "/3/WaterMeter") \
-        or path.startswith("/3/Logs")
+    memory_stats are host-local), and /3/Timeline, /3/Trace and
+    cluster-scope /metrics do their own explicit cloud-wide collects —
+    replaying them would put every Prometheus scrape behind the replay
+    barrier. /3/Profiler is deliberately host-local too: a capture
+    profiles THIS node, and the jax profiler is process-global state the
+    replay barrier must not serialize behind."""
+    return path in ("/metrics", "/3/Timeline", "/3/WaterMeter",
+                    "/3/Profiler") \
+        or path.startswith("/3/Logs") or path.startswith("/3/Trace/")
 
 
 def _json_default(o):
@@ -638,6 +677,15 @@ def _h_logs(h: _Handler, *_):
              "log": "\n".join(_log.recent(500))})
 
 
+def _collect_timeout() -> float:
+    """Per-host deadline for cluster-wide observability collects
+    (timeline/trace/metrics). The ISSUE-4 discipline: every wait the
+    coordinator performs while holding the broadcast lock is bounded —
+    a stalled worker costs one deadline, never a frozen scrape."""
+    return float(_os_mod.environ.get("H2O3_OBS_COLLECT_TIMEOUT_S", "2")
+                 or 2)
+
+
 def _h_timeline(h: _Handler):
     """GET /3/Timeline — the TimelineSnapshot analog: this host's span
     ring plus every worker's, collected through the multihost replay
@@ -650,7 +698,8 @@ def _h_timeline(h: _Handler):
     if bc is not None:
         # one flat merged list; hosts[] summarizes who answered (a None
         # entry is a worker that outwaited the collect timeout)
-        for i, remote in enumerate(bc.collect("timeline")):
+        for i, remote in enumerate(bc.collect("timeline",
+                                              timeout=_collect_timeout())):
             if isinstance(remote, dict):
                 rs = remote.get("spans", [])
                 spans.extend(rs)
@@ -671,11 +720,66 @@ def _h_timeline(h: _Handler):
              "events": events[-512:]})
 
 
+def _h_trace(h: _Handler, tid):
+    """GET /3/Trace/{id} — the Dapper-style stitched view of one request:
+    this host's spans for the trace plus every worker's (spans a replayed
+    request recorded remotely carry the originating trace id), merged and
+    time-sorted. Bounded by the same collect deadline as /3/Timeline."""
+    from h2o3_tpu.obs import timeline as _obs_tl
+    spans = _obs_tl.SPANS.trace_snapshot(tid)
+    hosts = [{"host": _obs_tl.host_id(), "n_spans": len(spans)}]
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        for i, remote in enumerate(bc.collect(f"trace:{tid}",
+                                              timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                rs = remote.get("spans", [])
+                spans.extend(rs)
+                hosts.append({"host": remote.get("host", i + 1),
+                              "n_spans": len(rs)})
+            else:
+                hosts.append({"host": i + 1, "n_spans": None,
+                              "lagging": True})
+    spans.sort(key=lambda s: s.get("start") or 0.0)
+    h._send({"__meta": {"schema_type": "TraceV3"},
+             "trace_id": tid, "spans": spans, "hosts": hosts,
+             "n_spans": len(spans)})
+
+
+def _cluster_metric_snapshots(h: _Handler):
+    """[(host, registry-snapshot)] for every answering host, local first.
+    A lagging worker is absorbed within the collect deadline: its slot is
+    skipped, counted in h2o3_cluster_scrape_timeouts_total and reported
+    in the second return value."""
+    from h2o3_tpu.obs import metrics as _obs_m
+    from h2o3_tpu.obs import timeline as _obs_tl
+    snaps = [(_obs_tl.host_id(), _obs_m.REGISTRY.to_dict())]
+    lagging = []
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        for i, remote in enumerate(bc.collect("metrics",
+                                              timeout=_collect_timeout())):
+            if isinstance(remote, dict) \
+                    and isinstance(remote.get("metrics"), dict):
+                snaps.append((remote.get("host", i + 1), remote["metrics"]))
+            else:
+                _obs_m.CLUSTER_SCRAPE_TIMEOUTS.inc()
+                lagging.append(i + 1)
+    return snaps, lagging
+
+
 def _h_metrics(h: _Handler):
-    """GET /metrics — Prometheus text exposition of the process registry."""
+    """GET /metrics — Prometheus text exposition of the process registry.
+    `?scope=cluster` federates: every host's snapshot is collected over
+    the replay channel and merged under a per-host host= label (counters/
+    histograms stay summable; gauges stay per-host)."""
     from h2o3_tpu.obs import metrics as _obs_m
     _obs_m.install_runtime_gauges()
-    body = _obs_m.REGISTRY.prometheus_text().encode()
+    if h._params().get("scope") == "cluster":
+        snaps, _ = _cluster_metric_snapshots(h)
+        body = _obs_m.cluster_prometheus_text(snaps).encode()
+    else:
+        body = _obs_m.REGISTRY.prometheus_text().encode()
     h.send_response(200)
     h.send_header("Content-Type",
                   "text/plain; version=0.0.4; charset=utf-8")
@@ -687,11 +791,51 @@ def _h_metrics(h: _Handler):
 
 def _h_watermeter(h: _Handler):
     """GET /3/WaterMeter — the registry as JSON (WaterMeterCpuTicks/
-    WaterMeterIo's REST shape, generalized to the whole registry)."""
+    WaterMeterIo's REST shape, generalized to the whole registry).
+    `?cluster=1` answers for the whole cloud: per-host snapshots merged
+    with host= labels, lagging hosts listed instead of waited on."""
     from h2o3_tpu.obs import metrics as _obs_m
     _obs_m.install_runtime_gauges()
+    p = h._params()
+    if str(p.get("cluster", "")).lower() in ("1", "true", "yes"):
+        snaps, lagging = _cluster_metric_snapshots(h)
+        h._send({"__meta": {"schema_type": "WaterMeterV3"},
+                 "metrics": _obs_m.merge_cluster_snapshots(snaps),
+                 "hosts": [hst for hst, _ in snaps],
+                 "lagging_hosts": lagging})
+        return
     h._send({"__meta": {"schema_type": "WaterMeterV3"},
              "metrics": _obs_m.REGISTRY.to_dict()})
+
+
+def _h_profiler(h: _Handler):
+    """POST /3/Profiler — on-demand profiling (ProfilerHandler analog):
+    action=start [kind=auto|jax|sampling] [trace_dir=...] starts a
+    capture (jax.profiler device trace, or the pure-Python sampling
+    fallback when unavailable); action=stop ends it and returns the
+    artifact dir. One session at a time — a concurrent start answers
+    409."""
+    from h2o3_tpu.obs import profiler as _prof
+    p = h._params()
+    action = str(p.get("action") or "").lower()
+    try:
+        if action == "start":
+            out = _prof.PROFILER.start(trace_dir=p.get("trace_dir") or None,
+                                       kind=str(p.get("kind") or "auto"))
+        elif action == "stop":
+            out = _prof.PROFILER.stop()
+        else:
+            return h._error("action must be start|stop", 400)
+    except _prof.ProfilerBusy as ex:
+        return h._error(str(ex), 409)
+    except (_prof.ProfilerIdle, ValueError) as ex:
+        return h._error(str(ex), 400)
+    h._send({"__meta": {"schema_type": "ProfilerV3"}, **out})
+
+
+# (GET /3/Profiler lives in routes_ext4: the legacy JProfile one-shot
+# stack sample, now merged with PROFILER.status() so the same GET reports
+# whether an on-demand session is running.)
 
 
 def _h_metadata_endpoints(h: _Handler):
@@ -742,8 +886,10 @@ ROUTES = [
     (re.compile(r"/3/Logs/download"), "GET", _h_logs),
     (re.compile(r"/3/Logs/nodes/([^/]+)/files/([^/]+)"), "GET", _h_logs),
     (re.compile(r"/3/Timeline"), "GET", _h_timeline),
+    (re.compile(r"/3/Trace/([^/]+)"), "GET", _h_trace),
     (re.compile(r"/metrics"), "GET", _h_metrics),
     (re.compile(r"/3/WaterMeter"), "GET", _h_watermeter),
+    (re.compile(r"/3/Profiler"), "POST", _h_profiler),
     (re.compile(r"/3/Metadata/endpoints"), "GET", _h_metadata_endpoints),
     (re.compile(r"/3/InitID"), "GET", _h_init_session),
     (re.compile(r"/3/InitID"), "DELETE", _h_end_session),

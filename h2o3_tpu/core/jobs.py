@@ -63,12 +63,19 @@ class Job:
         """Run `work(job)`; its return value is DKV-put under self.dest."""
         self.status = RUNNING
         self.start_time = time.time()
+        # jobs inherit the starting thread's trace (the REST request that
+        # launched the build), so job.run/job.<phase> spans stitch into
+        # GET /3/Trace/{id} even though the work runs on its own thread
+        from h2o3_tpu.obs import tracing as _tracing
+        parent_trace = _tracing.current()
 
         def _run():
+            from h2o3_tpu.obs import tracing as _tr
             from h2o3_tpu.obs.timeline import span
             try:
-                with span("job.run", job=self.key,
-                          description=self.description):
+                with _tr.trace(parent_trace), \
+                        span("job.run", job=self.key,
+                             description=self.description):
                     result = work(self)
                 if result is not None and self.dest:
                     DKV.put(self.dest, result)

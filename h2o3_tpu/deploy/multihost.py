@@ -360,7 +360,7 @@ class Broadcaster:
             f"{_ack_timeout():g}s during {what} — SPMD replay is wedged "
             "(H2O3_REPLAY_ACK_TIMEOUT_S bounds this wait)")
 
-    def broadcast(self, method: str, path: str, params: dict):
+    def broadcast(self, method: str, path: str, params: dict, trace=None):
         import socket as _socket
         import time as _time
         with self._lock:
@@ -368,6 +368,10 @@ class Broadcaster:
             deadline = _time.monotonic() + _ack_timeout()
             msg = {"seq": self._seq, "method": method, "path": path,
                    "params": params}
+            if trace:
+                # originating request's trace id: workers replay under it
+                # so their spans stitch into GET /3/Trace/{id}
+                msg["trace"] = trace
             try:
                 for i, (c, key) in enumerate(self._conns):
                     self._drain_owed(i, deadline)
@@ -480,6 +484,12 @@ def _collect_local(op: str):
             from h2o3_tpu.obs import timeline as _tl
             return {"host": _tl.host_id(),
                     "metrics": _m.REGISTRY.to_dict()}
+        if op.startswith("trace:"):
+            # GET /3/Trace/{id} stitching: this host's spans for ONE trace
+            from h2o3_tpu.obs import timeline as _tl
+            return {"host": _tl.host_id(),
+                    "spans": _tl.SPANS.trace_snapshot(op[len("trace:"):],
+                                                      limit=512)}
     except Exception:   # noqa: BLE001 — a worker probe error must not kill the loop
         import traceback
         traceback.print_exc()
@@ -529,7 +539,17 @@ def worker_loop(coordinator_host: str, port: int):
             continue
         _send_frame(sock, key, {"ack": msg["seq"]})  # ack, then execute
         try:
-            replay_request(msg["method"], msg["path"], msg["params"])
+            # replay under the ORIGINATING request's trace id (when the
+            # coordinator attached one): every span this replay opens —
+            # mrtask map/reduce phases, job phases, host fetches — tags
+            # itself with it, so GET /3/Trace/{id} on process 0 stitches
+            # this host's fragment in
+            from h2o3_tpu.obs import tracing as _tr
+            from h2o3_tpu.obs.timeline import span as _span
+            with _tr.trace(msg.get("trace")), \
+                    _span("replay.request", path=msg["path"],
+                          method=msg["method"]):
+                replay_request(msg["method"], msg["path"], msg["params"])
         except Exception:                 # keep replaying; process 0 owns
             import traceback              # error reporting to the client
             traceback.print_exc()

@@ -442,6 +442,12 @@ class ModelBase:
         job.start(work, background=False)
         job.join()
         DKV.put(self.key, self)
+        # optional serving pre-warm on publish (H2O3_SCORER_PREWARM=1):
+        # compile the most common row bucket in the background so the
+        # first real request warm-hits instead of paying the compile
+        from h2o3_tpu import serving
+        if serving.prewarm_enabled():
+            serving.prewarm(self)
         return self
 
     def _resolve_predictors(self, frame, x, y):

@@ -8,16 +8,29 @@ package is the TPU-native rebuild: a process-global metrics registry
 ring of timed spans (GET /3/Timeline, merged across hosts through the
 deploy/multihost replay channel).
 
+Distributed additions (ISSUE 5): `tracing` mints Dapper-style trace ids
+at the REST boundary and threads them through spans, jobs, the
+micro-batcher and the multihost replay channel (`GET /3/Trace/{id}`
+stitches them cloud-wide); `profiler` drives on-demand jax.profiler /
+sampling captures behind `POST /3/Profiler`; the metrics registry gains
+cluster federation (`GET /metrics?scope=cluster` merges every host's
+snapshot under a per-host `host=` label).
+
 Env surface:
   H2O3_OBS_TIMELINE_CAPACITY  span ring size (default 4096)
   H2O3_OBS_TRACE_DIR          xprof bridge: jax.profiler trace output dir
   H2O3_OBS_TRACE_SPAN         span-name prefix that triggers the capture
+  H2O3_TRACING                "0" disables REST trace-id minting
+  H2O3_OBS_COLLECT_TIMEOUT_S  per-host deadline for cluster-wide
+                              timeline/trace/metrics collects (default 2)
+  H2O3_PROFILE_DIR            default artifact dir for /3/Profiler
 """
 
 from h2o3_tpu.obs.metrics import (REGISTRY, Counter, Gauge, Histogram,
                                   MetricsRegistry, counter, gauge, histogram)
 from h2o3_tpu.obs.timeline import SPANS, Span, SpanTimeline, span
+from h2o3_tpu.obs import tracing
 
 __all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "counter", "gauge", "histogram",
-           "SPANS", "Span", "SpanTimeline", "span"]
+           "SPANS", "Span", "SpanTimeline", "span", "tracing"]

@@ -118,6 +118,10 @@ class Gauge(_Metric):
             try:
                 out = self._fn()
             except Exception:   # noqa: BLE001 — a dead probe must not 500 /metrics
+                # the scrape stays alive (this gauge just emits no
+                # series), but the failure is COUNTED — a silently dead
+                # probe looks exactly like a healthy zero otherwise
+                _note_collect_error(self.name)
                 return []
             if isinstance(out, (int, float)):
                 return [((), float(out))]
@@ -278,6 +282,18 @@ class MetricsRegistry:
 
 REGISTRY = MetricsRegistry()
 
+COLLECT_ERRORS = REGISTRY.counter(
+    "h2o3_metric_collect_errors_total",
+    "gauge callback exceptions swallowed during a scrape (the scrape "
+    "stays alive; the failing gauge emits no series)")
+
+
+def _note_collect_error(gauge_name: str):
+    """Count a gauge callback exception (Gauge._collect swallowed it so
+    the scrape survives). A function, not an inline emit: Gauge is
+    defined before the module-level REGISTRY/COLLECT_ERRORS exist."""
+    COLLECT_ERRORS.inc(metric=gauge_name)
+
 
 def counter(name: str, help: str = "") -> Counter:
     return REGISTRY.counter(name, help)
@@ -289,6 +305,81 @@ def gauge(name: str, help: str = "", fn: Optional[Callable] = None) -> Gauge:
 
 def histogram(name: str, help: str = "", buckets=None) -> Histogram:
     return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+# ---------------------------------------------------------------------------
+# Cluster metrics federation (ISSUE 5). Workers ship REGISTRY.to_dict()
+# snapshots over the replay channel (deploy/multihost._collect_local); the
+# coordinator merges them here with a per-host `host=` label. Counters and
+# histograms stay summable downstream (Prometheus `sum without (host)`);
+# gauges stay per-host by construction — HBM on host 2 is not HBM on
+# host 0. A host that outwaits the collect deadline is simply absent from
+# the merge, counted in h2o3_cluster_scrape_timeouts_total by the caller.
+CLUSTER_SCRAPE_TIMEOUTS = REGISTRY.counter(
+    "h2o3_cluster_scrape_timeouts_total",
+    "hosts absent from a cluster-scope metrics scrape — they outwaited "
+    "the collect deadline (H2O3_OBS_COLLECT_TIMEOUT_S) or answered with "
+    "an error; their series are missing from that merge")
+
+
+def merge_cluster_snapshots(snapshots: list) -> dict:
+    """[(host, REGISTRY.to_dict()-shaped dict)] → one merged dict of the
+    same shape, every series labeled host=<id>. Kind/help come from the
+    first host that declares the metric (hosts run the same code, so
+    drift here would be a deploy skew, not a merge concern)."""
+    merged: dict = {}
+    for host, snap in snapshots:
+        for name, m in (snap or {}).items():
+            dst = merged.setdefault(name, {"kind": m.get("kind", "gauge"),
+                                           "help": m.get("help", ""),
+                                           "series": []})
+            for s in m.get("series") or []:
+                s2 = dict(s)
+                s2["labels"] = dict(s.get("labels") or {}, host=str(host))
+                dst["series"].append(s2)
+    return merged
+
+
+def _render_series(name: str, kind: str, series: list) -> list:
+    """Exposition lines for one metric's merged JSON series (the
+    registry's _expose over live objects, re-done over snapshots that
+    crossed the wire as JSON)."""
+    lines = []
+    for s in series:
+        key = _label_key(s.get("labels") or {})
+        if kind == "histogram":
+            buckets = s.get("buckets") or {}
+            cum = 0
+            for ub, c in buckets.items():
+                if ub == "+Inf":
+                    continue
+                cum += int(c)
+                lines.append(f"{name}_bucket"
+                             f"{_fmt_labels(key, (('le', ub),))} {cum}")
+            cum += int(buckets.get("+Inf", 0))
+            lines.append(f"{name}_bucket"
+                         f"{_fmt_labels(key, (('le', '+Inf'),))} {cum}")
+            lines.append(f"{name}_sum{_fmt_labels(key)}"
+                         f" {_fmt_num(s.get('sum', 0.0))}")
+            lines.append(f"{name}_count{_fmt_labels(key)}"
+                         f" {int(s.get('count', 0))}")
+        else:
+            lines.append(f"{name}{_fmt_labels(key)}"
+                         f" {_fmt_num(s.get('value', 0.0))}")
+    return lines
+
+
+def cluster_prometheus_text(snapshots: list) -> str:
+    """Text exposition 0.0.4 of the merged cluster view (the
+    GET /metrics?scope=cluster body)."""
+    merged = merge_cluster_snapshots(snapshots)
+    out = []
+    for name in sorted(merged):
+        m = merged[name]
+        out.append(f"# HELP {name} {_escape(m['help'])}")
+        out.append(f"# TYPE {name} {m['kind']}")
+        out.extend(_render_series(name, m["kind"], m["series"]))
+    return "\n".join(out) + "\n"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,170 @@
+"""On-demand profiling — POST /3/Profiler start/stop.
+
+The reference exposes /3/Profiler (water/api/ProfilerHandler.java): every
+node stack-samples itself and ships the hot stacks back over REST. The
+TPU-native rebuild drives `jax.profiler.start_trace`/`stop_trace`, which
+captures device traces (XLA ops, HLO, host callbacks) into a TensorBoard-
+readable artifact dir. When the JAX profiler is unavailable (no backend,
+already-active capture, stripped build), a pure-Python sampling profiler
+stands in: a daemon thread samples every live thread's stack via
+`sys._current_frames()` and writes a flamegraph-ready collapsed-stack
+file — the ProfilerHandler behavior, minus the JVM.
+
+At most ONE session runs at a time (the jax profiler is process-global
+and two overlapping captures corrupt both); a second start answers 409.
+
+Env surface:
+  H2O3_PROFILE_DIR  default artifact directory (else a fresh tempdir)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from h2o3_tpu.analysis.lockdep import make_lock
+from h2o3_tpu.obs import metrics as _om
+
+SESSIONS = _om.counter(
+    "h2o3_profiler_sessions_total",
+    "profiler sessions started via /3/Profiler, labeled by kind "
+    "(jax = device trace, sampling = pure-Python stack sampler)")
+
+
+class ProfilerBusy(RuntimeError):
+    """A session is already running — the jax profiler is process-global,
+    so concurrent captures are refused (HTTP 409)."""
+
+
+class ProfilerIdle(RuntimeError):
+    """stop() without a running session (HTTP 400)."""
+
+
+class _SamplingProfiler:
+    """Stack sampler: every `interval_s`, collapse each live thread's
+    frame stack to "file:func;file:func;..." and count it. stop() writes
+    the counts in flamegraph collapsed-stack format."""
+
+    def __init__(self, interval_s: float = 0.01, max_depth: int = 64):
+        self.interval_s = interval_s
+        self.max_depth = max_depth
+        self.samples: dict = {}
+        self.n_samples = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="h2o3-pyprof")
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval_s):
+            for tid, frame in list(sys._current_frames().items()):
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < self.max_depth:
+                    code = f.f_code
+                    fname = code.co_filename.rsplit("/", 1)[-1]
+                    stack.append(f"{fname}:{code.co_name}")
+                    f = f.f_back
+                key = ";".join(reversed(stack))
+                self.samples[key] = self.samples.get(key, 0) + 1
+            self.n_samples += 1
+
+    def stop(self, out_dir: str) -> str:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        # snapshot: if a huge sampling pass outlives the bounded join,
+        # the thread may still be inserting — iterate a copy, never the
+        # live dict
+        samples = dict(self.samples)
+        path = os.path.join(out_dir, "pyprof.collapsed")
+        with open(path, "w") as fh:
+            for stack, cnt in sorted(samples.items(),
+                                     key=lambda kv: -kv[1]):
+                fh.write(f"{stack} {cnt}\n")
+        return path
+
+
+class ProfilerManager:
+    """One-session-at-a-time gate around the two capture backends."""
+
+    def __init__(self):
+        self._lock = make_lock("profiler")
+        self._active: dict | None = None
+
+    def _artifact_dir(self, trace_dir) -> str:
+        d = trace_dir or os.environ.get("H2O3_PROFILE_DIR")
+        if not d:
+            import tempfile
+            d = tempfile.mkdtemp(prefix="h2o3-profile-")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def start(self, trace_dir=None, kind: str = "auto") -> dict:
+        """Start a capture. kind: "auto" (jax, falling back to sampling),
+        "jax" (fail if unavailable), "sampling" (force the fallback)."""
+        if kind not in ("auto", "jax", "sampling"):
+            raise ValueError(f"profiler kind {kind!r} "
+                             "(want auto|jax|sampling)")
+        with self._lock:
+            if self._active is not None:
+                raise ProfilerBusy(
+                    f"a {self._active['kind']} profiler session is already "
+                    f"running (dir {self._active['dir']}) — stop it first")
+            d = self._artifact_dir(trace_dir)
+            used = None
+            if kind in ("auto", "jax"):
+                try:
+                    import jax
+                    jax.profiler.start_trace(d)
+                    used = "jax"
+                except Exception:   # noqa: BLE001 — fall back to sampling
+                    if kind == "jax":
+                        raise
+            sampler = None
+            if used is None:
+                sampler = _SamplingProfiler()
+                sampler.start()
+                used = "sampling"
+            self._active = {"kind": used, "dir": d, "sampler": sampler,
+                            "t_start": time.time()}
+            SESSIONS.inc(kind=used)
+            return {"status": "started", "kind": used, "dir": d}
+
+    def stop(self) -> dict:
+        with self._lock:
+            if self._active is None:
+                raise ProfilerIdle("no profiler session is running")
+            sess = self._active
+            self._active = None
+            out = {"status": "stopped", "kind": sess["kind"],
+                   "dir": sess["dir"],
+                   "seconds": round(time.time() - sess["t_start"], 3)}
+            if sess["kind"] == "jax":
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception as ex:   # noqa: BLE001 — report, don't 500
+                    out["error"] = repr(ex)
+            else:
+                out["artifact"] = sess["sampler"].stop(sess["dir"])
+                out["samples"] = sess["sampler"].n_samples
+            return out
+
+    def status(self) -> dict:
+        with self._lock:
+            if self._active is None:
+                return {"active": False}
+            return {"active": True, "kind": self._active["kind"],
+                    "dir": self._active["dir"],
+                    "seconds": round(time.time()
+                                     - self._active["t_start"], 3)}
+
+
+PROFILER = ProfilerManager()

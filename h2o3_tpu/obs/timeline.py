@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from collections import deque
 
 from h2o3_tpu.analysis.lockdep import make_lock
+from h2o3_tpu.obs import tracing as _tracing
 
 
 def host_id() -> int:
@@ -49,6 +50,8 @@ class Span:
     t_end: float | None = None
     host: int = 0
     attrs: dict = field(default_factory=dict)
+    # originating request's trace id (obs/tracing), None when untraced
+    trace: str | None = None
 
     @property
     def duration_ms(self) -> float | None:
@@ -60,7 +63,8 @@ class Span:
         return {"name": self.name, "id": self.span_id,
                 "parent": self.parent_id, "host": self.host,
                 "start": self.t_start, "end": self.t_end,
-                "duration_ms": self.duration_ms, "attrs": self.attrs}
+                "duration_ms": self.duration_ms, "attrs": self.attrs,
+                "trace": self.trace}
 
 
 class SpanTimeline:
@@ -88,7 +92,8 @@ class SpanTimeline:
         sp = Span(name=name, t_start=time.time(),
                   span_id=next(self._ids),
                   parent_id=st[-1].span_id if st else 0,
-                  host=host_id(), attrs=attrs)
+                  host=host_id(), attrs=attrs,
+                  trace=_tracing.current())
         st.append(sp)
         return sp
 
@@ -115,6 +120,19 @@ class SpanTimeline:
         if limit and len(spans) > limit:
             spans = spans[-limit:]
         return [s.to_dict() for s in spans]
+
+    def trace_snapshot(self, trace_id: str, limit: int = 0) -> list:
+        """Completed spans belonging to one trace: tagged with the id, or
+        LINKING it via attrs["links"] (a coalesced micro-batch dispatch
+        serving N parent traces records every parent there)."""
+        with self._lock:
+            spans = list(self._ring)
+        out = [s for s in spans
+               if s.trace == trace_id
+               or trace_id in (s.attrs.get("links") or ())]
+        if limit and len(out) > limit:
+            out = out[-limit:]
+        return [s.to_dict() for s in out]
 
     def clear(self):
         with self._lock:

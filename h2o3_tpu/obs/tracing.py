@@ -1,0 +1,73 @@
+"""Distributed request tracing — Dapper-style trace ids for the cloud.
+
+A trace id is minted at the REST boundary (`X-H2O3-Trace-Id` request
+header, auto-generated when absent) and carried in a per-thread context:
+every `timeline.span` opened while a trace is current tags itself with
+the id, jobs inherit the trace of the thread that started them, and the
+deploy/multihost replay channel forwards the id so remote hosts tag their
+replayed spans with the ORIGINATING request's trace. `GET /3/Trace/{id}`
+stitches the fragments back together cloud-wide.
+
+This module is intentionally dependency-free (stdlib only): it is
+imported by the span timeline, the REST layer, the micro-batcher, mrtask
+and bench.py, and must never pull jax or the metrics registry in.
+
+Env surface:
+  H2O3_TRACING  "0" disables trace-id minting at the REST layer (spans
+                still record, untagged). Default on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import secrets
+import threading
+
+_TLS = threading.local()
+
+# ids cross the REST boundary and the replay channel as free text: bound
+# the charset + length so a hostile header can't smuggle exposition-format
+# or JSON structure into merged outputs
+_SAFE_ID = re.compile(r"[0-9a-zA-Z_.\-]{1,64}")
+
+
+def enabled() -> bool:
+    """Trace-id minting at the REST layer (H2O3_TRACING, default on)."""
+    return os.environ.get("H2O3_TRACING", "1") != "0"
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(8)
+
+
+def current():
+    """The calling thread's current trace id, or None."""
+    return getattr(_TLS, "trace_id", None)
+
+
+def set_current(trace_id):
+    """Set the thread's trace id; returns the previous value so callers
+    can restore it (prefer the `trace()` context manager)."""
+    prev = getattr(_TLS, "trace_id", None)
+    _TLS.trace_id = trace_id
+    return prev
+
+
+@contextlib.contextmanager
+def trace(trace_id):
+    """Run a block under `trace_id` (None = explicitly untraced)."""
+    prev = set_current(trace_id)
+    try:
+        yield trace_id
+    finally:
+        set_current(prev)
+
+
+def sanitize(trace_id):
+    """A caller-supplied id, validated — or None when unusable."""
+    if not trace_id:
+        return None
+    tid = str(trace_id).strip()
+    return tid if _SAFE_ID.fullmatch(tid) else None

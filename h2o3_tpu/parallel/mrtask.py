@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.parallel import mesh as _mesh
 
 # ---------------------------------------------------------------------------
@@ -118,6 +120,16 @@ def cached_jit(fn, **jit_kwargs):
     return cur
 
 
+def _traced_dispatch(name: str, jfn, arrays, fn):
+    """Dispatch `jfn(*arrays)`, recording an mrtask phase span when the
+    calling thread is inside an active trace (obs/tracing). Untraced
+    callers — training inner loops, bench — pay a single TLS read."""
+    if _tracing.current() is not None:
+        with _span(name, fn=getattr(fn, "__name__", "<fn>")):
+            return jfn(*arrays)
+    return jfn(*arrays)
+
+
 def map_reduce(fn, *arrays, donate=()):
     """Jit `fn` over row-sharded arrays; outputs get whatever sharding XLA
     propagates (scalars/small reductions come back replicated).
@@ -125,7 +137,7 @@ def map_reduce(fn, *arrays, donate=()):
     `fn` is traced once and cached per shape/dtype signature by jax.jit.
     """
     jfn = cached_jit(fn, donate_argnums=donate)
-    return jfn(*arrays)
+    return _traced_dispatch("mrtask.map_reduce", jfn, arrays, fn)
 
 
 def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False):
@@ -152,7 +164,8 @@ def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False):
                out_specs, check_vma)
         hash(key)
     except (TypeError, ValueError, _Uncacheable):
-        return jax.jit(smapped)(*arrays)   # h2o3-ok: R001 unhashable specs fall back to the uncached legacy path
+        return _traced_dispatch(   # h2o3-ok: R001 unhashable specs fall back to the uncached legacy path
+            "mrtask.map_chunks", jax.jit(smapped), arrays, fn)
     with _JIT_CACHE_LOCK:
         jfn = _JIT_CACHE.get(key)
         if jfn is None:
@@ -160,7 +173,7 @@ def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False):
         _JIT_CACHE.move_to_end(key)
         while len(_JIT_CACHE) > _JIT_CACHE_MAX:
             _JIT_CACHE.popitem(last=False)
-    return jfn(*arrays)
+    return _traced_dispatch("mrtask.map_chunks", jfn, arrays, fn)
 
 
 def shard_sum(x, axis_name=_mesh.ROWS):
@@ -175,10 +188,18 @@ def host_fetch(x) -> "np.ndarray":
     whose shards live on other processes' devices raises; gather it to
     every host first (the MRTask result-collection hop). Single-process
     arrays take the plain fast path."""
+    import contextlib
     import numpy as np
     if isinstance(x, jax.Array) and not x.is_fully_addressable:
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        # the allgather IS the work here (the MRTask result-collection
+        # hop) — a traced request's remote fragment shows it
+        ctx = _span("mrtask.host_fetch",
+                    shape=[int(d) for d in getattr(x, "shape", ())]) \
+            if _tracing.current() is not None else contextlib.nullcontext()
+        with ctx:
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
     return np.asarray(x)
 
 

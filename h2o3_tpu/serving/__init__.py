@@ -15,9 +15,9 @@ from __future__ import annotations
 import numpy as np
 
 from h2o3_tpu.serving.scorer_cache import (     # noqa: F401
-    CACHE, FALLBACKS, Ineligible, model_token, row_bucket, score_frame,
-    score_frame_with_response, score_rows, stage_frame, stage_response,
-    _fastpath_reason)
+    CACHE, FALLBACKS, Ineligible, model_token, prewarm, prewarm_enabled,
+    row_bucket, score_frame, score_frame_with_response, score_rows,
+    stage_frame, stage_response, _fastpath_reason)
 from h2o3_tpu.serving.microbatch import (   # noqa: F401
     BATCHER, MicroBatcher, QueueFull)
 

@@ -15,6 +15,7 @@ large padded batches instead of a stream of tiny ones.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -23,6 +24,8 @@ import numpy as np
 
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.serving import scorer_cache as _sc
 
 REQUESTS = _om.counter("h2o3_score_microbatch_requests_total",
@@ -76,7 +79,7 @@ def _queue_depth_limit() -> int:
 
 
 class _Request:
-    __slots__ = ("raw", "n", "event", "result", "error")
+    __slots__ = ("raw", "n", "event", "result", "error", "trace")
 
     def __init__(self, raw: np.ndarray, n: int):
         self.raw = raw
@@ -84,6 +87,9 @@ class _Request:
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # submitting request's trace id: the coalesced dispatch span
+        # links every parent trace it served
+        self.trace = _tracing.current()
 
 
 class MicroBatcher:
@@ -187,12 +193,23 @@ class MicroBatcher:
             total = sum(r.n for r in batch)
             bucket = _sc.row_bucket(total)
             C = batch[0].raw.shape[1]
-            raw = np.full((bucket, C), np.nan, np.float32)
-            off = 0
-            for r in batch:
-                raw[off:off + r.n] = r.raw
-                off += r.n
-            out = _sc.score_rows(model, raw, total)
+            # one coalesced dispatch serves N parent requests: the span
+            # carries the leader's trace id AND links every follower's,
+            # so each parent's GET /3/Trace/{id} shows this dispatch.
+            # Trace-gated like scorer/mrtask spans: fully untraced
+            # dispatches must not churn the bounded timeline ring
+            links = sorted({r.trace for r in batch if r.trace})
+            ctx = _span("microbatch.dispatch", rows=total,
+                        requests=len(batch), links=links) \
+                if links or _tracing.current() is not None \
+                else contextlib.nullcontext()
+            with ctx:
+                raw = np.full((bucket, C), np.nan, np.float32)
+                off = 0
+                for r in batch:
+                    raw[off:off + r.n] = r.raw
+                    off += r.n
+                out = _sc.score_rows(model, raw, total, links=links)
             DISPATCHES.inc()
             BATCH_ROWS.observe(total)
             off = 0

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import weakref
 from collections import OrderedDict
 
@@ -49,6 +50,8 @@ import numpy as np
 
 from h2o3_tpu.analysis.lockdep import make_lock, make_rlock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.parallel import mesh as _mesh
 from h2o3_tpu.parallel import mrtask as _mrt
 
@@ -189,6 +192,11 @@ class ScorerCache:
                   fn=lambda: float(len(self._entries)))
 
     def program(self, model, bucket: int):
+        return self.program_ex(model, bucket)[0]
+
+    def program_ex(self, model, bucket: int):
+        """(compiled fn, warm_hit) — warm_hit distinguishes the
+        "scorer.warm_hit" vs "scorer.compile" span the dispatch records."""
         di = model._dinfo
         key = (model.key, model_token(model),
                tuple(di.raw_columns()), "float32", bucket)
@@ -197,7 +205,7 @@ class ScorerCache:
             if fn is not None:
                 self._entries.move_to_end(key)
                 HITS.inc()
-                return fn
+                return fn, True
             # per-key build lock: concurrent cold misses for the same
             # program must compile ONCE — the second caller waits for the
             # first instead of paying a duplicate multi-second compile
@@ -211,7 +219,7 @@ class ScorerCache:
                 if fn is not None:
                     self._entries.move_to_end(key)
                     HITS.inc()
-                    return fn
+                    return fn, True
             MISSES.inc()
             try:
                 fn = self._build(model)
@@ -241,7 +249,7 @@ class ScorerCache:
                 while len(self._entries) > _cache_size():
                     self._entries.popitem(last=False)
                     EVICTIONS.inc()
-        return fn
+        return fn, False
 
     @staticmethod
     def _build(model):
@@ -335,12 +343,26 @@ def _fastpath_reason(model, nrows: int):
     return None
 
 
-def score_rows(model, raw: np.ndarray, n: int) -> np.ndarray:
+def score_rows(model, raw: np.ndarray, n: int, links=()) -> np.ndarray:
     """Dispatch a staged (bucket, C) host buffer through the cached
     program. Returns the HOST result still at bucket length (rows beyond n
-    are garbage; callers trim)."""
-    fn = CACHE.program(model, raw.shape[0])
-    out = fn(_mrt.device_put_rows(raw))
+    are garbage; callers trim). `links` are additional trace ids served by
+    this dispatch (micro-batch followers)."""
+    import contextlib
+    fn, warm = CACHE.program_ex(model, raw.shape[0])
+    # compile spans ALWAYS record (rare, expensive, exactly what a trace
+    # viewer needs); warm-hit spans only under an active trace — the
+    # steady-state hot path pays nothing when nobody is looking
+    if not warm or _tracing.current() is not None or links:
+        attrs = {"bucket": raw.shape[0], "rows": n, "model": model.key}
+        if links:
+            attrs["links"] = list(links)
+        ctx = _span("scorer.warm_hit" if warm else "scorer.compile",
+                    **attrs)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        out = fn(_mrt.device_put_rows(raw))
     ROWS_SCORED.inc(n)
     # device_get, not np.asarray: the result fetch is the one intended
     # device→host transfer on this path — keep it explicit so the
@@ -383,6 +405,54 @@ def score_frame(model, frame):
     """Fast-path scoring of a Frame: host result at bucket length, or None
     when the caller must take the legacy sharded path."""
     return _fast_scored(model, frame, with_response=False)
+
+
+# ---------------------------------------------------------------------------
+# Pre-warm on model publish (ROADMAP ISSUE-2 gap). Serving's first request
+# to a fresh model pays the full trace+XLA compile; with
+# H2O3_SCORER_PREWARM=1 the publish path compiles the MOST COMMON serving
+# bucket — the minimum row bucket, where row-payload and small-frame
+# requests land — in the background, so that first request records a
+# warm-hit span instead.
+PREWARMS = _om.counter(
+    "h2o3_scorer_prewarm_total",
+    "background scorer-cache pre-warm compiles completed on model "
+    "publish (H2O3_SCORER_PREWARM=1)")
+
+
+def prewarm_enabled() -> bool:
+    return os.environ.get("H2O3_SCORER_PREWARM", "0") == "1"
+
+
+def prewarm(model, wait: bool = False):
+    """Compile `model`'s minimum-bucket scorer in a background thread.
+    Returns the Thread, or None when the model is fast-path ineligible.
+    Failures are logged, counted as ordinary fallbacks by the first real
+    request, and never break the publish."""
+    if _fastpath_reason(model, 1) is not None:
+        return None
+    bucket = row_bucket(1)
+
+    def _run():
+        try:
+            di = model._dinfo
+            raw = np.zeros((bucket, len(di.raw_columns())), np.float32)
+            fn = CACHE.program(model, bucket)
+            out = fn(_mrt.device_put_rows(raw))
+            jax.block_until_ready(out)   # force the XLA compile NOW
+            PREWARMS.inc()
+        except Exception:   # noqa: BLE001 — prewarm must never break publish
+            import traceback
+            from h2o3_tpu.utils import log as _log
+            _log.warn(f"scorer prewarm failed for {model.key}: "
+                      f"{traceback.format_exc(limit=2)}")
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"scorer-prewarm-{model.key}")
+    t.start()
+    if wait:
+        t.join(timeout=120.0)
+    return t
 
 
 def score_frame_with_response(model, frame):

@@ -133,6 +133,48 @@ def test_two_process_cloud_matches_single(tmp_path):
         cloud = _get(rest, "/3/Cloud")
         pred_multi = _drive_pipeline(rest, csv)
         assert len(pred_multi) == 400
+
+        # ---- ISSUE 5: one trace id spans both hosts of the real cloud.
+        # A scored request on host 0 replays on host 1 under the same
+        # trace; GET /3/Trace/{id} stitches REST + micro-batch/scorer
+        # spans (host 0) with replay + MRTask spans (host 1).
+        tid = "mp-trace-1"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rest}"
+            "/3/Predictions/models/mp_gbm/frames/mp_train",
+            data=urllib.parse.urlencode(
+                {"predictions_frame": "mp_pred_tr"}).encode(),
+            method="POST", headers={"X-H2O3-Trace-Id": tid})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers.get("X-H2O3-Trace-Id") == tid
+            json.loads(r.read())
+        # the worker records its spans when the replay finishes; poll the
+        # stitched view until host 1's fragment lands (bounded)
+        tr = None
+        for _ in range(60):
+            tr = _get(rest, f"/3/Trace/{tid}")
+            if {0, 1} <= {s["host"] for s in tr["spans"]}:
+                break
+            time.sleep(0.5)
+        by_host = {}
+        for s in tr["spans"]:
+            by_host.setdefault(s["host"], []).append(s["name"])
+        assert {0, 1} <= set(by_host), tr["hosts"]
+        assert "rest.request" in by_host[0]
+        assert "replay.request" in by_host[1]
+        assert any(n.startswith("mrtask.") for n in by_host[1]), \
+            f"no MRTask spans from the remote host: {by_host[1]}"
+
+        # ---- cluster metrics federation: one scrape of host 0 carries
+        # every host's series under host= labels
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest}/metrics?scope=cluster",
+                timeout=60) as r:
+            text = r.read().decode()
+        assert 'host="0"' in text and 'host="1"' in text, \
+            "cluster scrape did not merge both hosts"
+        wm = _get(rest, "/3/WaterMeter?cluster=1")
+        assert set(wm["hosts"]) == {0, 1} and wm["lagging_hosts"] == []
     finally:
         for p in procs:
             p.terminate()

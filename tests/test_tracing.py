@@ -1,0 +1,492 @@
+"""ISSUE 5 — distributed tracing, cluster metrics federation, profiling.
+
+Covers: trace-id context + span tagging, REST header mint/echo,
+micro-batch trace links, scorer warm-hit/compile spans, scorer pre-warm
+on publish, gauge collect-error counting, /3/Profiler sessions, the
+cluster-merge renderer, and — through a REAL Broadcaster talking to a
+protocol-faithful fake worker over the replay channel — /3/Trace/{id}
+stitching across ≥2 hosts and a cluster scrape that absorbs a stalled
+host within the deadline."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu import serving
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.deploy import multihost as MH
+from h2o3_tpu.models import ESTIMATORS
+from h2o3_tpu.obs import metrics as om
+from h2o3_tpu.obs import tracing
+from h2o3_tpu.obs.timeline import SPANS, span
+
+RNG = np.random.default_rng(11)
+
+
+# ---------------------------------------------------------------------------
+# tracing context + span tagging
+def test_trace_context_set_restore():
+    assert tracing.current() is None
+    with tracing.trace("tid-outer"):
+        assert tracing.current() == "tid-outer"
+        with tracing.trace("tid-inner"):
+            assert tracing.current() == "tid-inner"
+        assert tracing.current() == "tid-outer"
+    assert tracing.current() is None
+
+
+def test_trace_id_sanitize():
+    assert tracing.sanitize("abc-123.X_") == "abc-123.X_"
+    assert tracing.sanitize("") is None
+    assert tracing.sanitize(None) is None
+    assert tracing.sanitize('x" nasty\n') is None
+    assert tracing.sanitize("a" * 65) is None
+
+
+def test_spans_tagged_and_trace_snapshot_links():
+    tid = tracing.new_trace_id()
+    other = tracing.new_trace_id()
+    with tracing.trace(tid):
+        with span("t.tagged"):
+            pass
+    with span("t.untagged"):
+        pass
+    with span("t.linked", links=[tid, other]):
+        pass
+    got = SPANS.trace_snapshot(tid)
+    names = [s["name"] for s in got]
+    assert "t.tagged" in names and "t.linked" in names
+    assert "t.untagged" not in names
+    assert [s["name"] for s in SPANS.trace_snapshot(other)] == ["t.linked"]
+    tagged = next(s for s in got if s["name"] == "t.tagged")
+    assert tagged["trace"] == tid
+
+
+def test_job_inherits_starting_threads_trace():
+    from h2o3_tpu.core.jobs import Job
+    tid = tracing.new_trace_id()
+    with tracing.trace(tid):
+        job = Job(description="traced job")
+        job.start(lambda j: 42, background=True)
+    job.join(timeout=30)
+    runs = [s for s in SPANS.trace_snapshot(tid) if s["name"] == "job.run"]
+    assert runs and runs[0]["attrs"]["job"] == job.key
+    DKV.remove(job.key)
+
+
+# ---------------------------------------------------------------------------
+# model fixture shared by the serving-path tests
+@pytest.fixture(scope="module")
+def gbm_model():
+    n = 200
+    fr = Frame.from_dict(
+        {"a": RNG.normal(size=n), "b": RNG.normal(size=n),
+         "resp": RNG.choice(["no", "yes"], size=n)})
+    m = ESTIMATORS["gbm"](ntrees=2, max_depth=2, seed=3,
+                          histogram_type="UniformAdaptive")
+    m.train(x=["a", "b"], y="resp", training_frame=fr)
+    yield m
+    DKV.remove(fr.key)
+    DKV.remove(m.key)
+
+
+def test_scorer_compile_then_warm_hit_spans(gbm_model):
+    m = gbm_model
+    rows = [{"a": 0.1, "b": -0.2}, {"a": 1.0, "b": 0.5}]
+    tid1, tid2 = tracing.new_trace_id(), tracing.new_trace_id()
+    with tracing.trace(tid1):
+        serving.score_payload(m, rows)          # cold: compiles the bucket
+    with tracing.trace(tid2):
+        serving.score_payload(m, rows)          # warm: same bucket
+    names1 = [s["name"] for s in SPANS.trace_snapshot(tid1)]
+    names2 = [s["name"] for s in SPANS.trace_snapshot(tid2)]
+    assert "scorer.compile" in names1
+    assert "microbatch.dispatch" in names1
+    assert "scorer.warm_hit" in names2 and "scorer.compile" not in names2
+
+
+def test_microbatch_dispatch_links_all_parent_traces(gbm_model, monkeypatch):
+    m = gbm_model
+    serving.score_payload(m, [{"a": 0.0, "b": 0.0}])   # warm the bucket
+    monkeypatch.setenv("H2O3_SCORE_LINGER_MS", "150")
+    tids = [tracing.new_trace_id() for _ in range(3)]
+    barrier = threading.Barrier(len(tids))
+    errs = []
+
+    def worker(tid, val):
+        try:
+            with tracing.trace(tid):
+                barrier.wait(timeout=10)
+                serving.score_payload(m, [{"a": val, "b": -val}])
+        except Exception as ex:   # noqa: BLE001
+            errs.append(ex)
+
+    threads = [threading.Thread(target=worker, args=(t, float(i)))
+               for i, t in enumerate(tids)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errs
+    # every parent trace sees a dispatch span (own or linked), and at
+    # least one coalesced dispatch links >1 parent
+    linked_counts = []
+    for tid in tids:
+        disp = [s for s in SPANS.trace_snapshot(tid)
+                if s["name"] == "microbatch.dispatch"]
+        assert disp, f"trace {tid} lost its dispatch span"
+        linked_counts.append(max(len(s["attrs"].get("links") or [])
+                                 for s in disp))
+    assert max(linked_counts) > 1, "no dispatch coalesced multiple traces"
+
+
+def test_scorer_prewarm_counts_and_first_request_warm_hits():
+    n = 150
+    fr = Frame.from_dict(
+        {"a": RNG.normal(size=n), "b": RNG.normal(size=n),
+         "resp": RNG.choice(["no", "yes"], size=n)})
+    m = ESTIMATORS["glm"](family="binomial")
+    m.train(x=["a", "b"], y="resp", training_frame=fr)
+    pre0 = serving.scorer_cache.PREWARMS.value()
+    t = serving.prewarm(m, wait=True)
+    assert t is not None
+    assert serving.scorer_cache.PREWARMS.value() == pre0 + 1
+    # first real request to the pre-warmed bucket: warm hit, zero compiles
+    c0 = om.xla_compile_count()
+    tid = tracing.new_trace_id()
+    with tracing.trace(tid):
+        serving.score_payload(m, [{"a": 0.2, "b": 0.3}])
+    assert om.xla_compile_count() == c0, "prewarmed bucket recompiled"
+    names = [s["name"] for s in SPANS.trace_snapshot(tid)]
+    assert "scorer.warm_hit" in names and "scorer.compile" not in names
+    DKV.remove(fr.key)
+    DKV.remove(m.key)
+
+
+def test_prewarm_env_hook_on_train(monkeypatch):
+    monkeypatch.setenv("H2O3_SCORER_PREWARM", "1")
+    pre0 = serving.scorer_cache.PREWARMS.value()
+    n = 120
+    fr = Frame.from_dict(
+        {"a": RNG.normal(size=n), "resp": RNG.normal(size=n)})
+    m = ESTIMATORS["glm"]()
+    m.train(x=["a"], y="resp", training_frame=fr)
+    deadline = time.monotonic() + 60
+    while serving.scorer_cache.PREWARMS.value() < pre0 + 1 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert serving.scorer_cache.PREWARMS.value() >= pre0 + 1, \
+        "publish did not trigger a background prewarm"
+    DKV.remove(fr.key)
+    DKV.remove(m.key)
+
+
+# ---------------------------------------------------------------------------
+# satellite: gauge collect errors are counted, scrape survives
+def _collect_err_value():
+    c = om.REGISTRY.get("h2o3_metric_collect_errors_total")
+    return c.value(metric="bad_gauge_for_test") if c is not None else 0.0
+
+
+def test_gauge_collect_error_counted():
+    reg = om.MetricsRegistry()          # isolated registry, global counter
+    reg.gauge("bad_gauge_for_test", fn=lambda: 1 / 0)
+    reg.gauge("good_gauge_for_test", fn=lambda: 7.0)
+    before = _collect_err_value()
+    text = reg.prometheus_text()
+    assert "good_gauge_for_test 7" in text          # scrape stayed alive
+    assert _collect_err_value() == before + 1
+    reg.prometheus_text()
+    assert _collect_err_value() == before + 2
+
+
+# ---------------------------------------------------------------------------
+# cluster merge renderer (unit; snapshots round-trip through JSON like the
+# replay channel does)
+def test_cluster_merge_and_exposition():
+    local = om.MetricsRegistry()
+    local.counter("h2o3_fed_reqs_total", "reqs").inc(3, route="/3/Frames")
+    local.gauge("h2o3_fed_hbm_bytes", "hbm").set(100, device="0")
+    h = local.histogram("h2o3_fed_lat_seconds", "lat", buckets=(0.5, 1.0))
+    h.observe(0.2)
+    h.observe(2.0)
+    remote = json.loads(json.dumps(local.to_dict()))   # wire round-trip
+    remote["h2o3_fed_reqs_total"]["series"][0]["value"] = 5.0
+    merged = om.merge_cluster_snapshots([(0, local.to_dict()), (1, remote)])
+    reqs = merged["h2o3_fed_reqs_total"]["series"]
+    assert {tuple(sorted(s["labels"].items())) for s in reqs} == {
+        (("host", "0"), ("route", "/3/Frames")),
+        (("host", "1"), ("route", "/3/Frames"))}
+    text = om.cluster_prometheus_text([(0, local.to_dict()), (1, remote)])
+    assert 'h2o3_fed_reqs_total{host="0",route="/3/Frames"} 3' in text
+    assert 'h2o3_fed_reqs_total{host="1",route="/3/Frames"} 5' in text
+    # gauges keep per-host identity
+    assert 'h2o3_fed_hbm_bytes{device="0",host="0"} 100' in text
+    assert 'h2o3_fed_hbm_bytes{device="0",host="1"} 100' in text
+    # histograms render cumulative buckets per host, ending at +Inf
+    assert 'h2o3_fed_lat_seconds_bucket{host="1",le="0.5"} 1' in text
+    assert 'h2o3_fed_lat_seconds_bucket{host="1",le="1"} 1' in text
+    assert 'h2o3_fed_lat_seconds_bucket{host="1",le="+Inf"} 2' in text
+    assert 'h2o3_fed_lat_seconds_count{host="1"} 2' in text
+
+
+# ---------------------------------------------------------------------------
+# REST surface — single-host server (no broadcaster)
+@pytest.fixture(scope="module")
+def server():
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _req(s, path, method="GET", headers=None, data=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{s.port}{path}", method=method,
+        headers=headers or {},
+        data=urllib.parse.urlencode(data).encode() if data else None)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.headers, r.read()
+
+
+def test_rest_mints_and_echoes_trace_id(server):
+    hdrs, _ = _req(server, "/3/Cloud")
+    minted = hdrs.get("X-H2O3-Trace-Id")
+    assert minted and tracing.sanitize(minted) == minted
+    hdrs, _ = _req(server, "/3/Cloud",
+                   headers={"X-H2O3-Trace-Id": "my-trace-1"})
+    assert hdrs.get("X-H2O3-Trace-Id") == "my-trace-1"
+    # a hostile header is replaced, never echoed
+    hdrs, _ = _req(server, "/3/Cloud",
+                   headers={"X-H2O3-Trace-Id": 'bad"id'})
+    got = hdrs.get("X-H2O3-Trace-Id")
+    assert got and got != 'bad"id'
+
+
+def test_trace_endpoint_returns_request_spans(server):
+    tid = "rest-trace-42"
+    _req(server, "/3/Frames", headers={"X-H2O3-Trace-Id": tid})
+    hdrs, body = _req(server, f"/3/Trace/{tid}")
+    out = json.loads(body)
+    assert out["trace_id"] == tid
+    reqs = [s for s in out["spans"] if s["name"] == "rest.request"]
+    assert reqs, "rest.request span missing from the stitched trace"
+    assert reqs[0]["attrs"]["route"] == "/3/Frames"
+    assert reqs[0]["attrs"]["status"] == 200
+    assert out["hosts"][0]["n_spans"] == out["n_spans"]
+
+
+def test_profiler_rest_lifecycle(server, tmp_path):
+    from h2o3_tpu.obs import profiler as prof
+    sess0 = prof.SESSIONS.value(kind="sampling")
+    _, body = _req(server, "/3/Profiler", method="POST",
+                   data={"action": "start", "kind": "sampling",
+                         "trace_dir": str(tmp_path)})
+    out = json.loads(body)
+    assert out["status"] == "started" and out["kind"] == "sampling"
+    assert out["dir"] == str(tmp_path)
+    # status reports the running session; a second start is refused
+    _, body = _req(server, "/3/Profiler")
+    assert json.loads(body)["active"] is True
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _req(server, "/3/Profiler", method="POST", data={"action": "start"})
+    assert exc.value.code == 409
+    time.sleep(0.1)                      # let the sampler take samples
+    _, body = _req(server, "/3/Profiler", method="POST",
+                   data={"action": "stop"})
+    out = json.loads(body)
+    assert out["status"] == "stopped"
+    assert os.path.exists(out["artifact"])
+    assert prof.SESSIONS.value(kind="sampling") == sess0 + 1
+    _, body = _req(server, "/3/Profiler")
+    assert json.loads(body)["active"] is False
+
+
+# ---------------------------------------------------------------------------
+# cross-host stitching + federation through a REAL Broadcaster and a
+# protocol-faithful fake worker (handshake, seq ordering, acks — the same
+# wire the 2-process cloud uses, without the jax.distributed boot cost)
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _remote_metrics_snapshot():
+    return {"h2o3_score_rows_total": {
+        "kind": "counter", "help": "remote",
+        "series": [{"labels": {}, "value": 17.0}]}}
+
+
+def _remote_trace_spans(tid):
+    now = time.time()
+    return [{"name": "replay.request", "id": 1, "parent": 0, "host": 1,
+             "start": now, "end": now + 0.01, "duration_ms": 10.0,
+             "attrs": {"path": "/3/Predictions"}, "trace": tid},
+            {"name": "mrtask.map_reduce", "id": 2, "parent": 1, "host": 1,
+             "start": now, "end": now + 0.005, "duration_ms": 5.0,
+             "attrs": {"fn": "_score"}, "trace": tid}]
+
+
+def _fake_worker(sock, key, stall_ops=False):
+    """Ack every replayed request; answer collect ops with canned host-1
+    observability data (or never, when stalling)."""
+    while True:
+        try:
+            msg = MH._recv_frame(sock, key)
+        except Exception:   # noqa: BLE001 — coordinator closed mid-frame
+            return
+        if msg is None:
+            return
+        if "op" in msg:
+            if stall_ops:
+                continue                  # outwait the collect deadline
+            op = msg["op"]
+            if op == "metrics":
+                data = {"host": 1, "metrics": _remote_metrics_snapshot()}
+            elif op.startswith("trace:"):
+                data = {"host": 1,
+                        "spans": _remote_trace_spans(op[len("trace:"):])}
+            elif op == "timeline":
+                data = {"host": 1, "spans": []}
+            else:
+                data = None
+            MH._send_frame(sock, key, {"ack": msg["seq"], "data": data})
+        else:
+            MH._send_frame(sock, key, {"ack": msg["seq"]})
+
+
+def _cloud_server(stall_ops=False):
+    """(server, broadcaster, worker_sock): a live H2OServer whose
+    broadcaster talks to one fake remote host."""
+    from h2o3_tpu.api.server import H2OServer
+    port = _free_port()
+    out = {}
+
+    def _accept():
+        out["bc"] = MH.Broadcaster(1, port)
+
+    t = threading.Thread(target=_accept, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    sock = None
+    while sock is None and time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection(("127.0.0.1", port))
+        except OSError:
+            time.sleep(0.05)
+    secret = os.environ["H2O3_CLUSTER_SECRET"].encode()
+    chal = MH._recv_frame(sock, secret)
+    nonce_w = "feedface" * 4
+    MH._send_frame(sock, secret,
+                   {"hello": 1, "echo": chal["challenge"], "nonce": nonce_w})
+    key = MH._session_key(secret, chal["challenge"], nonce_w)
+    assert MH._recv_frame(sock, key) == {"welcome": 1}
+    t.join(timeout=10)
+    assert not t.is_alive() and "bc" in out
+    wt = threading.Thread(target=_fake_worker, args=(sock, key, stall_ops),
+                          daemon=True)
+    wt.start()
+    srv = H2OServer(port=0).start()
+    srv.httpd.broadcaster = out["bc"]
+    return srv, out["bc"], sock
+
+
+@pytest.fixture()
+def cluster_secret(monkeypatch):
+    monkeypatch.setenv("H2O3_CLUSTER_SECRET", "tracing-test-secret")
+
+
+def test_trace_stitched_across_two_hosts(gbm_model, cluster_secret):
+    m = gbm_model
+    srv, bc, sock = _cloud_server()
+    try:
+        tid = "stitch-me-1"
+        body = json.dumps({"rows": [{"a": 0.3, "b": -0.1}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/3/Predictions/models/{m.key}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-H2O3-Trace-Id": tid})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers.get("X-H2O3-Trace-Id") == tid
+            assert json.loads(r.read())["row_count"] == 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/3/Trace/{tid}",
+                timeout=60) as r:
+            out = json.loads(r.read())
+        by_host = {}
+        for s in out["spans"]:
+            by_host.setdefault(s["host"], []).append(s["name"])
+        # ONE trace id spans REST → micro-batch → scorer on the serving
+        # host AND MRTask work on the remote host
+        assert set(by_host) >= {0, 1}, out["hosts"]
+        assert "rest.request" in by_host[0]
+        assert "microbatch.dispatch" in by_host[0]
+        assert any(n.startswith("scorer.") for n in by_host[0])
+        assert "mrtask.map_reduce" in by_host[1]
+        assert len(out["hosts"]) == 2
+        # spans come back time-sorted
+        starts = [s["start"] for s in out["spans"]]
+        assert starts == sorted(starts)
+    finally:
+        srv.stop()
+        sock.close()
+
+
+def test_cluster_scrape_merges_both_hosts(gbm_model, cluster_secret):
+    srv, bc, sock = _cloud_server()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics?scope=cluster",
+                timeout=60) as r:
+            text = r.read().decode()
+        assert 'h2o3_score_rows_total{host="1"} 17' in text
+        assert 'host="0"' in text                 # local series labeled too
+        # plain scope stays single-host, label-free
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=60) as r:
+            assert 'host="0"' not in r.read().decode()
+        # JSON twin
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/3/WaterMeter?cluster=1",
+                timeout=60) as r:
+            wm = json.loads(r.read())
+        assert wm["hosts"] == [0, 1] and wm["lagging_hosts"] == []
+        series = wm["metrics"]["h2o3_score_rows_total"]["series"]
+        assert {"labels": {"host": "1"}, "value": 17.0} in series
+    finally:
+        srv.stop()
+        sock.close()
+
+
+def test_cluster_scrape_absorbs_stalled_host(gbm_model, cluster_secret,
+                                             monkeypatch):
+    monkeypatch.setenv("H2O3_OBS_COLLECT_TIMEOUT_S", "0.5")
+    srv, bc, sock = _cloud_server(stall_ops=True)
+    try:
+        t0 = time.monotonic()
+        before = om.CLUSTER_SCRAPE_TIMEOUTS.value()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics?scope=cluster",
+                timeout=60) as r:
+            text = r.read().decode()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"stalled host held the scrape {elapsed:.1f}s"
+        assert om.CLUSTER_SCRAPE_TIMEOUTS.value() == before + 1
+        assert 'host="0"' in text                 # local data still served
+        assert 'host="1"' not in text             # stalled host absent
+    finally:
+        srv.stop()
+        sock.close()
