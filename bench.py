@@ -21,8 +21,18 @@ import traceback
 
 import numpy as np
 
-# relay first-contact can be slow; a wedged relay hangs forever
+# relay first-contact can be slow; a wedged relay hangs forever. The CHIP
+# probe gets a SHORT deadline (BENCH_r03-r05 lesson: three rounds burned
+# 300s+ waiting on a wedged relay and recorded nothing) — if the TPU
+# doesn't answer fast, fall back to CPU and record a real number; the CPU
+# probe keeps the long deadline since it is the last resort.
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
+TPU_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "60"))
+
+# backend main() actually initialized, recorded for the crash handler —
+# which must NEVER query jax itself: a first-touch backend init there
+# could hang on the wedged relay the probe exists to sidestep
+_OBSERVED_BACKEND = "none"
 
 
 def _registry():
@@ -33,7 +43,7 @@ def _registry():
     return om.REGISTRY
 
 
-def blocked_record(stage: str, detail: str) -> dict:
+def blocked_record(stage: str, detail: str, backend: str = "none") -> dict:
     """Structured evidence when the chip is unreachable (BENCH_r03 lesson:
     a raw traceback at import left the round with zero perf record). The
     wedged state is also a labeled gauge, so a scraper sees
@@ -55,23 +65,24 @@ def blocked_record(stage: str, detail: str) -> dict:
         "value": 0,
         "unit": "row*trees/s",
         "vs_baseline": 0.0,
+        "backend": backend,
         "blocked": True,
         "blocked_stage": stage,
         "blocked_detail": detail[-2000:],
     }
 
 
-def _probe_once(env: dict) -> tuple | None:
+def _probe_once(env: dict, timeout_s: int = PROBE_TIMEOUT_S) -> tuple | None:
     """One subprocess probe: None when healthy, else (stage, detail)."""
     code = ("import jax, jax.numpy as jnp; x = jnp.ones((4,)); "
             "print(jax.default_backend(), float(x.sum()))")
     try:
         r = subprocess.run([sys.executable, "-c", code],
-                           timeout=PROBE_TIMEOUT_S,
+                           timeout=timeout_s,
                            capture_output=True, text=True, env=env)
     except subprocess.TimeoutExpired:
         return ("backend-probe-timeout",
-                f"backend init did not respond within {PROBE_TIMEOUT_S}s "
+                f"backend init did not respond within {timeout_s}s "
                 "(TPU relay wedged?)")
     if r.returncode != 0:
         return ("backend-probe-error",
@@ -83,15 +94,18 @@ def _probe_once(env: dict) -> tuple | None:
 def probe_backend() -> dict | None:
     """Pre-flight the backend in a SUBPROCESS with a hard timeout so a wedged
     TPU relay (observed: jax.devices() hung >5h) yields a blocked record
-    instead of hanging the driver. When the chip is unreachable but the CPU
-    backend works (or JAX_PLATFORMS=cpu was requested), fall back to CPU
-    smoke mode and report a REAL number instead of a blocked record
-    (BENCH_r05: blocked_stage=backend-probe-timeout left the round with
-    zero perf signal). Returns None when a usable backend exists."""
-    fail = _probe_once(dict(os.environ))
+    instead of hanging the driver. The chip probe uses the SHORT deadline;
+    when it fails and the CPU backend works (or JAX_PLATFORMS=cpu was
+    requested), fall back to CPU smoke mode and report a REAL number with
+    `backend` recorded in the JSON — a round must never say
+    `blocked: backend-probe-timeout` while tier-1 proves CPU is healthy
+    (the BENCH_r03-r05 gap). Returns None when a usable backend exists."""
+    want_cpu = os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+    fail = _probe_once(dict(os.environ),
+                       PROBE_TIMEOUT_S if want_cpu else TPU_PROBE_TIMEOUT_S)
     if fail is None:
         return None
-    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+    if not want_cpu:
         if _probe_once(dict(os.environ, JAX_PLATFORMS="cpu")) is None:
             print(f"chip probe failed ({fail[0]}); falling back to "
                   "JAX_PLATFORMS=cpu smoke mode", file=sys.stderr)
@@ -221,6 +235,10 @@ def main():
 
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    # safe: the subprocess probe just proved this backend initializes
+    global _OBSERVED_BACKEND
+    _OBSERVED_BACKEND = jax.default_backend()
 
     # the bench run carries its OWN trace id: every span it opens (tree
     # levels, parse stages, scoring dispatches) is fetchable afterward via
@@ -442,5 +460,6 @@ if __name__ == "__main__":
         # one parseable JSON line no matter what — the driver's record must
         # never be a bare traceback again; diagnostics go to stderr
         traceback.print_exc()
-        print(json.dumps(blocked_record("run", traceback.format_exc())))
+        print(json.dumps(blocked_record("run", traceback.format_exc(),
+                                        backend=_OBSERVED_BACKEND)))
         sys.exit(0)

@@ -17,8 +17,13 @@ lock only exists in the composition. This module builds the composition:
     make_lock/make_rlock/DepLock) — id `module.Class.attr` — and
     module-level lock globals — id `module.NAME`. `with <lock>:` blocks
     are tracked lexically; a `with` on something unresolvable holds
-    nothing (conservative: silence over noise). Bare `.acquire()` calls
-    are NOT modeled — the codebase convention is `with`.
+    nothing (conservative: silence over noise). Manual
+    `<lock>.acquire()` / `<lock>.release()` pairs on resolvable locks
+    are modeled linearly in statement order within a function body
+    (try/finally release lands after the guarded statements, matching
+    the AST walk), so a pager-style I/O lock held across explicit
+    acquire/release cannot dodge R007/R008; `acquire(blocking=False)`
+    try-locks add held-ness but no order edge (a trylock cannot wait).
 
 Per-function summaries (locks acquired, blocking ops, out-calls, each
 with the lexically-held lock set) are closed over the call graph to a
@@ -417,8 +422,29 @@ def _blocking_desc(call: ast.Call):
 
 # ---------------------------------------------------------------------------
 # per-function lexical summary
+def _is_trylock(call: ast.Call) -> bool:
+    """acquire(False) / acquire(blocking=False): cannot wait, so it adds
+    held-ness but no order dependency (Linux lockdep's trylock rule)."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is False:
+        return True
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
 def _summarize(fi: _FnInfo, proj: _Project):
     mi, cls = fi.mod, fi.cls
+    # locks held via manual .acquire()/.release(): tracked linearly in
+    # statement order across the whole function body (the AST walk visits
+    # try bodies before finally blocks, so the common acquire/try/finally-
+    # release shape holds exactly the guarded statements)
+    manual: list = []
+
+    def held_set(held: tuple) -> frozenset:
+        return frozenset(held) | frozenset(manual)
 
     def visit(node, held: tuple):
         if isinstance(node, ast.With):
@@ -426,7 +452,7 @@ def _summarize(fi: _FnInfo, proj: _Project):
             for item in node.items:
                 lid = proj.resolve_lock(mi, cls, item.context_expr)
                 if lid is not None:
-                    fi.acquires.append((lid, node.lineno, frozenset(held)))
+                    fi.acquires.append((lid, node.lineno, held_set(held)))
                     ids.append(lid)
                 visit(item.context_expr, held)
             inner = tuple(held) + tuple(i for i in ids if i not in held)
@@ -437,12 +463,26 @@ def _summarize(fi: _FnInfo, proj: _Project):
                              ast.Lambda)):
             return      # nested scope: summarized separately (module defs)
         if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("acquire", "release"):
+                lid = proj.resolve_lock(mi, cls, node.func.value)
+                if lid is not None:
+                    if node.func.attr == "acquire":
+                        if not _is_trylock(node):
+                            fi.acquires.append(
+                                (lid, node.lineno, held_set(held)))
+                        manual.append(lid)
+                    elif lid in manual:
+                        manual.remove(lid)
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, held)
+                    return
             desc = _blocking_desc(node)
             if desc is not None:
-                fi.blocking.append((desc, node.lineno, frozenset(held)))
+                fi.blocking.append((desc, node.lineno, held_set(held)))
             callee = proj.resolve_call(mi, cls, node)
             if callee is not None and callee in proj.fns:
-                fi.calls.append((callee, node.lineno, frozenset(held),
+                fi.calls.append((callee, node.lineno, held_set(held),
                                  _has_bound(node)))
         for child in ast.iter_child_nodes(node):
             visit(child, held)

@@ -24,8 +24,19 @@ deadlocking under the unlucky schedule.
 Instrumented lock classes (see the callers): `dkv`, `scorer_cache`,
 `scorer_cache.tokens`, `scorer_cache.broken`, `scorer_cache.build`,
 `microbatch`, `metrics.registry`, `timeline.ring`, `timeline.trace`,
-`replay_channel`. Per-metric series locks stay plain `threading.Lock` —
-they are leaf locks on the hottest counter path and never nest.
+`replay_channel`, and the DKV chunk pager's `tiering.io` (per-chunk
+transfer lock, one class for every instance) and `tiering.residency`
+(pager maps/accounting) — ordered io → residency, neither ever nested
+under `dkv`. Per-metric series locks stay plain `threading.Lock` — they
+are leaf locks on the hottest counter path and never nest.
+
+Manual `.acquire()`/`.release()` calls on a DepLock are instrumented
+exactly like `with`-blocks (acquire/release ARE the with-protocol).
+A non-blocking try-acquire (`acquire(blocking=False)`) records the lock
+as held but adds NO order edge and is never reported as an inversion —
+a trylock cannot wait, so it cannot complete a deadlock cycle (Linux
+lockdep's trylock rule). Bounded acquires (`timeout=`) still record
+order: timing out rescues the schedule but the ordering bug remains.
 
 Metrics: `h2o3_lockdep_edges_total` (distinct order edges recorded),
 `h2o3_lockdep_inversions_total` (cycles detected). Both are declared
@@ -160,12 +171,14 @@ def _caller_site() -> str:
     return "<unknown>"
 
 
-def _note_acquire(name: str):
+def _note_acquire(name: str, trylock: bool = False):
     """Record intent to acquire `name`; raises on inversion BEFORE the
-    underlying acquire, so the error surfaces instead of the deadlock."""
+    underlying acquire, so the error surfaces instead of the deadlock.
+    `trylock` (a non-blocking acquire) records held-ness only: it cannot
+    wait, so it adds no order edge and never proves an inversion."""
     global _EDGE_COUNT, _INVERSION_COUNT
     held = _held()
-    if name in held:            # re-entrant acquire: no new order edge
+    if trylock or name in held:  # trylock / re-entry: no new order edge
         held.append(name)
         return
     if not held:
@@ -236,7 +249,7 @@ class DepLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if _STATE.enabled and not _busy():
-            _note_acquire(self.name)
+            _note_acquire(self.name, trylock=not blocking)
             ok = self._lock.acquire(blocking, timeout)
             if not ok:
                 _note_release(self.name)

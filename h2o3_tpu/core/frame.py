@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.core import tiering as _tiering
 from h2o3_tpu.parallel import mesh as _mesh
 from h2o3_tpu.parallel import mrtask as _mr
 
@@ -129,15 +130,38 @@ class Vec:
     """A typed, row-sharded, dtype-packed column resident in TPU HBM."""
 
     def __init__(self, data, codec: Codec, mask, nrows: int, type: str = T_NUM,
-                 domain: Optional[np.ndarray] = None, host_data=None):
-        self.data = data            # jax.Array (padded,) packed — or None for str
+                 domain: Optional[np.ndarray] = None, host_data=None,
+                 packed_host=None, packed_mask=None):
+        # the packed planes live behind the DKV tier pager: `data`/`mask`
+        # are fault-on-read properties over one TierChunk (HBM → host
+        # codec bytes → disk), None for str/uuid/sparse layouts. A chunk
+        # may be born cold (data=None + packed_host): budgeted ingest
+        # parks codec bytes in the host tier and faults on first access.
+        if data is not None or packed_host is not None:
+            host = (packed_host, packed_mask) \
+                if packed_host is not None else None
+            self._chunk = _tiering.PAGER.new_chunk(data, mask, host=host,
+                                                   label=type)
+        else:
+            self._chunk = None
         self.codec = codec
-        self.mask = mask            # jax.Array uint8 (padded,) or None
         self.nrows = nrows
         self.type = type
         self.domain = domain        # np.ndarray[str] for T_CAT
         self.host_data = host_data  # np object array for T_STR/T_UUID
         self._rollups: Optional[Rollups] = None
+
+    @property
+    def data(self):
+        """Packed jax.Array (padded,) — faults the chunk to HBM."""
+        ch = self._chunk
+        return ch.device()[0] if ch is not None else None
+
+    @property
+    def mask(self):
+        """uint8 NA plane (padded,) or None — faults alongside data."""
+        ch = self._chunk
+        return ch.device()[1] if ch is not None else None
 
     # ---- construction ---------------------------------------------------
     @staticmethod
@@ -168,13 +192,22 @@ class Vec:
         maskp = np.ones(pad, bool)       # padding rows are NA
         maskp[:n] = mask
         packed, codec = _choose_codec(colp, maskp)
-        data = _mr.device_put_rows(packed)
-        dmask = _mr.device_put_rows(maskp.astype(np.uint8)) if maskp.any() else None
+        mask_np = maskp.astype(np.uint8) if maskp.any() else None
+        if mask_np is None and n < pad:  # padding must always be masked
+            mask_np = np.zeros(pad, np.uint8)
+            mask_np[n:] = 1
         dom = np.asarray(domain, dtype=object) if domain is not None else None
-        if dmask is None and n < pad:   # padding must always be masked
-            m = np.zeros(pad, np.uint8); m[n:] = 1
-            dmask = _mr.device_put_rows(m)
-        return Vec(data, codec, dmask, n, vtype, dom)
+        if _tiering.PAGER.hbm_budget:
+            # budgeted ingest: park the codec bytes in the HOST tier and
+            # let first access fault them — an eager device_put here
+            # would spike HBM past the budget before the pager could act
+            return Vec(None, codec, None, n, vtype, dom,
+                       packed_host=packed, packed_mask=mask_np)
+        data = _mr.device_put_rows(packed)
+        dmask = _mr.device_put_rows(mask_np) if mask_np is not None else None
+        # packed/mask_np are the codec bytes the pager's host tier keeps
+        return Vec(data, codec, dmask, n, vtype, dom,
+                   packed_host=packed, packed_mask=mask_np)
 
     @staticmethod
     def from_device_floats(col_j, vtype=T_NUM, domain=None) -> "Vec":
@@ -222,7 +255,11 @@ class Vec:
     # ---- access ---------------------------------------------------------
     @property
     def padded_len(self) -> int:
-        return int(self.data.shape[0]) if self.data is not None else len(self.host_data)
+        # chunk metadata, NOT .data: reading the shape must never fault a
+        # demoted chunk back into HBM
+        if self._chunk is not None:
+            return self._chunk.rows
+        return len(self.host_data)
 
     def as_f32(self) -> jax.Array:
         """Decoded f32 view (NaN NAs, padding = NaN). Materializes; prefer
@@ -723,11 +760,18 @@ class Frame:
         if hit is not None:
             return hit
         vs = [self.vec(c) for c in cols]
+        # bounded-lookahead faulting, ONE device() per column (both
+        # planes from a single fault — touching .data then .mask would
+        # fault a demoted chunk twice): the I/O worker tiers up the next
+        # couple of columns while the main thread faults the current one.
         # sparse columns densify through as_f32 (already decoded f32 with
         # NaN padding) — _decode_f32 cannot read their data=None layout
-        datas = [v.as_f32() if isinstance(v, SparseVec) else v.data
-                 for v in vs]
-        masks = [None if isinstance(v, SparseVec) else v.mask for v in vs]
+        planes = _mr.map_chunked(
+            lambda v: (v.as_f32(), None) if isinstance(v, SparseVec)
+            else v._chunk.device(),
+            vs, lookahead=2)
+        datas = [p[0] for p in planes]
+        masks = [p[1] for p in planes]
         codecs = tuple(Codec("f32") if isinstance(v, SparseVec) else v.codec
                        for v in vs)
 
@@ -786,21 +830,33 @@ class Frame:
 
     # ---- summary (REST /3/Frames summary) --------------------------------
     def summary(self) -> dict:
+        # chunked iteration with lookahead: rollups fault one column at a
+        # time, so the pager tiers up column j+1 while j's kernel runs
+        rolls = _mr.map_chunked(
+            lambda v: None if v.type == T_STR else v.rollups(),
+            self.vecs, lookahead=2)
         out = {}
-        for n, v in zip(self.names, self.vecs):
-            if v.type == T_STR:
+        for n, v, r in zip(self.names, self.vecs, rolls):
+            if r is None:
                 out[n] = {"type": v.type}
                 continue
-            r = v.rollups()
             out[n] = {"type": v.type, "min": r.min, "max": r.max,
                       "mean": r.mean, "sigma": r.sigma, "missing": r.nas,
                       "zeros": r.zeros,
                       "cardinality": v.cardinality}
         return out
 
+    def _tier_on_get(self):
+        """DKV.get hook: LRU-touch this frame's chunks; a whole-frame
+        spill (every chunk on disk) promotes its codec bytes back to host
+        RAM, HBM faults stay lazy (raw_get never calls this)."""
+        _tiering.PAGER.on_frame_get(
+            [v._chunk for v in self.vecs])
+
     def _on_remove(self):
         # Vecs may be shared with other frames (column slices, adapted test
-        # frames) — drop only our caches; device arrays are freed by refcount.
+        # frames) — drop only our caches; device arrays (and their pager
+        # chunks + spill files) are freed by refcount/GC.
         self._matrix_cache.clear()
 
     def __repr__(self):

@@ -40,16 +40,19 @@ class _DKV:
     def get(self, key: str, default=None):
         with self._mutex:
             v = self._store.get(key, default)
-        if v is not None and getattr(v, "spilled", False):
-            # Cleaner spilled this frame to ice; reload transparently
-            # (water/Value.java mem/disk duality)
-            from h2o3_tpu.core.memory import resolve
-            return resolve(v)
+        # chunk-tiered objects (Frames) re-promote transparently on get
+        # (water/Value.java mem/disk duality, now chunk-granular): the
+        # hook runs OUTSIDE the registry mutex so pager I/O never nests
+        # under `dkv`
+        hook = getattr(v, "_tier_on_get", None)
+        if hook is not None:
+            hook()
         return v
 
     def raw_get(self, key: str, default=None):
-        """Registry hit WITHOUT spill resolution — for the memory manager's
-        accounting/cleaning, which must not fault spilled frames back in."""
+        """Registry hit WITHOUT tier promotion — for the memory manager's
+        accounting/cleaning and metric scrapes, which must not fault
+        demoted chunks back in."""
         with self._mutex:
             return self._store.get(key, default)
 

@@ -5,143 +5,128 @@ Cleaner.java:11 (a background "user-mode swap": LRU-ages cached Values and
 spills cold ones to ice_root disk, reloading transparently on access),
 FrameSizeMonitor.java.
 
-TPU-native design: the scarce resource is device HBM, not JVM heap. The
-manager accounts the HBM bytes of every registered Frame, and when a
-configurable budget is exceeded, LRU-spills whole cold frames to the ice
-directory (.hex snapshots via io/persist) and frees their device buffers.
-Access through `DKV.get` transparently reloads (Value.java's mem/disk
-duality, frame-granular instead of chunk-granular — device_put of a whole
-column set is one bulk host→HBM transfer, which is how TPUs like it).
-There is no background thread: `maybe_clean()` runs at registration points
-(frame creation), the moral equivalent of Cleaner wakeups."""
+TPU-native design: the scarce resource is device HBM. Paging itself is
+CHUNK-granular and lives in core/tiering.py (HBM → host codec bytes →
+disk); this module is the frame-level facade the rest of the runtime
+talks to — byte accounting for the DKV census, Cleaner wakeups at frame
+registration, explicit whole-frame spill/load, and pinning. Frame-granular
+`_Spilled` placeholders are gone: a "spilled" frame is simply one whose
+every chunk sits on the disk tier, and `DKV.get` promotes its codec bytes
+back to host RAM while HBM faults stay lazy per chunk (so a frame
+slightly over budget pages a few chunks instead of ping-ponging whole)."""
 
 from __future__ import annotations
 
-import os
-import time
+from h2o3_tpu.core import tiering as _tiering
+from h2o3_tpu.io import spill as _spill
 
-import numpy as np
 
-DEFAULT_BUDGET = int(os.environ.get("H2O3_TPU_HBM_BUDGET_MB", "0")) * 2**20
+def _frame_chunks(frame):
+    return [c for c in (getattr(v, "_chunk", None) for v in frame.vecs)
+            if c is not None]
 
 
 class MemoryManager:
-    def __init__(self, ice_root: str | None = None,
-                 budget_bytes: int = DEFAULT_BUDGET):
-        self.ice_root = ice_root or os.path.join(
-            os.path.expanduser("~"), ".h2o3_tpu_ice")
-        self.budget = budget_bytes          # 0 = unlimited (no spilling)
-        self._touch: dict[str, float] = {}  # frame key -> last access
-        self._spilled: dict[str, str] = {}  # frame key -> snapshot path
-        self._pinned: set[str] = set()
+    def __init__(self):
+        self.pager = _tiering.PAGER
+
+    # ---- config ---------------------------------------------------------
+    @property
+    def budget(self) -> int:
+        """HBM budget in bytes (0 = unlimited) — the pager's ladder top."""
+        return self.pager.hbm_budget
+
+    @budget.setter
+    def budget(self, value: int):
+        self.pager.hbm_budget = int(value)
+
+    @property
+    def ice_root(self) -> str:
+        return _spill.get_ice_root()
+
+    @ice_root.setter
+    def ice_root(self, path: str):
+        _spill.set_ice_root(path)
 
     # ---- accounting (MemoryManager.java) --------------------------------
     def frame_bytes(self, frame) -> int:
-        total = 0
-        for v in frame.vecs:
-            for arr in (getattr(v, "data", None), getattr(v, "mask", None)):
-                if arr is not None:
-                    total += int(np.prod(arr.shape)) * arr.dtype.itemsize
-        return total
+        """MEMORY-resident packed bytes of the frame's pageable planes
+        (HBM or host RAM) — the DKV census number. Chunks whose only
+        copy is a spill file contribute 0, matching the old contract
+        where spilled frames dropped out of the census. Sparse/str/uuid
+        planes carry no chunk and are not pageable (yet) — see ROADMAP."""
+        return sum(c.nbytes for c in _frame_chunks(frame)
+                   if c.tier != _tiering.TIER_DISK)
 
     def total_bytes(self) -> int:
-        # raw_get: accounting must never fault spilled frames back into HBM
+        """HBM-resident packed chunk bytes, cluster-wide working set."""
+        return self.pager.tier_bytes()[_tiering.TIER_HBM]
+
+    def _chunks_of(self, key: str):
         from h2o3_tpu.core.frame import Frame
         from h2o3_tpu.core.kvstore import DKV
-        return sum(self.frame_bytes(o) for k in DKV.keys()
-                   if k not in self._spilled
-                   and isinstance(o := DKV.raw_get(k), Frame))
+        # raw_get: accounting/cleaning must never fault chunks back in
+        f = DKV.raw_get(key)
+        return _frame_chunks(f) if isinstance(f, Frame) else []
 
     def touch(self, key: str):
-        self._touch[key] = time.time()
+        self.pager.touch_chunks(self._chunks_of(key))
 
     def pin(self, key: str):
-        self._pinned.add(key)
+        for c in self._chunks_of(key):
+            c.pinned += 1
 
     def unpin(self, key: str):
-        self._pinned.discard(key)
+        for c in self._chunks_of(key):
+            if c.pinned:
+                c.pinned -= 1
 
     # ---- the Cleaner (Cleaner.java:11) ----------------------------------
     def maybe_clean(self):
-        """Spill LRU frames until under budget (no-op when budget==0)."""
-        if not self.budget:
-            return []
-        from h2o3_tpu.core.frame import Frame
-        from h2o3_tpu.core.kvstore import DKV
-        live = [(k, DKV.raw_get(k)) for k in DKV.keys()
-                if k not in self._spilled]
-        frames = [(k, o) for k, o in live
-                  if isinstance(o, Frame) and k not in self._pinned]
-        used = sum(self.frame_bytes(o) for _, o in frames)
-        if used <= self.budget:
-            return []
-        frames.sort(key=lambda kv: self._touch.get(kv[0], 0.0))
-        spilled = []
-        for k, f in frames:
-            if used <= self.budget:
-                break
-            used -= self.frame_bytes(f)
-            self.spill(k, f)
-            spilled.append(k)
-        return spilled
+        """Cleaner wakeup: enforce the tier budgets, LRU-demoting cold
+        chunks (no-op when no budget is set)."""
+        return self.pager.maybe_demote()
 
     def spill(self, key: str, frame=None):
-        """Write the frame to ice and drop its device buffers."""
+        """Demote every chunk of the frame to the disk tier (the explicit
+        Cleaner spill; files land under ice_root via io/spill)."""
         from h2o3_tpu.core.kvstore import DKV
-        from h2o3_tpu.io.persist import export_frame
-        frame = frame if frame is not None else DKV.get(key)
-        os.makedirs(self.ice_root, exist_ok=True)
-        path = os.path.join(self.ice_root, f"{key}.hex")
-        export_frame(frame, path)
-        self._spilled[key] = path
-        DKV.atomic(key, lambda _old: _Spilled(key, path))
-        return path
+        frame = frame if frame is not None else DKV.raw_get(key)
+        for c in _frame_chunks(frame):
+            self.pager.demote(c, _tiering.TIER_DISK)
+        return _spill.chunk_dir()
 
     def load(self, key: str):
-        """Reload a spilled frame into HBM (Value.loadPersist analog)."""
+        """Fault every chunk of the frame back to HBM (bulk reload)."""
         from h2o3_tpu.core.kvstore import DKV
-        from h2o3_tpu.io.persist import import_frame
-        path = self._spilled.pop(key, None)
-        if path is None:
-            # concurrent loader won the race — wait for its DKV.put to land
-            for _ in range(2000):
-                v = DKV.raw_get(key)
-                if not getattr(v, "spilled", False):
-                    return v
-                time.sleep(0.005)
-            raise TimeoutError(f"spilled frame {key!r} never reloaded")
-        f = import_frame(path, key=key)
-        DKV.put(key, f)
-        self.touch(key)
-        try:
-            os.remove(path)
-        except OSError:
-            pass
+        f = DKV.raw_get(key)
+        if f is not None:
+            for c in _frame_chunks(f):
+                c.device()
         return f
 
     def is_spilled(self, key: str) -> bool:
-        return key in self._spilled
+        """True when the frame's every pageable chunk sits on disk."""
+        chunks = self._chunks_of(key)
+        return bool(chunks) and all(
+            c.tier == _tiering.TIER_DISK for c in chunks)
+
+    def is_hbm_resident(self, key: str) -> bool:
+        """True when at least one of the frame's chunks is in HBM."""
+        return any(c.tier == _tiering.TIER_HBM
+                   for c in self._chunks_of(key))
 
     def stats(self) -> dict:
+        from h2o3_tpu.core.frame import Frame
+        from h2o3_tpu.core.kvstore import DKV
+        spilled = [k for k in DKV.keys()
+                   if isinstance(DKV.raw_get(k), Frame)
+                   and self.is_spilled(k)]
+        st = self.pager.stats()
         return {"ice_root": self.ice_root, "budget_bytes": self.budget,
-                "resident_bytes": self.total_bytes(),
-                "spilled": sorted(self._spilled)}
-
-
-class _Spilled:
-    """Registry placeholder for a spilled frame; DKV.get resolves it."""
-
-    def __init__(self, key, path):
-        self.key = key
-        self.path = path
-        self.spilled = True
+                "resident_bytes": st["tier_bytes"][_tiering.TIER_HBM],
+                "tier_bytes": st["tier_bytes"],
+                "faults": st["faults"], "spilled": sorted(spilled)}
 
 
 MANAGER = MemoryManager()
-
-
-def resolve(obj):
-    """Transparent reload when a registry hit is a spill placeholder."""
-    if isinstance(obj, _Spilled):
-        return MANAGER.load(obj.key)
-    return obj

@@ -63,7 +63,9 @@ def export_frame(frame: Frame, path: str) -> str:
         c = {"type": v.type, "codec": v.codec.kind, "bias": v.codec.bias,
              "const": None if v.codec.const_val != v.codec.const_val
              else v.codec.const_val,
-             "domain": v.levels(), "has_mask": v.mask is not None,
+             # has_mask is filled from the staged planes below — touching
+             # v.mask here would fault a demoted chunk back into HBM
+             "domain": v.levels(), "has_mask": False,
              "is_str": v.type == "str", "is_sparse": is_sparse}
         header["cols"].append(c)
         if is_sparse:
@@ -76,9 +78,13 @@ def export_frame(frame: Frame, path: str) -> str:
                                         for x in data])
             arrays[f"sm{j}"] = np.array([x is None for x in data])
         else:
-            arrays[f"d{j}"] = np.asarray(v.data)
-            if v.mask is not None:
-                arrays[f"m{j}"] = np.asarray(v.mask)
+            # staging_view: packed planes from the cheapest resident tier
+            # — exporting a demoted frame must not fault it back into HBM
+            data_h, mask_h = v._chunk.staging_view()
+            c["has_mask"] = mask_h is not None
+            arrays[f"d{j}"] = np.asarray(data_h)
+            if mask_h is not None:
+                arrays[f"m{j}"] = np.asarray(mask_h)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("header.json", json.dumps(header, default=float))
         import io as _io
@@ -126,11 +132,14 @@ def _import_frame_local(path: str, key=None) -> Frame:
             codec = Codec(c["codec"], bias=c["bias"] or 0.0,
                           const_val=(c["const"] if c["const"] is not None
                                      else float("nan")))
-            data = mr.device_put_rows(npz[f"d{j}"])
-            mask = mr.device_put_rows(npz[f"m{j}"]) if c["has_mask"] else None
+            data_h = npz[f"d{j}"]
+            mask_h = npz[f"m{j}"] if c["has_mask"] else None
+            data = mr.device_put_rows(data_h)
+            mask = mr.device_put_rows(mask_h) if mask_h is not None else None
             dom = (np.asarray(c["domain"], object)
                    if c["domain"] is not None else None)
-            vecs.append(Vec(data, codec, mask, header["nrows"], c["type"], dom))
+            vecs.append(Vec(data, codec, mask, header["nrows"], c["type"],
+                            dom, packed_host=data_h, packed_mask=mask_h))
     return Frame(header["names"], vecs, key or header["key"])
 
 

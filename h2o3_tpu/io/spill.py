@@ -1,0 +1,78 @@
+"""Chunk spill backend — the disk tier of the DKV chunk pager.
+
+Reference: water/persist/PersistIce.java (Value byte[] spill files under
+ice_root), water/Value.java mem/disk duality. Where persist.py snapshots
+WHOLE frames (.hex, the FramePersist analog), this backend stores ONE
+chunk plane-bundle per file: the packed codec bytes (dtype-packed data
+plane + optional uint8 NA mask) exactly as the parser produced them, so a
+disk→host promotion is a plain np.load with zero decode work and a
+host→HBM promotion stays the same bulk device_put as any other fault.
+
+Files live under the ice root (H2O3_TPU_ICE_ROOT, default
+~/.h2o3_tpu_ice/chunks); the pager owns their lifetime — a chunk's spill
+file is deleted when the chunk is promoted off disk or garbage-collected.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+_DEFAULT_ICE = os.path.join(os.path.expanduser("~"), ".h2o3_tpu_ice")
+_ICE_ROOT = os.environ.get("H2O3_TPU_ICE_ROOT", _DEFAULT_ICE)
+
+_SAFE = re.compile(r"[^A-Za-z0-9_.-]")
+
+# chunk keys are a per-process counter ("num#1", ...), so two processes
+# sharing one ice root (two servers, parallel test workers) would clobber
+# each other's files — every process spills into its own subdirectory
+_PROC_TAG = f"p{os.getpid()}"
+
+
+def get_ice_root() -> str:
+    return _ICE_ROOT
+
+
+def set_ice_root(path: str):
+    """Point the spill tier somewhere else (tests use tmp dirs; the
+    memory manager's `ice_root` attribute delegates here)."""
+    global _ICE_ROOT
+    _ICE_ROOT = str(path)
+
+
+def chunk_dir() -> str:
+    return os.path.join(_ICE_ROOT, "chunks", _PROC_TAG)
+
+
+def write_chunk(key: str, data: np.ndarray, mask) -> str:
+    """Persist one chunk's packed planes; returns the spill path.
+    Uncompressed npz: the planes are already codec-packed, and spill
+    bandwidth (not disk footprint) is what bounds demotion."""
+    d = chunk_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{_SAFE.sub('_', key)}.npz")
+    arrays = {"data": np.asarray(data)}
+    if mask is not None:
+        arrays["mask"] = np.asarray(mask)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def read_chunk(path: str):
+    """(data, mask_or_None) packed host planes from a spill file."""
+    with np.load(path, allow_pickle=False) as npz:
+        data = npz["data"]
+        mask = npz["mask"] if "mask" in npz.files else None
+    return data, mask
+
+
+def delete_chunk(path: str):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

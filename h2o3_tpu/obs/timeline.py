@@ -59,6 +59,15 @@ class Span:
             return None
         return 1000.0 * (self.t_end - self.t_start)
 
+    def event(self, name: str, **attrs):
+        """Record a point-in-time event on this span (the OpenTelemetry
+        span-event analog): lands in attrs["events"] and is rendered by
+        /3/Timeline and GET /3/Trace/{id}. The DKV pager uses this to
+        mark chunk faults/evictions inside MRTask spans. Call from the
+        span's owning thread (same contract as mutating attrs)."""
+        self.attrs.setdefault("events", []).append(
+            dict({"name": name, "t": time.time()}, **attrs))
+
     def to_dict(self) -> dict:
         return {"name": self.name, "id": self.span_id,
                 "parent": self.parent_id, "host": self.host,

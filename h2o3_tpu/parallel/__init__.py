@@ -1,2 +1,3 @@
 from h2o3_tpu.parallel.mesh import Cloud, init, cloud, shutdown
-from h2o3_tpu.parallel.mrtask import map_reduce, shard_sum, map_chunks
+from h2o3_tpu.parallel.mrtask import (map_reduce, shard_sum, map_chunks,
+                                      map_chunked, prefetch_chunks)

@@ -130,25 +130,61 @@ def _traced_dispatch(name: str, jfn, arrays, fn):
     return jfn(*arrays)
 
 
-def map_reduce(fn, *arrays, donate=()):
+def prefetch_chunks(handles):
+    """Start tier-up of DKV chunk handles (Vecs or TierChunks) on the
+    pager's I/O worker — fire-and-forget, so a later fault finds the
+    planes already HBM-resident. The MRTask lookahead primitive."""
+    if not handles:
+        return
+    from h2o3_tpu.core import tiering as _tiering
+    _tiering.PAGER.prefetch(handles)
+
+
+def map_chunked(fn, chunks, *, lookahead: int = 1):
+    """Sequential MRTask over out-of-core chunk handles: run `fn(chunk)`
+    per handle, prefetching the NEXT `lookahead` handles' tier-up on the
+    pager's I/O thread overlapped with the current handle's compute —
+    the Cleaner-era "reload while the map runs" pipelining, chunk-shaped.
+    Returns the list of per-chunk results (reduce is the caller's fold)."""
+    seq = list(chunks)
+    out = []
+    queued = 0          # high-water mark: windows overlap, enqueue once
+    for i, c in enumerate(seq):
+        if lookahead > 0 and i + 1 < len(seq):
+            lo = max(queued, i + 1)
+            hi = i + 1 + lookahead
+            if hi > lo:
+                prefetch_chunks(seq[lo:hi])
+                queued = hi
+        out.append(fn(c))
+    return out
+
+
+def map_reduce(fn, *arrays, donate=(), prefetch=()):
     """Jit `fn` over row-sharded arrays; outputs get whatever sharding XLA
     propagates (scalars/small reductions come back replicated).
 
     `fn` is traced once and cached per shape/dtype signature by jax.jit.
+    `prefetch` takes chunk handles (Vecs) whose tier-up should overlap
+    this dispatch — typically the NEXT iteration's columns.
     """
+    prefetch_chunks(prefetch)
     jfn = cached_jit(fn, donate_argnums=donate)
     return _traced_dispatch("mrtask.map_reduce", jfn, arrays, fn)
 
 
-def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False):
+def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False,
+               prefetch=()):
     """shard_map `fn` over the rows axis: fn runs once per shard ("node"),
     seeing only its local rows, and may use lax.psum/ppermute over "rows".
 
     in_specs/out_specs default to row-sharded in, replicated out. The
     jitted shard_map wrapper is cached by (fn code+closure, mesh, specs):
     shard_map returns a fresh object per call, so an uncached jit here
-    re-traced on every invocation (R001).
+    re-traced on every invocation (R001). `prefetch` overlaps the next
+    chunk handles' tier-up with this dispatch (see map_chunked).
     """
+    prefetch_chunks(prefetch)
     c = _mesh.cloud()
     if in_specs is None:
         in_specs = tuple(P(_mesh.ROWS, *([None] * (a.ndim - 1))) for a in arrays)

@@ -114,9 +114,15 @@ def _decode_host(vec) -> np.ndarray:
         keep = rows < n
         out[rows[keep]] = vals[keep]
         return out
-    if vec.data is None:
+    ch = getattr(vec, "_chunk", None)
+    if ch is None:
         raise Ineligible(f"column type {vec.type!r} has no numeric staging")
-    data = np.asarray(jax.device_get(vec.data))[:n]
+    # tier-aware staging: resident host codec bytes are read in place
+    # (zero transfers); an HBM-only chunk costs ONE explicit device_get
+    # (transfer-guard-clean); a disk chunk loads to host WITHOUT faulting
+    # the packed planes into HBM just to copy them back out
+    data_h, mask_h = ch.staging_view()
+    data = np.asarray(data_h)[:n]
     c = vec.codec
     if c.kind == "const":
         out = np.full(n, np.float32(c.const_val), np.float32)
@@ -124,8 +130,8 @@ def _decode_host(vec) -> np.ndarray:
         out = data.astype(np.float32)
         if c.bias:
             out = out + np.float32(c.bias)
-    if vec.mask is not None:
-        m = np.asarray(jax.device_get(vec.mask))[:n]
+    if mask_h is not None:
+        m = np.asarray(mask_h)[:n]
         out = np.where(m != 0, np.float32(np.nan), out)
     return out
 

@@ -110,6 +110,74 @@ def test_r008_detects_timeoutless_queue_get_under_lock():
     assert "queue.get" in found[0].message
 
 
+def test_r007_detects_cycle_via_manual_acquire_release():
+    """The carried-forward gap: a pager-style I/O lock held across
+    explicit .acquire()/.release() must not dodge the order rules."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        self._la.acquire()\n"
+        "        try:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "        finally:\n"
+        "            self._la.release()\n"
+        "    def m2(self):\n"
+        "        with self._lb:\n"
+        "            self._la.acquire()\n"
+        "            self._la.release()\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_ma.py")
+             if f.rule == "R007"]
+    assert len(found) == 1
+    assert "lock-order cycle" in found[0].message
+
+
+def test_r008_detects_blocking_between_acquire_and_release():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def bad(self):\n"
+        "        self._lock.acquire()\n"
+        "        time.sleep(5)\n"
+        "        self._lock.release()\n"
+        "    def ok(self):\n"
+        "        self._lock.acquire()\n"
+        "        self._lock.release()\n"
+        "        time.sleep(5)\n")
+    found = [f for f in engine.analyze_source(src, "h2o3_tpu/fix_mb.py")
+             if f.rule == "R008"]
+    assert len(found) == 1 and found[0].line == 8
+    assert "time.sleep" in found[0].message
+
+
+def test_r007_trylock_acquire_adds_no_order_edge():
+    """acquire(blocking=False) cannot wait, so opposing try-lock order is
+    not a deadlock schedule (Linux lockdep's trylock rule)."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "    def m1(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def m2(self):\n"
+        "        with self._lb:\n"
+        "            if self._la.acquire(blocking=False):\n"
+        "                self._la.release()\n")
+    assert "R007" not in _rules_of(
+        engine.analyze_source(src, "h2o3_tpu/fix_mt.py"))
+
+
 def test_r008_bounded_wait_is_clean():
     src = (
         "import threading\n"

@@ -100,6 +100,28 @@ def test_lockdep_log_mode_counts_without_raising(lockdep_raise):
     assert lockdep.counts()["inversions"] == inv0 + 1
 
 
+def test_lockdep_manual_acquire_release_and_trylock(lockdep_raise):
+    """Manual .acquire()/.release() records order like with-blocks; a
+    non-blocking try-acquire records held-ness but no order edge (it
+    cannot wait, so it cannot complete a deadlock cycle)."""
+    lh = lockdep.make_lock("fixture.H")
+    li = lockdep.make_lock("fixture.I")
+    lh.acquire()
+    li.acquire()                       # manual nesting: H→I edge
+    li.release()
+    lh.release()
+    assert ("fixture.H", "fixture.I") in lockdep.edges()
+    inv0 = lockdep.counts()["inversions"]
+    with li:                           # opposing TRYLOCK: no inversion
+        assert lh.acquire(blocking=False)
+        lh.release()
+    assert lockdep.counts()["inversions"] == inv0
+    assert ("fixture.I", "fixture.H") not in lockdep.edges()
+    with li:                           # opposing BLOCKING acquire: raises
+        with pytest.raises(lockdep.LockOrderInversion):
+            lh.acquire()
+
+
 def test_lockdep_disabled_is_passthrough():
     lockdep.disable()
     lg = lockdep.make_lock("fixture.G")
@@ -213,3 +235,107 @@ def test_race_harness_dkv_scoring_scrapes_under_lockdep(glm, lockdep_raise,
         f"{lockdep.edges()}"
     for k in [k for k in DKV.keys() if k.startswith("race_obj_")]:
         DKV.remove(k)
+
+
+# ---------------------------------------------------------------------------
+# 3. the DKV tiering race harness (ISSUE 6)
+def test_tiering_race_harness_under_lockdep(lockdep_raise, tmp_path,
+                                            monkeypatch):
+    """Concurrent MRTask chunk iteration + DKV overwrite/delete + forced
+    demotion through the whole tier ladder, with the pager's
+    `tiering.io`/`tiering.residency` locks under lockdep raise mode: any
+    lock-order cycle between the pager, dkv, metrics.registry and
+    timeline.ring raises out of a worker and fails the test."""
+    from h2o3_tpu.core import tiering
+    from h2o3_tpu.core.frame import Frame
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu.core.memory import MANAGER
+    from h2o3_tpu.obs import metrics as om
+    from h2o3_tpu.obs.timeline import span
+    from h2o3_tpu.parallel import mrtask as mr
+
+    PAGER = tiering.PAGER
+    old_ice = MANAGER.ice_root
+    old_hbm, old_host = PAGER.hbm_budget, PAGER.host_budget
+    MANAGER.ice_root = str(tmp_path)
+    frames = [Frame.from_dict({f"x{j}": RNG.normal(size=4000)
+                               for j in range(4)}) for _ in range(3)]
+    per = frames[0].vecs[0]._chunk.nbytes
+    PAGER.hbm_budget = per * 5 + 128      # ~5 of 12 chunks fit: churn
+    PAGER.host_budget = per * 4 + 128     # force the disk tier too
+
+    inv = om.REGISTRY.get("h2o3_lockdep_inversions_total")
+    inv0 = inv.value()
+    edges0 = lockdep.counts()["edges"]
+    n_workers = 8
+    iters = 10
+    barrier = threading.Barrier(n_workers)
+    errors: list = []
+
+    def run(body):
+        def _loop():
+            try:
+                barrier.wait(timeout=30)
+                for i in range(iters):
+                    body(i)
+            except Exception as ex:   # noqa: BLE001 — collected, asserted
+                errors.append(ex)
+        return _loop
+
+    def iterate(i):
+        fr = frames[i % len(frames)]
+        with span("race.mrtask", i=i):
+            sums = mr.map_chunked(
+                lambda v: float(np.nansum(v.to_numpy())),
+                fr.vecs, lookahead=1)
+        assert len(sums) == 4
+
+    def dkv_churn(i):
+        key = f"tier_race_{i % 2}"
+        DKV.put(key, {"gen": i})
+        DKV.get(frames[i % len(frames)].key)      # fault-on-get path
+        DKV.atomic(key, lambda old: None if i % 3 == 2 else {"g": i})
+        DKV.stats()
+
+    def demote(i):
+        fr = frames[(i + 1) % len(frames)]
+        PAGER.demote(fr.vecs[i % 4]._chunk,
+                     tiering.TIER_DISK if i % 2 else tiering.TIER_HOST)
+        PAGER.maybe_demote()
+
+    def spill_reload(i):
+        fr = frames[i % len(frames)]
+        MANAGER.spill(fr.key)
+        MANAGER.load(fr.key)
+
+    def scrape(i):
+        text = om.REGISTRY.prometheus_text()
+        assert "h2o3_dkv_tier_bytes" in text
+        PAGER.stats()
+        MANAGER.stats()
+
+    bodies = ([iterate, iterate, iterate] + [dkv_churn, dkv_churn]
+              + [demote, spill_reload, scrape])
+    assert len(bodies) == n_workers
+    threads = [threading.Thread(target=run(b), daemon=True,
+                                name=f"tier-race-{b.__name__}-{j}")
+               for j, b in enumerate(bodies)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), \
+            "tiering race harness wedged — a worker never finished"
+        assert not errors, f"tiering race harness errors: {errors!r}"
+        assert lockdep.counts()["edges"] > edges0, \
+            "the pager's locks recorded no nesting — instrumentation dead"
+        assert inv.value() == inv0, \
+            f"lock-order inversion in the tier ladder: {lockdep.edges()}"
+    finally:
+        PAGER.hbm_budget, PAGER.host_budget = old_hbm, old_host
+        MANAGER.ice_root = old_ice
+        for fr in frames:
+            DKV.remove(fr.key)
+        for k in [k for k in DKV.keys() if k.startswith("tier_race_")]:
+            DKV.remove(k)

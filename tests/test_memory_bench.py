@@ -41,11 +41,16 @@ def test_budget_lru_spills_cold_frame(tmp_path):
     try:
         cold = Frame.from_dict({"x": np.zeros(20000)})
         MANAGER.budget = MANAGER.total_bytes() + 1000   # barely above usage
-        hot = Frame.from_dict({"y": np.zeros(20000)})   # triggers clean
-        assert MANAGER.is_spilled(cold.key)
-        assert not MANAGER.is_spilled(hot.key)
+        hot = Frame.from_dict({"y": np.zeros(20000)})   # born cold under
+        hot.vec("y").to_numpy()       # budget; first access faults it in
+        # chunk-granular tiering: admitting the hot frame demotes the
+        # COLD frame's chunks out of HBM (to the host codec-byte tier),
+        # the hot frame stays device-resident, access faults back
+        assert not MANAGER.is_hbm_resident(cold.key)
+        assert MANAGER.is_hbm_resident(hot.key)
         back = DKV.get(cold.key)
         assert back.nrows == 20000
+        assert np.allclose(back.vec("x").to_numpy()[:5], 0.0)
     finally:
         MANAGER.budget = old_budget
         MANAGER.ice_root = old_ice
