@@ -49,38 +49,49 @@ def main(argv=None) -> int:
     findings = engine.analyze_modules(mods, rules=rules)
 
     if args.write_census is not None or args.check_census:
-        from h2o3_tpu.analysis import rules_metrics
-        # the census is PACKAGE metrics by definition — independent of
-        # which paths this invocation analyzes (the hook passes tests/
-        # too, which must not leak fixture metrics into the census).
-        # When the analyzed paths cover the whole package (the hook's
-        # `h2o3_tpu tests` spelling), filter the already-parsed modules
-        # instead of re-reading the tree; re-load only for partial runs.
+        from h2o3_tpu.analysis import rules_metrics, rules_spans
+        # the censuses are PACKAGE metrics/spans by definition —
+        # independent of which paths this invocation analyzes (the hook
+        # passes tests/ too, which must not leak fixture names into a
+        # census). When the analyzed paths cover the whole package (the
+        # hook's `h2o3_tpu tests` spelling), filter the already-parsed
+        # modules instead of re-reading the tree; re-load only for
+        # partial runs.
         pkg_root = engine.package_root()
         if any(os.path.abspath(p) == pkg_root for p in paths):
             pkg_mods = [m for m in mods
                         if m.path.startswith(pkg_root + os.sep)]
         else:
             pkg_mods = engine.load_modules([pkg_root])
-        body = rules_metrics.census_markdown(pkg_mods)
-        default_path = os.path.join(engine.package_root(), "obs",
-                                    "METRICS.md")
+        censuses = [
+            (rules_metrics.census_markdown(pkg_mods), "metric",
+             os.path.join(engine.package_root(), "obs", "METRICS.md")),
+            (rules_spans.census_markdown(pkg_mods), "span",
+             os.path.join(engine.package_root(), "obs", "SPANS.md")),
+        ]
         if args.write_census is not None:
-            out = args.write_census
-            if out == "__default__":
-                out = default_path
-            with open(out, "w", encoding="utf-8") as fh:
-                fh.write(body)
-            print(f"census written: {out}", file=sys.stderr)
+            targets = censuses
+            if args.write_census != "__default__":
+                # explicit path: the metric census only (legacy
+                # spelling). Leave `censuses` itself alone — the
+                # --check-census gate below must keep comparing the
+                # COMMITTED files, not the file just written
+                targets = [(censuses[0][0], "metric", args.write_census)]
+            for body, _, out in targets:
+                with open(out, "w", encoding="utf-8") as fh:
+                    fh.write(body)
+                print(f"census written: {out}", file=sys.stderr)
         if args.check_census:
-            have = ""
-            if os.path.exists(default_path):
-                with open(default_path, encoding="utf-8") as fh:
-                    have = fh.read()
-            if have != body:
-                print("stale metric census — run: python -m "
-                      "h2o3_tpu.analysis --write-census", file=sys.stderr)
-                return 1
+            for body, what, path in censuses:
+                have = ""
+                if os.path.exists(path):
+                    with open(path, encoding="utf-8") as fh:
+                        have = fh.read()
+                if have != body:
+                    print(f"stale {what} census — run: python -m "
+                          "h2o3_tpu.analysis --write-census",
+                          file=sys.stderr)
+                    return 1
 
     if args.baseline and not args.write_baseline:
         engine.apply_baseline(findings, engine.load_baseline(args.baseline))

@@ -62,6 +62,15 @@ def _parent_map(tree):
     return {c: p for p in ast.walk(tree) for c in ast.iter_child_nodes(p)}
 
 
+def _enclosing_class(node: ast.AST, parents: dict) -> str:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = parents.get(cur)
+    return ""
+
+
 def _registry_names(mod: Module) -> set:
     """Declaration helpers this module imported from the obs registry
     (`from h2o3_tpu.obs.metrics import counter, histogram`)."""
@@ -122,6 +131,14 @@ def collect(mods: list):
                     for t in parent.targets:
                         if isinstance(t, ast.Name):
                             var_to_name[t.id] = name
+                        elif isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id in ("self", "cls"):
+                            # instance-attribute metric (self._burn = …),
+                            # scoped by class so two classes in one module
+                            # can't cross-wire each other's attrs
+                            cls = _enclosing_class(parent, parents)
+                            var_to_name[f"{cls}.{t.attr}"] = name
             elif not _is_registry_call(node, local_decl):
                 pass   # np.histogram(...) and friends — not a metric
             elif isinstance(first, ast.Name) and \
@@ -141,12 +158,29 @@ def collect(mods: list):
             if node.func.attr not in _EMIT_FNS:
                 continue
             recv = node.func.value
-            if not (isinstance(recv, ast.Name)
-                    and recv.id in var_to_name):
+            key = None
+            if isinstance(recv, ast.Name) and recv.id in var_to_name:
+                key = recv.id
+            elif isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id in ("self", "cls"):
+                k = f"{_enclosing_class(node, parents)}.{recv.attr}"
+                if k in var_to_name:
+                    key = k
+            if key is None:
                 continue
-            name = var_to_name[recv.id]
-            labels = frozenset(kw.arg for kw in node.keywords
-                               if kw.arg is not None)
+            name = var_to_name[key]
+            # `exemplar` is the reserved OpenMetrics exemplar kwarg on
+            # HISTOGRAM observe/time only — there it is not a label, and
+            # passing it at one site but not another must not split the
+            # series. On inc()/set() no such parameter exists: the kwarg
+            # would land in **labels and mint a series per trace id, so
+            # it must stay visible to the cardinality check.
+            labels = frozenset(
+                kw.arg for kw in node.keywords
+                if kw.arg is not None
+                and not (kw.arg == "exemplar"
+                         and node.func.attr in ("observe", "time")))
             for entry in decls.get(name, []):
                 if entry["file"] == mod.rel:
                     entry.setdefault("emissions", []).append(

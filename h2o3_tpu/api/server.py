@@ -190,6 +190,16 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if tid is not None:
                 with _span("rest.request", method=method) as sp:
+                    # X-H2O3-Sample: 1 pins this trace through the flight
+                    # recorder's tail sampler regardless of outcome — both
+                    # via the root attr (read at trace completion) and via
+                    # pin() at ENTRY, so a fragment finalized while the
+                    # root is still open (linger expiry, span-count
+                    # overflow) is retained too
+                    if self.headers.get("X-H2O3-Sample") == "1":
+                        sp.attrs["sampled"] = 1
+                        from h2o3_tpu.obs import recorder as _obs_rec
+                        _obs_rec.RECORDER.pin(tid)
                     self._route_inner(method)
                     sp.attrs["route"] = self._route_label
                     sp.attrs["status"] = self._status or 0
@@ -197,8 +207,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._route_inner(method)
         finally:
             _tracing.set_current(prev_trace)
+            # the trace id rides the histogram as an OpenMetrics exemplar:
+            # a Grafana latency spike clicks through to GET /3/Trace/{id}
             REQUEST_SECONDS.observe(
-                _time_mod.perf_counter() - t0,
+                _time_mod.perf_counter() - t0, exemplar=tid,
                 route=self._route_label, method=method,
                 status=str(self._status or 0))
 
@@ -230,7 +242,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # tags its replayed spans with the ORIGINATING request's
                 # trace
                 bc.broadcast(method, path, params,
-                             trace=getattr(self, "_trace_id", None))
+                             trace=getattr(self, "_trace_id", None),
+                             sampled=self.headers.get(
+                                 "X-H2O3-Sample") == "1")
             for pat, m, fn in ROUTES:
                 if m != method:
                     continue
@@ -259,7 +273,7 @@ def _is_obs_path(path: str) -> bool:
     profiles THIS node, and the jax profiler is process-global state the
     replay barrier must not serialize behind."""
     return path in ("/metrics", "/3/Timeline", "/3/WaterMeter",
-                    "/3/Profiler") \
+                    "/3/Profiler", "/3/Traces", "/3/Alerts") \
         or path.startswith("/3/Logs") or path.startswith("/3/Trace/")
 
 
@@ -721,19 +735,30 @@ def _h_timeline(h: _Handler):
 
 
 def _h_trace(h: _Handler, tid):
-    """GET /3/Trace/{id} — the Dapper-style stitched view of one request:
-    this host's spans for the trace plus every worker's (spans a replayed
-    request recorded remotely carry the originating trace id), merged and
-    time-sorted. Bounded by the same collect deadline as /3/Timeline."""
+    """GET /3/Trace/{id} — the Dapper-style stitched view of one request,
+    read through ring → disk → cluster: this host's timeline ring, then
+    the flight recorder's durable segments (so a trace evicted from the
+    ring — or recorded by a PREVIOUS process over the same ice_root — is
+    still answerable), then every worker's fragments over the replay
+    channel. Bounded by the same collect deadline as /3/Timeline."""
+    from h2o3_tpu.obs import recorder as _obs_rec
     from h2o3_tpu.obs import timeline as _obs_tl
-    spans = _obs_tl.SPANS.trace_snapshot(tid)
-    hosts = [{"host": _obs_tl.host_id(), "n_spans": len(spans)}]
+    spans, disk = _obs_rec.RECORDER.read_through(
+        tid, _obs_tl.SPANS.trace_snapshot(tid))
+    seen = {(s.get("host"), s.get("id")) for s in spans}
+    hosts = [{"host": _obs_tl.host_id(), "n_spans": len(spans),
+              "from_disk": disk}]
     bc = getattr(h.server, "broadcaster", None)
     if bc is not None:
         for i, remote in enumerate(bc.collect(f"trace:{tid}",
                                               timeout=_collect_timeout())):
             if isinstance(remote, dict):
-                rs = remote.get("spans", [])
+                # dedup against what the shared-ice_root disk read already
+                # loaded: a worker's collect reply re-reads the same
+                # segments its own recorder wrote
+                rs = [s for s in remote.get("spans", [])
+                      if (s.get("host"), s.get("id")) not in seen]
+                seen.update((s.get("host"), s.get("id")) for s in rs)
                 spans.extend(rs)
                 hosts.append({"host": remote.get("host", i + 1),
                               "n_spans": len(rs)})
@@ -744,6 +769,51 @@ def _h_trace(h: _Handler, tid):
     h._send({"__meta": {"schema_type": "TraceV3"},
              "trace_id": tid, "spans": spans, "hosts": hosts,
              "n_spans": len(spans)})
+
+
+def _h_traces(h: _Handler):
+    """GET /3/Traces — flight-recorder trace search: the timeline ring
+    plus the durable segments under ice_root, grouped into per-trace
+    summaries. Filters: route= (substring of the rest.request route),
+    name= (substring of any span name), status= ("error", a code, or
+    "all"), min_ms= (min span duration), since=/until= (unix seconds on
+    trace start), limit= (default 50)."""
+    from h2o3_tpu.obs import recorder as _obs_rec
+    from h2o3_tpu.obs import timeline as _obs_tl
+    p = h._params()
+
+    def _f(key):
+        v = p.get(key)
+        return float(v) if v not in (None, "") else None
+
+    try:
+        min_ms, since, until = _f("min_ms"), _f("since"), _f("until")
+        limit = int(p.get("limit") or 50)
+    except ValueError:
+        # a client typo is a 400, never a 5xx: a 500 here would itself be
+        # tail-retained as an error trace and burn the availability SLO
+        return h._error("min_ms/since/until/limit must be numeric", 400)
+    out = _obs_rec.RECORDER.search(
+        name=p.get("name") or None, route=p.get("route") or None,
+        status=p.get("status") or None, min_ms=min_ms,
+        since=since, until=until, limit=limit,
+        extra_spans=_obs_tl.SPANS.snapshot())
+    h._send({"__meta": {"schema_type": "TracesV3"},
+             "traces": out, "n_traces": len(out),
+             "recorder_bytes": _obs_rec.RECORDER.disk_bytes()})
+
+
+def _h_alerts(h: _Handler):
+    """GET /3/Alerts — the SLO engine's live view: declared specs, fresh
+    burn rates (an evaluate() runs on every call, so the response never
+    trails the background period), and per-SLO alert states with the
+    episode trace id each firing recorded."""
+    from h2o3_tpu.obs import slo as _slo
+    alerts = _slo.ENGINE.evaluate()
+    h._send({"__meta": {"schema_type": "AlertsV3"},
+             "slos": [s.to_dict() for s in _slo.ENGINE.specs()],
+             "alerts": alerts,
+             "firing": [a["slo"] for a in alerts if a.get("firing")]})
 
 
 def _cluster_metric_snapshots(h: _Handler):
@@ -772,17 +842,27 @@ def _h_metrics(h: _Handler):
     """GET /metrics — Prometheus text exposition of the process registry.
     `?scope=cluster` federates: every host's snapshot is collected over
     the replay channel and merged under a per-host host= label (counters/
-    histograms stay summable; gauges stay per-host)."""
+    histograms stay summable; gauges stay per-host). When the scraper
+    negotiates OpenMetrics (Accept: application/openmetrics-text, or
+    ?format=openmetrics), the single-host body carries histogram
+    EXEMPLARS — the trace ids latency observations recorded — which
+    Prometheus stores under --enable-feature=exemplar-storage; the
+    cluster merge stays 0.0.4 (exemplars are process-local)."""
     from h2o3_tpu.obs import metrics as _obs_m
     _obs_m.install_runtime_gauges()
-    if h._params().get("scope") == "cluster":
+    p = h._params()
+    ctype = "text/plain; version=0.0.4; charset=utf-8"
+    if p.get("scope") == "cluster":
         snaps, _ = _cluster_metric_snapshots(h)
         body = _obs_m.cluster_prometheus_text(snaps).encode()
+    elif "openmetrics" in (h.headers.get("Accept") or "") \
+            or p.get("format") == "openmetrics":
+        body = _obs_m.REGISTRY.openmetrics_text().encode()
+        ctype = "application/openmetrics-text; version=1.0.0; charset=utf-8"
     else:
         body = _obs_m.REGISTRY.prometheus_text().encode()
     h.send_response(200)
-    h.send_header("Content-Type",
-                  "text/plain; version=0.0.4; charset=utf-8")
+    h.send_header("Content-Type", ctype)
     h.send_header("Content-Length", str(len(body)))
     h.end_headers()
     if getattr(h, "command", "") != "HEAD":
@@ -814,22 +894,68 @@ def _h_profiler(h: _Handler):
     capture (jax.profiler device trace, or the pure-Python sampling
     fallback when unavailable); action=stop ends it and returns the
     artifact dir. One session at a time — a concurrent start answers
-    409."""
+    409.
+
+    `cluster=1` fans the action out over the replay channel: every
+    worker starts/stops its OWN session, stop gathers each host's
+    sampling flamegraph within the collect deadline (a stalled host is
+    listed in lagging_hosts, never waited on), and the collapsed stacks
+    merge into ONE host-prefixed pyprof.merged.collapsed under the
+    coordinator's artifact dir (the local raw capture stays intact)."""
     from h2o3_tpu.obs import profiler as _prof
+    from h2o3_tpu.obs import timeline as _obs_tl
     p = h._params()
     action = str(p.get("action") or "").lower()
+    cluster = str(p.get("cluster", "")).lower() in ("1", "true", "yes")
+    bc = getattr(h.server, "broadcaster", None)
+    kind = str(p.get("kind") or "auto")
     try:
         if action == "start":
             out = _prof.PROFILER.start(trace_dir=p.get("trace_dir") or None,
-                                       kind=str(p.get("kind") or "auto"))
+                                       kind=kind)
         elif action == "stop":
             out = _prof.PROFILER.stop()
         else:
             return h._error("action must be start|stop", 400)
     except _prof.ProfilerBusy as ex:
         return h._error(str(ex), 409)
-    except (_prof.ProfilerIdle, ValueError) as ex:
+    except _prof.ProfilerIdle as ex:
+        if not (cluster and bc is not None and action == "stop"):
+            return h._error(str(ex), 400)
+        # a locally-dead session (out-of-band stop, coordinator restart)
+        # must not strand the workers' sessions sampling forever — fan
+        # the stop out anyway and answer with their artifacts
+        out = {"status": "idle", "error": str(ex)}
+    except ValueError as ex:
         return h._error(str(ex), 400)
+    if cluster and bc is not None:
+        op = f"profiler:start:{kind}" if action == "start" \
+            else "profiler:stop"
+        hosts = [{"host": _obs_tl.host_id(), **out}]
+        lagging = []
+        parts = []      # (host, collapsed_text) for the merged flamegraph
+        if action == "stop" and out.get("artifact"):
+            parts.append((_obs_tl.host_id(),
+                          _prof.read_collapsed(out["artifact"])))
+        for i, remote in enumerate(bc.collect(op,
+                                              timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                if remote.get("collapsed"):
+                    parts.append((remote.get("host", i + 1),
+                                  remote["collapsed"]))
+                hosts.append({k: v for k, v in remote.items()
+                              if k != "collapsed"})
+            else:
+                lagging.append(i + 1)
+        out = dict(out, hosts=hosts, lagging_hosts=lagging)
+        if action == "stop" and parts:
+            dest = out.get("dir")
+            if not dest:        # local session was idle: workers' artifacts
+                import tempfile  # still need a home for the merge
+                dest = out["dir"] = tempfile.mkdtemp(prefix="h2o3-profile-")
+            merged = _prof.merge_collapsed(parts, dest)
+            if merged:
+                out["merged_flamegraph"] = merged
     h._send({"__meta": {"schema_type": "ProfilerV3"}, **out})
 
 
@@ -887,6 +1013,8 @@ ROUTES = [
     (re.compile(r"/3/Logs/nodes/([^/]+)/files/([^/]+)"), "GET", _h_logs),
     (re.compile(r"/3/Timeline"), "GET", _h_timeline),
     (re.compile(r"/3/Trace/([^/]+)"), "GET", _h_trace),
+    (re.compile(r"/3/Traces"), "GET", _h_traces),
+    (re.compile(r"/3/Alerts"), "GET", _h_alerts),
     (re.compile(r"/metrics"), "GET", _h_metrics),
     (re.compile(r"/3/WaterMeter"), "GET", _h_watermeter),
     (re.compile(r"/3/Profiler"), "POST", _h_profiler),
@@ -1000,6 +1128,10 @@ class H2OServer:
         # H2O3_TRANSFER_GUARD) — no-op unless a deployment flips them
         from h2o3_tpu.analysis import sanitizers as _san
         _san.install_from_env()
+        # SLO engine: load H2O3_SLO_FILE specs and start the background
+        # burn-rate evaluator (idle when the env is unset)
+        from h2o3_tpu.obs import slo as _slo
+        _slo.install_from_env()
         if background:
             self.thread = threading.Thread(target=self.httpd.serve_forever,
                                            daemon=True, name="h2o3-rest")

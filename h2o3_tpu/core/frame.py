@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.core import tiering as _tiering
 from h2o3_tpu.parallel import mesh as _mesh
@@ -124,6 +125,12 @@ class Rollups:
     nas: int
     zeros: int
     is_int: bool
+
+
+# one rollup device dispatch in flight at a time, process-wide: leaf
+# lock (nothing else is acquired under it; mrtask's jit-wrapper cache
+# lock below it is itself a leaf)
+_ROLLUP_LOCK = make_lock("vec.rollups")
 
 
 class Vec:
@@ -285,9 +292,19 @@ class Vec:
 
     # ---- rollups (lazy, cached) -----------------------------------------
     def rollups(self) -> Rollups:
-        if self._rollups is None:
-            self._rollups = self._compute_rollups()
-        return self._rollups
+        r = self._rollups
+        if r is None:
+            # compute-once, process-wide: parallel model builds (grid
+            # search) all roll up the shared training frame's vecs at
+            # the same instant, and N simultaneous dispatches of the
+            # same sharded program can rendezvous-deadlock XLA:CPU on
+            # small hosts — at most one rollup kernel may be in flight,
+            # and N-1 of the stampede's results were discarded anyway
+            with _ROLLUP_LOCK:
+                r = self._rollups
+                if r is None:
+                    r = self._rollups = self._compute_rollups()  # h2o3-ok: R008 intentional: the whole point of the lock is one rollup device dispatch in flight at a time
+        return r
 
     def _compute_rollups(self) -> Rollups:
         if self.type == T_STR:
@@ -304,7 +321,8 @@ class Vec:
                        mean, sigma, n_real_na, int(zeros), frac == 0.0)
 
     def invalidate_rollups(self):
-        self._rollups = None
+        with _ROLLUP_LOCK:
+            self._rollups = None
 
     # convenience accessors (Vec.min()/max()/mean()/sigma()/naCnt())
     def min(self): return self.rollups().min

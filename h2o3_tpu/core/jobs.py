@@ -75,8 +75,18 @@ class Job:
             try:
                 with _tr.trace(parent_trace), \
                         span("job.run", job=self.key,
-                             description=self.description):
-                    result = work(self)
+                             description=self.description) as _sp:
+                    try:
+                        result = work(self)
+                    except JobCancelled:
+                        raise
+                    except BaseException as e:
+                        # tag the span before it closes: the `error` attr
+                        # is what the flight recorder's tail sampler keys
+                        # on — without it a fast-failing traced job loses
+                        # the downsample lottery
+                        _sp.attrs["error"] = repr(e)
+                        raise
                 if result is not None and self.dest:
                     DKV.put(self.dest, result)
                 self.progress = 1.0
@@ -116,6 +126,7 @@ class Job:
         from h2o3_tpu.obs.timeline import span
         t0 = time.time()
         try:
+            # h2o3-ok: R011 phase names are builder-supplied data (init/train/score), bounded by the algo's phase() calls
             with span(f"job.{name}", job=self.key):
                 yield
         finally:

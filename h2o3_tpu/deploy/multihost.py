@@ -360,7 +360,8 @@ class Broadcaster:
             f"{_ack_timeout():g}s during {what} — SPMD replay is wedged "
             "(H2O3_REPLAY_ACK_TIMEOUT_S bounds this wait)")
 
-    def broadcast(self, method: str, path: str, params: dict, trace=None):
+    def broadcast(self, method: str, path: str, params: dict, trace=None,
+                  sampled=False):
         import socket as _socket
         import time as _time
         with self._lock:
@@ -372,6 +373,10 @@ class Broadcaster:
                 # originating request's trace id: workers replay under it
                 # so their spans stitch into GET /3/Trace/{id}
                 msg["trace"] = trace
+            if sampled:
+                # X-H2O3-Sample pin travels too: each worker's flight
+                # recorder retains its fragment of the pinned trace
+                msg["sampled"] = 1
             try:
                 for i, (c, key) in enumerate(self._conns):
                     self._drain_owed(i, deadline)
@@ -485,11 +490,21 @@ def _collect_local(op: str):
             return {"host": _tl.host_id(),
                     "metrics": _m.REGISTRY.to_dict()}
         if op.startswith("trace:"):
-            # GET /3/Trace/{id} stitching: this host's spans for ONE trace
+            # GET /3/Trace/{id} read-through: this host's ring spans for
+            # ONE trace plus whatever its flight recorder retained
+            from h2o3_tpu.obs import recorder as _rec
             from h2o3_tpu.obs import timeline as _tl
-            return {"host": _tl.host_id(),
-                    "spans": _tl.SPANS.trace_snapshot(op[len("trace:"):],
-                                                      limit=512)}
+            tid = op[len("trace:"):]
+            spans, _n = _rec.RECORDER.read_through(
+                tid, _tl.SPANS.trace_snapshot(tid, limit=512), limit=512)
+            return {"host": _tl.host_id(), "spans": spans}
+        if op.startswith("profiler:"):
+            # cluster-wide capture fan-out (POST /3/Profiler?cluster=1):
+            # start/stop this host's profiler session; a sampling stop
+            # ships the collapsed flamegraph text back in the ack
+            from h2o3_tpu.obs import profiler as _prof
+            from h2o3_tpu.obs import timeline as _tl
+            return {"host": _tl.host_id(), **(_prof.collect_op(op) or {})}
     except Exception:   # noqa: BLE001 — a worker probe error must not kill the loop
         import traceback
         traceback.print_exc()
@@ -548,8 +563,22 @@ def worker_loop(coordinator_host: str, port: int):
             from h2o3_tpu.obs.timeline import span as _span
             with _tr.trace(msg.get("trace")), \
                     _span("replay.request", path=msg["path"],
-                          method=msg["method"]):
-                replay_request(msg["method"], msg["path"], msg["params"])
+                          method=msg["method"]) as _sp:
+                if msg.get("sampled"):
+                    # attr marks the fragment root; pin() covers pieces
+                    # finalized before it closes (linger, span overflow)
+                    _sp.attrs["sampled"] = 1
+                    from h2o3_tpu.obs import recorder as _rec
+                    _rec.RECORDER.pin(msg.get("trace"))
+                try:
+                    replay_request(msg["method"], msg["path"],
+                                   msg["params"])
+                except Exception as e:
+                    # the error attr makes THIS host's recorder retain
+                    # its fragment of the failed trace — the 5xx status
+                    # lives only on the coordinator's root span
+                    _sp.attrs["error"] = repr(e)
+                    raise
         except Exception:                 # keep replaying; process 0 owns
             import traceback              # error reporting to the client
             traceback.print_exc()

@@ -792,6 +792,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         path = []
         for lam in lams:
             for it in range(max(1, max_it)):
+                # h2o3-ok: R011 same IRLSM phase as the multinomial sweep below — family= attr disambiguates
                 with _span("glm.irlsm", iter=it, lam=float(lam),
                            family=fam):
                     _IRLSM_ITERS.inc()

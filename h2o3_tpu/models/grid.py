@@ -25,6 +25,27 @@ import numpy as np
 
 from h2o3_tpu.core.kvstore import DKV
 
+# XLA's CPU client shares ONE collective thread pool across concurrently
+# launched programs: two in-flight 8-replica executions each park a subset
+# of their participants at the AllGather rendezvous and starve each other
+# forever (collective_ops_utils.h "may be stuck"). Concurrent builds on a
+# host-platform mesh therefore run their train() calls one at a time —
+# the WHOLE call, not just dispatch, because async execution outlives the
+# launch and must not overlap the next build's collectives. Accelerator
+# runtimes queue per-device and interleave fine, so they keep full
+# overlap. (Other concurrent multi-replica dispatch paths share the
+# hazard on host meshes — see the ROADMAP item on hoisting this into the
+# shared dispatch layer.)
+_HOST_COLLECTIVE_LOCK = threading.Lock()
+
+
+def _needs_device_serialization() -> bool:
+    try:
+        import jax
+        return jax.default_backend() == "cpu" and jax.device_count() > 1
+    except Exception:  # noqa: BLE001 — no jax, nothing to serialize
+        return False
+
 
 class H2OGridSearch:
     def __init__(self, model, hyper_params: dict, grid_id=None,
@@ -109,8 +130,13 @@ class H2OGridSearch:
             params["model_id"] = model_id
             try:
                 m = self._cls(**params)
-                m.train(x=x, y=y, training_frame=training_frame,
-                        validation_frame=validation_frame)
+                if _needs_device_serialization():
+                    with _HOST_COLLECTIVE_LOCK:
+                        m.train(x=x, y=y, training_frame=training_frame,
+                                validation_frame=validation_frame)
+                else:
+                    m.train(x=x, y=y, training_frame=training_frame,
+                            validation_frame=validation_frame)
                 with self._lock:
                     self.models.append(m)
                 if recovery is not None:

@@ -543,8 +543,9 @@ def _memo_trainer(grower: BinnedGrower, cache_key, build_run, mesh,
     if mesh is not None:
         if grower.axis_name is None:
             raise ValueError("mesh given but grower has no axis_name")
-        fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_specs, check_vma=False))
+        from h2o3_tpu.parallel.compat import shard_map as _shard_map
+        fn = jax.jit(_shard_map(run, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_vma=False))
     else:
         fn = jax.jit(run)
     cache[cache_key] = fn

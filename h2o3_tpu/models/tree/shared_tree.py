@@ -681,7 +681,7 @@ class H2OGradientBoostingEstimator(SharedTreeEstimator):
         while done < ntrees:
             k = min(interval, ntrees - done)
             with job.phase("grow"), \
-                    _span("gbm.chunk", trees=k * K, rows=n,
+                    _span("gbm.chunk", trees=k * K, rows=n,  # h2o3-ok: R011 same stage as binomial path, engine= attr disambiguates
                           engine="binned_multinomial"):
                 trainer = BN.gbm_multi_chunk_trainer(
                     grower, n, n_classes=K, eta=lr, sample_rate=sample_rate,

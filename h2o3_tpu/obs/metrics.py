@@ -17,6 +17,7 @@ the registry) is what gets merged cloud-wide.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Callable, Optional
 
 # Default latency buckets (seconds): sub-ms dispatches up to multi-minute
@@ -147,7 +148,12 @@ class Histogram(_Metric):
         super().__init__(name, help)
         self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels):
+        """Record one observation. `exemplar` is NOT a label: it is an
+        OpenMetrics exemplar — typically the observing request's trace id
+        — remembered per bucket and emitted by openmetrics_text() so a
+        latency spike on a dashboard clicks through to a stored trace."""
         k = _label_key(labels)
         v = float(value)
         with self._lock:
@@ -161,9 +167,15 @@ class Histogram(_Metric):
                     st["counts"][i] += 1
                     break
             else:
+                i = len(self.buckets)
                 st["counts"][-1] += 1
             st["sum"] += v
             st["count"] += 1
+            if exemplar:
+                # last-write-wins per bucket: the freshest exemplar is
+                # the most likely to still be in the flight recorder
+                st.setdefault("exemplars", {})[i] = (
+                    str(exemplar), v, _time.time())
 
     def time(self, **labels):
         """Context manager: observe the block's wall time in seconds."""
@@ -188,22 +200,40 @@ class Histogram(_Metric):
             return {"sum": st["sum"], "count": st["count"],
                     "counts": list(st["counts"])}
 
-    def _expose(self) -> list:
+    def series_snapshots(self) -> list:
+        """[(labels_dict, {"sum","count","counts"})] for every live
+        series — the SLO engine's window sampler walks this."""
+        with self._lock:
+            return [(dict(k), {"sum": s["sum"], "count": s["count"],
+                               "counts": list(s["counts"])})
+                    for k, s in sorted(self._series.items())]
+
+    def _expose(self, exemplars: bool = False) -> list:
+        """Cumulative-bucket text exposition; with `exemplars` (the
+        OpenMetrics renderer) each bucket a stored exemplar covers gets
+        `... # {trace_id="<id>"} <value> <unix_ts>` appended."""
         with self._lock:
             items = sorted((k, {"counts": list(s["counts"]),
-                                "sum": s["sum"], "count": s["count"]})
+                                "sum": s["sum"], "count": s["count"],
+                                "ex": dict(s.get("exemplars") or {})
+                                if exemplars else {}})
                            for k, s in self._series.items())
         lines = []
         for k, st in items:
             cum = 0
-            for ub, c in zip(self.buckets, st["counts"]):
+            bounds = [(_fmt_num(ub), c)
+                      for ub, c in zip(self.buckets, st["counts"])]
+            bounds.append(("+Inf", st["counts"][-1]))
+            for i, (le, c) in enumerate(bounds):
                 cum += c
-                lines.append(f"{self.name}_bucket"
-                             f"{_fmt_labels(k, (('le', _fmt_num(ub)),))}"
-                             f" {cum}")
-            cum += st["counts"][-1]
-            lines.append(f"{self.name}_bucket"
-                         f"{_fmt_labels(k, (('le', '+Inf'),))} {cum}")
+                line = (f"{self.name}_bucket"
+                        f"{_fmt_labels(k, (('le', le),))} {cum}")
+                ex = st["ex"].get(i)
+                if ex is not None:
+                    tid, v, ts = ex
+                    line += (f' # {{trace_id="{_escape(tid)}"}} '
+                             f"{_fmt_num(v)} {ts:.3f}")
+                lines.append(line)
             lines.append(f"{self.name}_sum{_fmt_labels(k)}"
                          f" {_fmt_num(st['sum'])}")
             lines.append(f"{self.name}_count{_fmt_labels(k)} {st['count']}")
@@ -271,6 +301,27 @@ class MetricsRegistry:
             out.append(f"# HELP {m.name} {_escape(m.help)}")
             out.append(f"# TYPE {m.name} {m.kind}")
             out.extend(m._expose())
+        return "\n".join(out) + "\n"
+
+    def openmetrics_text(self) -> str:
+        """OpenMetrics 1.0 exposition — what Prometheus negotiates (via
+        Accept) when --enable-feature=exemplar-storage wants exemplars.
+        Differences from 0.0.4 that matter here: counter families drop
+        the _total suffix in metadata (samples keep it), histogram
+        _bucket samples may carry `# {trace_id="..."} value ts`
+        exemplars, and the body terminates with `# EOF`."""
+        out = []
+        for m in self.metrics():
+            family = m.name
+            if m.kind == "counter" and family.endswith("_total"):
+                family = family[: -len("_total")]
+            out.append(f"# HELP {family} {_escape(m.help)}")
+            out.append(f"# TYPE {family} {m.kind}")
+            if isinstance(m, Histogram):
+                out.extend(m._expose(exemplars=True))
+            else:
+                out.extend(m._expose())
+        out.append("# EOF")
         return "\n".join(out) + "\n"
 
     def to_dict(self) -> dict:

@@ -168,3 +168,72 @@ class ProfilerManager:
 
 
 PROFILER = ProfilerManager()
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide capture (ISSUE 7). POST /3/Profiler?cluster=1 fans
+# start/stop over the replay channel's collect op; each worker runs its
+# own PROFILER session and ships its sampling flamegraph back as text
+# (bounded), and the coordinator merges every host's collapsed stacks —
+# each line prefixed host<N>; — into ONE flamegraph-ready file.
+_MAX_COLLAPSED_BYTES = 256 * 1024
+
+
+def read_collapsed(path: str, max_bytes: int = _MAX_COLLAPSED_BYTES) -> str:
+    """A pyprof.collapsed artifact as text, truncated at a line boundary
+    so it can ride a JSON collect ack without blowing the frame bound."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read(max_bytes + 1)
+    except OSError:
+        return ""
+    if len(text) > max_bytes:
+        text = text[:max_bytes]
+        text = text[: text.rfind("\n") + 1]
+    return text
+
+
+def collect_op(op: str):
+    """Worker-side handler for the profiler collect ops
+    ("profiler:start:<kind>" / "profiler:stop") — runs inside
+    _collect_local on the replay channel, so errors answer as data, never
+    as a dead worker slot."""
+    try:
+        if op.startswith("profiler:start:"):
+            kind = op[len("profiler:start:"):] or "auto"
+            return PROFILER.start(kind=kind)
+        if op == "profiler:stop":
+            out = PROFILER.stop()
+            if out.get("artifact"):
+                out["collapsed"] = read_collapsed(out["artifact"])
+            return out
+    except (ProfilerBusy, ProfilerIdle, ValueError) as ex:
+        return {"status": "error", "error": str(ex)}
+    return {"status": "error", "error": f"unknown profiler op {op!r}"}
+
+
+def merge_collapsed(parts, out_dir: str) -> str | None:
+    """[(host, collapsed_text)] → one host-prefixed flamegraph file
+    (`pyprof.merged.collapsed` under out_dir — a distinct name, so the
+    coordinator's raw `pyprof.collapsed` capture survives): every stack
+    line becomes
+    `host<N>;<stack> <count>`, so one flamegraph shows where each host
+    spent its samples side by side. Returns the path, or None when no
+    host produced sampling output (pure jax captures have no collapsed
+    text — their artifacts stay host-local TensorBoard dirs)."""
+    merged: dict = {}
+    for host, text in parts:
+        for line in (text or "").splitlines():
+            stack, _, cnt = line.rpartition(" ")
+            if not stack or not cnt.isdigit():
+                continue
+            key = f"host{host};{stack}"
+            merged[key] = merged.get(key, 0) + int(cnt)
+    if not merged:
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "pyprof.merged.collapsed")
+    with open(path, "w", encoding="utf-8") as fh:
+        for stack, cnt in sorted(merged.items(), key=lambda kv: -kv[1]):
+            fh.write(f"{stack} {cnt}\n")
+    return path

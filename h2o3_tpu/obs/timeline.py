@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -29,6 +30,18 @@ from collections import deque
 
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import tracing as _tracing
+
+
+def _dropped_counter():
+    """Ring-overflow counter, declared lazily: the flight recorder (and
+    through it the metrics registry) imports this module, so a top-level
+    metrics import here would cycle."""
+    from h2o3_tpu.obs import metrics as _om
+    return _om.counter(
+        "h2o3_timeline_dropped_spans_total",
+        "completed spans pushed out of the bounded timeline ring by "
+        "overflow (H2O3_OBS_TIMELINE_CAPACITY) — under load the ring "
+        "forgets; the flight recorder (obs/recorder) is the durable tier")
 
 
 def host_id() -> int:
@@ -86,7 +99,14 @@ class SpanTimeline:
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
         self._lock = make_lock("timeline.ring")
-        self._ids = itertools.count(1)
+        # span ids start at a random per-process base (not 1): the
+        # recorder's durability story spans restarts, and the (host, id)
+        # dedup keys in /3/Trace/{id} + recorder.search would otherwise
+        # collide a fresh process's ring spans 1..N with a dead process's
+        # on-disk spans for the same reused trace id, silently hiding the
+        # stored ones. Base < 2^52 keeps ids exact in JSON doubles.
+        self._ids = itertools.count(
+            (random.getrandbits(31) << 20) + 1)
         self._tls = threading.local()
 
     def _stack(self) -> list:
@@ -115,7 +135,22 @@ class SpanTimeline:
             while st and st.pop() is not sp:
                 pass
         with self._lock:
+            # deque(maxlen) overflow is SILENT — count the span the
+            # append is about to push out, so ring data loss is a signal
+            # (h2o3_timeline_dropped_spans_total), not a mystery
+            dropped = (self.capacity is not None
+                       and len(self._ring) == self.capacity)
             self._ring.append(sp)
+        if dropped:
+            _dropped_counter().inc()
+        # durable tier: traced spans stream to the flight recorder, which
+        # makes the keep/drop call at trace completion (tail sampling).
+        # Untraced spans return after one attribute read. Lazy import —
+        # the recorder imports the metrics registry; this module must
+        # stay importable underneath both.
+        if sp.trace is not None:
+            from h2o3_tpu.obs import recorder as _recorder
+            _recorder.RECORDER.on_span_end(sp)
 
     def current(self) -> Span | None:
         st = self._stack()

@@ -1,0 +1,22 @@
+"""JAX version compatibility shims for the parallel layer.
+
+The repo targets the stable `jax.shard_map` API (jax >= 0.6, `check_vma`
+kwarg); older runtimes ship the same transform as
+`jax.experimental.shard_map.shard_map` with the replication check under
+`check_rep`. Resolving per call (not at import) keeps the module usable
+when jax itself is stubbed out.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)

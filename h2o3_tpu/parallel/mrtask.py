@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs.timeline import span as _span
+from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.parallel import mesh as _mesh
 
 # ---------------------------------------------------------------------------
@@ -191,16 +192,16 @@ def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False,
     in_specs = tuple(in_specs)
 
     def smapped(*arrs):
-        return jax.shard_map(fn, mesh=c.mesh, in_specs=in_specs,
-                             out_specs=out_specs if out_specs is not None
-                             else P(), check_vma=check_vma)(*arrs)
+        return _compat.shard_map(fn, mesh=c.mesh, in_specs=in_specs,
+                                 out_specs=out_specs if out_specs is not None
+                                 else P(), check_vma=check_vma)(*arrs)
 
     try:
         key = ("map_chunks", _fn_key(fn), c.mesh, in_specs,
                out_specs, check_vma)
         hash(key)
     except (TypeError, ValueError, _Uncacheable):
-        return _traced_dispatch(   # h2o3-ok: R001 unhashable specs fall back to the uncached legacy path
+        return _traced_dispatch(   # h2o3-ok: R001,R011 unhashable specs fall back to the uncached legacy path; same map_chunks stage either way
             "mrtask.map_chunks", jax.jit(smapped), arrays, fn)
     with _JIT_CACHE_LOCK:
         jfn = _JIT_CACHE.get(key)

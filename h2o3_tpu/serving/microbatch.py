@@ -43,6 +43,10 @@ BATCH_ROWS = _om.histogram("h2o3_score_microbatch_rows",
                            "real rows per coalesced dispatch",
                            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
                                     1024, 4096, 16384, 65536))
+BATCH_SECONDS = _om.histogram(
+    "h2o3_score_microbatch_seconds",
+    "coalesced dispatch wall time (staging + device + readback); the "
+    "exemplar carries one served request's trace id")
 
 def _wait_s() -> float:
     """Follower safety timeout (seconds): the R008 rule forbids an
@@ -203,6 +207,7 @@ class MicroBatcher:
                         requests=len(batch), links=links) \
                 if links or _tracing.current() is not None \
                 else contextlib.nullcontext()
+            t0 = time.perf_counter()
             with ctx:
                 raw = np.full((bucket, C), np.nan, np.float32)
                 off = 0
@@ -211,7 +216,11 @@ class MicroBatcher:
                     off += r.n
                 out = _sc.score_rows(model, raw, total, links=links)
             DISPATCHES.inc()
-            BATCH_ROWS.observe(total)
+            # one served trace id rides each histogram as an OpenMetrics
+            # exemplar, so a dispatch-latency spike resolves to a trace
+            ex = links[0] if links else _tracing.current()
+            BATCH_ROWS.observe(total, exemplar=ex)
+            BATCH_SECONDS.observe(time.perf_counter() - t0, exemplar=ex)
             off = 0
             for r in batch:
                 r.result = out[off:off + r.n]

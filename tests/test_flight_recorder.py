@@ -1,0 +1,609 @@
+"""ISSUE 7 — flight recorder, trace search, exemplars, SLO burn rates.
+
+Covers: tail-based sampling dispositions (error/slow/sampled retained,
+fast-OK downsampled), segment roll + retention GC under
+H2O3_OBS_RETAIN_MB, trace search filters, REST durability (a trace
+evicted from the ring — and read by a FRESH process over the same
+ice_root — still answers at GET /3/Trace/{id} and GET /3/Traces),
+OpenMetrics exemplars on /metrics resolving to stored traces, the SLO
+burn-rate engine (fire + resolve, gauges, alert spans) and its
+GET /3/Alerts surface, and the timeline ring-overflow counter."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.obs import metrics as om
+from h2o3_tpu.obs import recorder as rec_mod
+from h2o3_tpu.obs import slo as slo_mod
+from h2o3_tpu.obs import tracing
+from h2o3_tpu.obs.timeline import SPANS, Span, SpanTimeline, span
+
+
+def _mkspan(trace, name, dur_ms, parent=0, span_id=1, **attrs):
+    t0 = time.time() - dur_ms / 1000.0
+    sp = Span(name=name, t_start=t0, span_id=span_id, parent_id=parent,
+              trace=trace, attrs=attrs)
+    sp.t_end = t0 + dur_ms / 1000.0
+    return sp
+
+
+def _disposition(kind):
+    c = om.REGISTRY.get("h2o3_recorder_spans_total")
+    return c.value(disposition=kind) if c is not None else 0.0
+
+
+@pytest.fixture()
+def recorder(tmp_path, monkeypatch):
+    """An isolated FlightRecorder writing under a tmp segment root, with
+    the probabilistic lottery OFF (only forced retention applies)."""
+    monkeypatch.setenv("H2O3_OBS_SAMPLE", "0")
+    monkeypatch.setenv("H2O3_OBS_SLOW_MS", "1000")
+    r = rec_mod.FlightRecorder(root=str(tmp_path / "segments"))
+    return r
+
+
+# ---------------------------------------------------------------------------
+# tail-based sampling dispositions
+def test_tail_sampling_dispositions(recorder):
+    ret0, drop0 = _disposition("retained"), _disposition("downsampled")
+    # slow trace (child + slow root): retained
+    recorder.on_span_end(_mkspan("t-slow", "inner", 10, parent=7,
+                                 span_id=2))
+    recorder.on_span_end(_mkspan("t-slow", "rest.request", 2500,
+                                 route="/3/Parse", status=200))
+    # fast-OK trace: downsampled (sample rate 0)
+    recorder.on_span_end(_mkspan("t-fast", "rest.request", 3,
+                                 route="/3/Cloud", status=200))
+    # failed trace: retained regardless of speed
+    recorder.on_span_end(_mkspan("t-err", "rest.request", 3,
+                                 route="/99/Rapids", status=500))
+    # explicitly-sampled trace (X-H2O3-Sample: 1): retained
+    recorder.on_span_end(_mkspan("t-pin", "rest.request", 3,
+                                 route="/3/Cloud", status=200, sampled=1))
+    assert _disposition("retained") == ret0 + 4
+    assert _disposition("downsampled") == drop0 + 1
+    got = recorder.load_trace("t-slow")
+    assert {s["name"] for s in got} == {"inner", "rest.request"}
+    starts = [s["start"] for s in got]
+    assert starts == sorted(starts)
+    assert recorder.load_trace("t-fast") == []
+    # untraced spans never reach the buffers
+    recorder.on_span_end(_mkspan(None, "loose", 5))
+    assert _disposition("downsampled") == drop0 + 1
+
+
+def test_probabilistic_downsampling_respects_rate(recorder, monkeypatch):
+    monkeypatch.setenv("H2O3_OBS_SAMPLE", "1")      # keep everything
+    for i in range(5):
+        recorder.on_span_end(_mkspan(f"t-{i}", "rest.request", 1,
+                                     route="/3/Cloud", status=200))
+    assert len(recorder.search(route="/3/Cloud", limit=10)) == 5
+
+
+def test_pin_retains_fragments_without_sampled_attr(recorder):
+    """X-H2O3-Sample registers pin() at request ENTRY: a piece of the
+    pinned trace whose own root closes fast-OK WITHOUT the sampled attr
+    (a background job inherits the trace id; its root span is separate
+    from the rest.request root) must still be retained."""
+    recorder.pin("t-pinned-job")
+    recorder.on_span_end(_mkspan("t-pinned-job", "job.train", 3,
+                                 status=200))          # fast-OK root
+    assert {s["name"] for s in recorder.load_trace("t-pinned-job")} \
+        == {"job.train"}
+    # same fragment unpinned loses the lottery (sample rate 0)
+    recorder.on_span_end(_mkspan("t-unpinned-job", "job.train", 3,
+                                 status=200))
+    assert recorder.load_trace("t-unpinned-job") == []
+
+
+def test_linger_expires_idle_traces_only_and_retains(recorder, monkeypatch):
+    """Linger measures IDLE time, and an expired fragment's outcome is
+    unknowable (its root never closed) — it must be retained, never
+    downsampled: the head of a long request that errors after the sweep
+    is exactly the data the recorder exists to keep."""
+    monkeypatch.setenv("H2O3_OBS_TRACE_LINGER_S", "0.08")
+    # t-active streams child spans: each append refreshes activity, so
+    # it outlives many linger windows un-finalized
+    for _ in range(4):
+        recorder.on_span_end(_mkspan("t-active", "mrtask.map_reduce", 1,
+                                     parent=9))
+        time.sleep(0.05)
+    assert "t-active" in recorder._buf, "active trace expired mid-flight"
+    assert recorder.load_trace("t-active") == []
+    # ...then goes idle past the window: the next sweep (triggered by any
+    # other span ending) finalizes it as a retained fragment
+    time.sleep(0.1)
+    recorder.on_span_end(_mkspan("t-other", "inner", 1, parent=3))
+    assert "t-active" not in recorder._buf
+    got = recorder.load_trace("t-active")
+    assert len(got) == 4 and all(s["name"] == "mrtask.map_reduce"
+                                 for s in got)
+
+
+def test_read_paths_sweep_idle_fragments(recorder, monkeypatch):
+    """A thread that dies mid-request leaves an open-rooted fragment in
+    the buffer; if no traced span ever ends again, the READ paths (and
+    the recorder-bytes gauge each /metrics scrape) must still finalize
+    it — durability can't depend on future traffic."""
+    monkeypatch.setenv("H2O3_OBS_TRACE_LINGER_S", "0.05")
+    recorder.on_span_end(_mkspan("t-dead-thread", "inner", 1, parent=5))
+    time.sleep(0.08)
+    got = recorder.load_trace("t-dead-thread")       # sweeps, then reads
+    assert len(got) == 1 and got[0]["name"] == "inner", got
+    # search and the gauge callback sweep too
+    recorder.on_span_end(_mkspan("t-dead-2", "inner", 1, parent=5,
+                                 span_id=3))
+    time.sleep(0.08)
+    assert "t-dead-2" in {t["trace"] for t in recorder.search(limit=10)}
+
+
+def test_dropped_head_healed_when_later_fragment_errors(recorder):
+    """Multi-root ordering: the request root closes fast-OK (its
+    fragment loses the lottery) BEFORE the background job's root errors.
+    The dropped head must be resurrected — written retroactively with
+    disposition=healed — when the error fragment is retained."""
+    heal0 = _disposition("healed")
+    recorder.on_span_end(_mkspan("t-late-err", "rest.request", 3,
+                                 route="/3/ModelBuilders/gbm", status=200))
+    assert recorder.load_trace("t-late-err") == []      # lottery lost
+    recorder.on_span_end(_mkspan("t-late-err", "job.run", 5, span_id=2,
+                                 error="RuntimeError('kaput')"))
+    got = recorder.load_trace("t-late-err")
+    assert {s["name"] for s in got} == {"rest.request", "job.run"}, got
+    assert _disposition("healed") == heal0 + 1
+
+
+def test_search_does_not_double_count_ring_and_disk(recorder):
+    """A retained trace's spans are usually still in the ring when it's
+    searched — each (host, id) counts once, not once per source."""
+    sp = _mkspan("dup-1", "rest.request", 3, route="/99/Rapids", status=500)
+    recorder.on_span_end(sp)                     # error → retained to disk
+    out = recorder.search(extra_spans=[sp.to_dict()], limit=10)
+    t = next(t for t in out if t["trace"] == "dup-1")
+    assert t["n_spans"] == 1, t
+
+
+def test_search_keeps_newest_ring_traces_under_load(recorder):
+    """The ring snapshot arrives oldest-first; the bounded summary
+    working set must admit the NEWEST traces, or under load the most
+    recent incident is exactly the one search can't find."""
+    extras = []            # 600 distinct traces > the 256/limit*8 bound
+    for i in range(600):
+        extras.append({"trace": f"ring-{i:04d}", "name": "rest.request",
+                       "parent": 0, "start": 1000.0 + i, "end": 1000.5 + i,
+                       "duration_ms": 500.0,
+                       "attrs": {"route": "/3/Cloud", "status": "200"}})
+    got = [t["trace"] for t in recorder.search(limit=50, extra_spans=extras)]
+    assert got[0] == "ring-0599" and got[-1] == "ring-0550", got[:3]
+
+
+def test_filtered_search_reaches_disk_past_full_ring(recorder):
+    """A ring flooded with fast-OK traces fills the bounded working set
+    before the disk scan starts; a filtered search must keep scanning
+    (evicting non-matching candidates) until the durably-retained error
+    trace — long evicted from the ring — is read from its segment."""
+    recorder.on_span_end(_mkspan("disk-err", "rest.request", 3,
+                                 route="/99/Rapids", status=500))
+    extras = []            # > the max(limit*8, 256) bound at limit=10
+    for i in range(500):
+        extras.append({"trace": f"flood-{i:04d}", "name": "rest.request",
+                       "parent": 0, "start": 2000.0 + i, "end": 2000.1 + i,
+                       "duration_ms": 100.0,
+                       "attrs": {"route": "/3/Cloud", "status": "200"}})
+    out = recorder.search(status="error", limit=10, extra_spans=extras)
+    assert [t["trace"] for t in out] == ["disk-err"], out
+
+
+def test_segment_roll_and_retention_gc(recorder, monkeypatch):
+    monkeypatch.setenv("H2O3_OBS_SEGMENT_MB", "0.002")   # 2 KB segments
+    monkeypatch.setenv("H2O3_OBS_RETAIN_MB", "0.006")    # keep ~3 of them
+    for i in range(100):
+        recorder.on_span_end(_mkspan(
+            f"t-{i:03d}", "rest.request", 5000, route="/3/Parse",
+            status=200, filler="x" * 64))
+    recorder.flush()
+    assert recorder.disk_bytes() <= 6000 + 2100, \
+        f"retention budget blown: {recorder.disk_bytes()}"
+    found = {t["trace"] for t in recorder.search(limit=100)}
+    assert "t-099" in found, "newest trace GC'd instead of oldest"
+    assert "t-000" not in found, "oldest segment survived the budget"
+
+
+def test_writer_rolls_when_active_segment_unlinked(recorder):
+    """Sibling-process GC unlinks oldest-mtime segments regardless of
+    owner — including THIS process's still-open one. The writer must
+    notice the dead inode and roll, or every retained trace until the
+    size roll would be invisible to all readers."""
+    recorder.on_span_end(_mkspan("u-1", "rest.request", 3,
+                                 route="/99/Rapids", status=500))
+    first = recorder._path
+    assert first and os.path.exists(first)
+    os.unlink(first)                    # what a remote GC would do
+    recorder.on_span_end(_mkspan("u-2", "rest.request", 3,
+                                 route="/99/Rapids", status=500))
+    assert recorder._path != first and os.path.exists(recorder._path)
+    recorder.flush()
+    on_disk = {t["trace"] for t in recorder.search(status="error",
+                                                   limit=10)}
+    assert "u-2" in on_disk, "trace written to an unlinked inode"
+
+
+def test_search_filters(recorder):
+    recorder.on_span_end(_mkspan("s-ok", "rest.request", 10,
+                                 route="/3/Frames", status=200, sampled=1))
+    recorder.on_span_end(_mkspan("s-slow", "rest.request", 3000,
+                                 route="/3/Predictions/x", status=200))
+    recorder.on_span_end(_mkspan("s-err", "rest.request", 20,
+                                 route="/3/Predictions/x", status=503))
+    by_route = recorder.search(route="/3/Predictions")
+    assert {t["trace"] for t in by_route} == {"s-slow", "s-err"}
+    assert {t["trace"] for t in recorder.search(status="error")} == {"s-err"}
+    assert {t["trace"] for t in recorder.search(min_ms=1000)} == {"s-slow"}
+    assert {t["trace"] for t in recorder.search(status="503")} == {"s-err"}
+    assert {t["trace"] for t in recorder.search(name="rest.")} >= \
+        {"s-ok", "s-slow", "s-err"}
+    assert len(recorder.search(limit=1)) == 1
+    summ = next(t for t in by_route if t["trace"] == "s-err")
+    assert summ["error"] is True and summ["route"] == "/3/Predictions/x"
+
+
+def test_torn_tail_line_is_skipped(recorder):
+    recorder.on_span_end(_mkspan("c-1", "rest.request", 9000,
+                                 route="/3/A", status=200))
+    recorder.flush()
+    segs = [p for _, p, _ in recorder._segments()]
+    assert segs
+    with open(segs[-1], "a", encoding="utf-8") as fh:
+        fh.write('{"trace": "c-2", "name": "torn')   # crash mid-append
+    assert [t["trace"] for t in recorder.search(limit=10)] == ["c-1"]
+
+
+# ---------------------------------------------------------------------------
+# REST surface: durability, read-through, search, exemplars
+@pytest.fixture(scope="module")
+def server():
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def rest_recorder(tmp_path, monkeypatch):
+    """Point the PROCESS recorder at a tmp root for REST tests."""
+    monkeypatch.setenv("H2O3_OBS_SAMPLE", "0")
+    rec_mod.RECORDER.set_root(str(tmp_path / "obs" / "segments"))
+    yield tmp_path
+    rec_mod.RECORDER.set_root(None)
+
+
+def _req(s, path, method="GET", headers=None, data=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{s.port}{path}", method=method,
+        headers=headers or {},
+        data=urllib.parse.urlencode(data).encode() if data else None)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.headers, r.read()
+
+
+def test_trace_survives_ring_eviction_and_fresh_process(server,
+                                                        rest_recorder):
+    tid = "durable-trace-1"
+    _req(server, "/3/Frames", headers={"X-H2O3-Trace-Id": tid,
+                                       "X-H2O3-Sample": "1"})
+    # flood of fast-OK traffic: downsampled, so the budget holds
+    drop0 = _disposition("downsampled")
+    for _ in range(20):
+        _req(server, "/3/Cloud")
+    assert _disposition("downsampled") >= drop0 + 20
+    # evict EVERYTHING from the ring — the TimeLine failure mode
+    SPANS.clear()
+    hdrs, body = _req(server, f"/3/Trace/{tid}")
+    out = json.loads(body)
+    assert out["n_spans"] >= 1, "trace lost with the ring"
+    names = [s["name"] for s in out["spans"]]
+    assert "rest.request" in names
+    assert out["hosts"][0]["from_disk"] >= 1
+    # search finds it by route and by pinned-sample status
+    _, body = _req(server, "/3/Traces?route=/3/Frames")
+    found = json.loads(body)["traces"]
+    assert tid in {t["trace"] for t in found}
+    # fast-OK flood is absent (downsampled)
+    _, body = _req(server, "/3/Traces?route=/3/Cloud&limit=100")
+    assert json.loads(body)["traces"] == []
+
+    # a FRESH PROCESS over the same ice_root retrieves the same trace —
+    # the durability claim PersistIce makes for values, made for traces
+    code = (
+        "import json\n"
+        "from h2o3_tpu.obs import recorder\n"
+        "r = recorder.FlightRecorder()\n"
+        f"spans = r.load_trace({tid!r})\n"
+        f"hits = r.search(route='/3/Frames')\n"
+        "print(json.dumps({'n': len(spans),"
+        " 'traces': [t['trace'] for t in hits]}))\n")
+    env = dict(os.environ, H2O3_TPU_ICE_ROOT=str(rest_recorder),
+               JAX_PLATFORMS="cpu")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["n"] >= 1 and tid in out["traces"], out
+
+
+def test_failed_job_trace_retained(rest_recorder):
+    """A traced background job that fails fast must be retained: the
+    job.run span is its fragment's ROOT (separate thread, separate root
+    from the launching request) and carries the `error` attr the tail
+    sampler keys on — without it a quick training failure lost the
+    H2O3_OBS_SAMPLE lottery."""
+    from h2o3_tpu.core.jobs import Job
+    tid = "job-fail-trace-1"
+    with tracing.trace(tid):
+        j = Job(dest=None, description="boom").start(
+            lambda job: (_ for _ in ()).throw(RuntimeError("kaput")),
+            background=False)
+    assert j.status == "FAILED"
+    got = rec_mod.RECORDER.load_trace(tid)
+    assert any(s["name"] == "job.run" and "kaput" in
+               str(s["attrs"].get("error")) for s in got), got
+
+
+def test_span_ids_do_not_collide_across_timelines():
+    """Span ids start at a random per-process base: two process
+    lifetimes writing the same trace id to a shared ice_root must not
+    produce colliding (host, id) dedup keys that hide the dead
+    process's durable spans from /3/Trace/{id}."""
+    a, b = SpanTimeline(capacity=8), SpanTimeline(capacity=8)
+    sa, sb = a.begin("x"), b.begin("x")
+    a.end(sa), b.end(sb)
+    assert sa.span_id != sb.span_id
+    assert sa.span_id < 2 ** 52 and sb.span_id < 2 ** 52
+
+
+def test_failed_request_trace_retained(server, rest_recorder):
+    tid = "failed-trace-1"
+    try:
+        _req(server, "/99/Rapids", method="POST",
+             headers={"X-H2O3-Trace-Id": tid},
+             data={"ast": "(this is not rapids"})
+    except urllib.error.HTTPError as ex:
+        assert ex.code == 500
+    SPANS.clear()
+    _, body = _req(server, "/3/Traces?status=error")
+    assert tid in {t["trace"] for t in json.loads(body)["traces"]}
+    _, body = _req(server, f"/3/Trace/{tid}")
+    assert json.loads(body)["n_spans"] >= 1
+    # malformed numeric query params are the CLIENT's error: a 400, never
+    # a 5xx that would itself be tail-retained and burn the SLO budget
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(server, "/3/Traces?min_ms=abc")
+    assert ei.value.code == 400
+
+
+def test_openmetrics_exemplar_resolves_to_stored_trace(server,
+                                                       rest_recorder):
+    tid = "exemplar-trace-1"
+    _req(server, "/3/Frames", headers={"X-H2O3-Trace-Id": tid,
+                                       "X-H2O3-Sample": "1"})
+    _, body = _req(server, "/metrics?format=openmetrics")
+    text = body.decode()
+    assert text.endswith("# EOF\n")
+    ex_line = next(l for l in text.splitlines()
+                   if f'trace_id="{tid}"' in l)
+    assert "h2o3_rest_request_seconds_bucket" in ex_line
+    assert " # {" in ex_line
+    # OpenMetrics counter families drop _total in metadata, keep it on
+    # the samples
+    assert "# TYPE h2o3_recorder_spans counter" in text
+    assert "h2o3_recorder_spans_total{" in text
+    # the exemplar's trace id resolves to a STORED trace
+    SPANS.clear()
+    _, body = _req(server, f"/3/Trace/{tid}")
+    assert json.loads(body)["n_spans"] >= 1
+    # content negotiation: Accept header works, default stays 0.0.4
+    hdrs, body = _req(server, "/metrics",
+                      headers={"Accept": "application/openmetrics-text"})
+    assert "openmetrics-text" in hdrs.get("Content-Type", "")
+    hdrs, body = _req(server, "/metrics")
+    assert "0.0.4" in hdrs.get("Content-Type", "")
+    assert "# EOF" not in body.decode()
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+def _lat_spec(**kw):
+    # 2m long window: warm-up coverage scaling means a 30s-old ring can
+    # drive the long-window burn to at most obs*0.25 — still over the
+    # 10x factor for a total regression (burn_obs = 1/budget = 100)
+    d = {"name": "test-lat", "metric": "h2o3_slo_t_seconds",
+         "objective": 0.99, "threshold_ms": 100, "route": "/3/P",
+         "windows": [[60, 120, 10.0]]}
+    d.update(kw)
+    return slo_mod.SLOSpec(d)
+
+
+def test_slo_burn_fires_and_resolves():
+    reg = om.MetricsRegistry()
+    lat = reg.histogram("h2o3_slo_t_seconds", "t")
+    eng = slo_mod.SLOEngine(specs=[_lat_spec()], registry=reg)
+    t0 = time.time()
+    for _ in range(200):
+        lat.observe(0.01, route="/3/P", status="200")
+    assert eng.evaluate(now=t0) and not eng.alerts()[0]["firing"]
+    # seeded latency regression: every new request blows the threshold
+    for _ in range(100):
+        lat.observe(0.5, route="/3/P", status="200")
+    ring0 = SPANS.snapshot()
+    alerts = eng.evaluate(now=t0 + 30)
+    st = alerts[0]
+    assert st["firing"] is True and st["trace"].startswith("slo-test-lat")
+    assert st["burn"]["1m"] > 10.0
+    assert reg.get("h2o3_slo_burn_rate").value(
+        slo="test-lat", window="1m") > 10.0
+    assert reg.get("h2o3_slo_alert_active").value(slo="test-lat") == 1.0
+    # the scratch engine published into ITS registry, not the process one
+    g = om.REGISTRY.get("h2o3_slo_burn_rate")
+    assert g is None or g.value(slo="test-lat", window="1m") == 0.0
+    # the transition recorded a traceable slo.alert span
+    fired = [s for s in SPANS.snapshot() if s["name"] == "slo.alert"
+             and s["trace"] == st["trace"]]
+    assert fired and fired[0]["attrs"]["state"] == "firing"
+    assert len(SPANS.snapshot()) == len(ring0) + len(fired)
+    # recovery: flood of fast requests dilutes the short window
+    for _ in range(50000):
+        lat.observe(0.01, route="/3/P", status="200")
+    alerts = eng.evaluate(now=t0 + 120)
+    assert alerts[0]["firing"] is False
+    assert reg.get("h2o3_slo_alert_active").value(slo="test-lat") == 0.0
+    resolved = [s for s in SPANS.snapshot() if s["name"] == "slo.alert"
+                and s["trace"] == st["trace"]
+                and s["attrs"]["state"] == "resolved"]
+    assert resolved, "resolve transition not recorded as a span"
+
+
+def test_slo_warmup_scales_long_window_burn():
+    """A 30s error burst right after process start must NOT page the
+    fast-burn pair: with history shorter than the window, burn scales
+    by ring coverage (unseen history assumed clean), so the long window
+    cannot clamp to the short window's data and defeat the multi-window
+    guard."""
+    reg = om.MetricsRegistry()
+    # h2o3-ok: R005 isolated per-test registry reusing the fixture metric name
+    lat = reg.histogram("h2o3_slo_t_seconds", "t")
+    spec = _lat_spec(windows=[[60, 3600, 10.0]])
+    eng = slo_mod.SLOEngine(specs=[spec], registry=reg)
+    t0 = time.time()
+    lat.observe(0.01, route="/3/P", status="200")
+    eng.evaluate(now=t0)
+    for _ in range(100):
+        lat.observe(0.5, route="/3/P", status="200")   # total regression
+    st = eng.evaluate(now=t0 + 30)[0]
+    assert st["burn"]["1m"] > 10.0          # short window sees the burst
+    assert st["burn"]["1h"] < 1.0           # long window: 30s/1h coverage
+    assert st["firing"] is False, "warm-up burst paged the fast-burn pair"
+
+
+def test_alert_span_detaches_from_enclosing_request_span():
+    """evaluate() usually runs inside a GET /3/Alerts request span: the
+    slo.alert transition must still be the episode trace's ROOT, not a
+    child pointing into the polling request's unrelated trace."""
+    spec = _lat_spec()
+    with span("rest.request", route="/3/Alerts"):
+        slo_mod._alert_span(spec, "firing", 20.0, "1m", "slo-episode-x")
+    got = [s for s in SPANS.snapshot() if s["name"] == "slo.alert"
+           and s["trace"] == "slo-episode-x"]
+    assert got and got[-1]["parent"] == 0
+
+
+def test_slo_install_ignores_directory_mount(tmp_path, monkeypatch):
+    """k8s mounts slo.json via subPath from an OPTIONAL ConfigMap; when
+    the map is absent the kubelet materializes an empty directory at the
+    path — the engine must idle, not crashloop the server."""
+    monkeypatch.setenv("H2O3_SLO_FILE", str(tmp_path))
+    assert slo_mod.install_from_env() is None
+
+
+def test_slo_sample_ring_bounded_under_fast_polling():
+    """Every GET /3/Alerts appends an evaluation sample; rapid polling
+    must update the newest sample in place, never grow the ring."""
+    reg = om.MetricsRegistry()
+    # h2o3-ok: R005 isolated per-test registry reusing the fixture metric name
+    lat = reg.histogram("h2o3_slo_t_seconds", "t")
+    eng = slo_mod.SLOEngine(specs=[_lat_spec()], registry=reg)
+    t0 = time.time()
+    for i in range(500):
+        lat.observe(0.01, route="/3/P", status="200")
+        eng.evaluate(now=t0 + i * 0.01)      # 100 Hz polling for 5s
+    ring = eng._samples["test-lat"]
+    assert len(ring) <= 8, f"ring grew under fast polling: {len(ring)}"
+    assert ring[-1][1] == 500               # newest totals stay fresh
+    # spaced samples still append (the burn delta survives)
+    eng.evaluate(now=t0 + 30)
+    for _ in range(100):
+        lat.observe(0.5, route="/3/P", status="200")
+    st = eng.evaluate(now=t0 + 60)[0]
+    assert st["burn"]["1m"] > 10.0
+
+
+def test_slo_availability_and_window_semantics():
+    reg = om.MetricsRegistry()
+    # h2o3-ok: R005 isolated per-test registry reusing the fixture metric name
+    lat = reg.histogram("h2o3_slo_t_seconds", "t")
+    spec = _lat_spec(name="test-avail", threshold_ms=None,
+                     objective=0.999)
+    eng = slo_mod.SLOEngine(specs=[spec], registry=reg)
+    t0 = time.time()
+    for _ in range(1000):
+        lat.observe(0.01, route="/3/P", status="200")
+    eng.evaluate(now=t0)
+    for _ in range(10):
+        lat.observe(0.01, route="/3/P", status="500")
+    eng.evaluate(now=t0 + 30)
+    # 10/10 bad in the delta → observed burn 1.0/0.001 = 1000, scaled
+    # by warm-up coverage 30s/60s (unseen history assumed clean)
+    assert reg.get("h2o3_slo_burn_rate").value(
+        slo="test-avail", window="1m") == pytest.approx(500.0)
+    assert eng.alerts()[0]["firing"] is True
+
+
+def test_slo_specs_load_and_rest_alerts(server, tmp_path, monkeypatch):
+    spec_file = tmp_path / "slo.json"
+    spec_file.write_text(json.dumps({"slos": [{
+        "name": "rest-cloud-lat", "route": "/3/Cloud",
+        "objective": 0.9, "threshold_ms": 0.0001,
+        "windows": [[10, 30, 1.5]]}]}))
+    monkeypatch.setenv("H2O3_SLO_FILE", str(spec_file))
+    monkeypatch.setenv("H2O3_SLO_EVAL_S", "0")      # no background thread
+    assert slo_mod.install_from_env() is None       # loaded, thread idle
+    try:
+        assert [s.name for s in slo_mod.ENGINE.specs()] == ["rest-cloud-lat"]
+        _req(server, "/3/Alerts")                   # baseline sample
+        for _ in range(5):
+            _req(server, "/3/Cloud")                # all blow 0.0001ms
+        deadline = time.monotonic() + 30
+        firing = []
+        while not firing and time.monotonic() < deadline:
+            _, body = _req(server, "/3/Alerts")
+            out = json.loads(body)
+            firing = out["firing"]
+            time.sleep(0.1)
+        assert firing == ["rest-cloud-lat"], out
+        assert out["slos"][0]["kind"] == "latency"
+        alert = next(a for a in out["alerts"] if a["slo"] == "rest-cloud-lat")
+        assert alert["trace"]
+    finally:
+        slo_mod.ENGINE.configure([])    # also clears the engine's gauges
+
+
+def test_default_slo_file_parses():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deploy", "slo.json")
+    specs = slo_mod.load_specs(path)
+    names = {s.name for s in specs}
+    assert "predictions-latency" in names
+    lat = next(s for s in specs if s.name == "predictions-latency")
+    assert lat.threshold_ms == 250 and lat.budget == pytest.approx(0.01)
+    assert lat.windows[0] == (300.0, 3600.0, 14.4)
+
+
+# ---------------------------------------------------------------------------
+# satellite: ring overflow is counted
+def test_timeline_ring_overflow_counted():
+    tl = SpanTimeline(capacity=4)
+    before = om.REGISTRY.get("h2o3_timeline_dropped_spans_total").value() \
+        if om.REGISTRY.get("h2o3_timeline_dropped_spans_total") else 0.0
+    for i in range(10):
+        tl.end(tl.begin(f"ring-{i}"))
+    after = om.REGISTRY.get("h2o3_timeline_dropped_spans_total").value()
+    assert after == before + 6
+    assert len(tl.snapshot()) == 4

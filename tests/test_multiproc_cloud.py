@@ -97,6 +97,9 @@ def test_two_process_cloud_matches_single(tmp_path):
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)
     env["H2O3_CLUSTER_SECRET"] = "multiproc-test-secret"
+    # profiler stop ships each worker's flamegraph inside the collect
+    # ack; give the sampler-join + file write headroom over the default
+    env["H2O3_OBS_COLLECT_TIMEOUT_S"] = "10"
     # the conftest pins single-process visible devices via XLA flags; the
     # subprocesses must form their own 2-proc cloud with 1 device each
     env["XLA_FLAGS"] = ""
@@ -175,6 +178,32 @@ def test_two_process_cloud_matches_single(tmp_path):
             "cluster scrape did not merge both hosts"
         wm = _get(rest, "/3/WaterMeter?cluster=1")
         assert set(wm["hosts"]) == {0, 1} and wm["lagging_hosts"] == []
+
+        # ---- ISSUE 7: cluster-wide profiling. One POST fans start/stop
+        # to both hosts over the replay channel; each host runs its own
+        # sampling capture, and the merged flamegraph carries BOTH host
+        # prefixes.
+        prof_dir = str(tmp_path / "prof")
+        out = _post(rest, "/3/Profiler", action="start", kind="sampling",
+                    cluster="1", trace_dir=prof_dir)
+        assert out["status"] == "started", out
+        assert {h["host"] for h in out["hosts"]} == {0, 1}, out
+        assert out["lagging_hosts"] == []
+        # give both hosts' samplers work + time to sample
+        _post(rest, "/3/Predictions/models/mp_gbm/frames/mp_train",
+              predictions_frame="mp_pred_prof")
+        time.sleep(0.5)
+        out = _post(rest, "/3/Profiler", action="stop", cluster="1")
+        assert out["status"] == "stopped", out
+        hosts = {h["host"]: h for h in out["hosts"]}
+        assert set(hosts) == {0, 1}, out
+        # both hosts produced sampling artifacts on their own disks
+        assert hosts[0].get("artifact") and hosts[1].get("artifact")
+        merged = out.get("merged_flamegraph")
+        assert merged and os.path.exists(merged), out
+        with open(merged) as fh:
+            flame = fh.read()
+        assert "host0;" in flame and "host1;" in flame, flame[:500]
     finally:
         for p in procs:
             p.terminate()

@@ -150,6 +150,31 @@ def test_r005_detects_inconsistent_label_sets():
     assert len(found) == 1 and found[0].line == 8
 
 
+def test_r005_sees_instance_attribute_emissions():
+    """Metrics bound to self.<attr> at declaration (the SLO engine's
+    pattern) must be tracked through self.<attr>.set(...) emission
+    sites — both for the label-consistency gate and the census."""
+    src = (
+        "from h2o3_tpu.obs import metrics as _om\n"
+        "class Eng:\n"
+        "    def __init__(self):\n"
+        "        self._g = _om.REGISTRY.gauge('h2o3_fixture_attr', 'x')\n"
+        "    def a(self):\n"
+        "        self._g.set(1.0, slo='s')\n"
+        "    def b(self):\n"
+        "        self._g.set(0.0)\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R005"]
+    assert len(found) == 1 and found[0].line == 8
+    # census records the labels seen at the attribute emission sites
+    import ast as _ast
+    from h2o3_tpu.analysis import rules_metrics
+    mod = engine.Module("<fixture>", "<fixture>", src, _ast.parse(src))
+    decls, _ = rules_metrics.collect([mod])
+    emis = [e for en in decls["h2o3_fixture_attr"]
+            for e in en.get("emissions", [])]
+    assert {lb for _, _, ls in emis for lb in ls} == {"slo"}
+
+
 def test_r006_detects_group_signature_drift():
     src = (
         "import re\n"
@@ -251,6 +276,138 @@ def test_metric_census_is_committed_and_current():
     assert have == want, \
         "stale metric census — run: python -m h2o3_tpu.analysis " \
         "--write-census"
+
+
+def test_check_census_checks_committed_files_despite_explicit_write(
+        tmp_path):
+    """`--write-census <path> --check-census` must still gate the
+    COMMITTED censuses: writing to an explicit side path and then
+    comparing the gate against that same fresh file would let a stale
+    obs/METRICS.md or SPANS.md sail through exit 0."""
+    spans_path = os.path.join(engine.package_root(), "obs", "SPANS.md")
+    with open(spans_path, encoding="utf-8") as fh:
+        committed = fh.read()
+    try:
+        with open(spans_path, "a", encoding="utf-8") as fh:
+            fh.write("\nstale marker\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "h2o3_tpu.analysis",
+             "--write-census", str(tmp_path / "side.md"),
+             "--check-census"],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        assert out.returncode == 1, out.stdout + out.stderr
+        assert "stale" in out.stderr and "census" in out.stderr
+    finally:
+        with open(spans_path, "w", encoding="utf-8") as fh:
+            fh.write(committed)
+
+
+def test_r005_ignores_exemplar_kwarg():
+    """`exemplar=` on Histogram.observe is the OpenMetrics exemplar, not
+    a label — mixed presence across sites must not split the series."""
+    src = (
+        "from h2o3_tpu.obs import metrics as _om\n"
+        "H = _om.histogram('h2o3_fixture_ex_seconds', 'x')\n"
+        "def a(tid):\n"
+        "    H.observe(0.1, exemplar=tid, route='/3/X')\n"
+        "def b():\n"
+        "    H.observe(0.2, route='/3/X')\n")
+    assert not [f for f in engine.analyze_source(src) if f.rule == "R005"]
+
+
+def test_r005_flags_exemplar_kwarg_on_counter():
+    """Counter.inc has no exemplar parameter — the kwarg lands in
+    **labels and mints a series per trace id, so R005 must keep seeing
+    it as a label (the observe/time carve-out must not leak here)."""
+    src = (
+        "from h2o3_tpu.obs import metrics as _om\n"
+        "C = _om.counter('h2o3_fixture_ex_total', 'x')\n"
+        "def a(tid):\n"
+        "    C.inc(exemplar=tid, route='/3/X')\n"
+        "def b():\n"
+        "    C.inc(route='/3/X')\n")
+    found = [f for f in engine.analyze_source(src) if f.rule == "R005"]
+    assert found and "label" in found[0].message.lower(), found
+
+
+# ---------------------------------------------------------------------------
+# R011: span-name drift (ISSUE 7)
+def test_r011_detects_duplicate_span_declarations():
+    src = (
+        "from h2o3_tpu.obs.timeline import span as _span\n"
+        "def a():\n"
+        "    with _span('fixture.phase'):\n"
+        "        pass\n"
+        "def b():\n"
+        "    with _span('fixture.phase'):\n"
+        "        pass\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/fixture_spans.py") if f.rule == "R011"]
+    assert len(found) == 1 and "more than one call site" in found[0].message
+
+
+def test_r011_detects_nonliteral_span_name():
+    src = (
+        "from h2o3_tpu.obs.timeline import span\n"
+        "def a(key):\n"
+        "    with span('fixture.' + key):\n"
+        "        pass\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/fixture_spans.py") if f.rule == "R011"]
+    assert len(found) == 1 and "non-literal" in found[0].message
+
+
+def test_r011_clean_shapes():
+    """Pass-through wrappers, conditional literals, and receiver-style
+    calls are all legitimate; wrapper call sites are censused."""
+    from h2o3_tpu.analysis import rules_spans
+    src = (
+        "from h2o3_tpu.obs import timeline\n"
+        "from h2o3_tpu.obs.timeline import span as _span\n"
+        "def wrapper(name, fn):\n"
+        "    with _span(name):\n"
+        "        return fn()\n"
+        "def a(warm, fn):\n"
+        "    with _span('fixture.warm' if warm else 'fixture.cold'):\n"
+        "        pass\n"
+        "    with timeline.span('fixture.receiver'):\n"
+        "        pass\n"
+        "    return wrapper('fixture.wrapped', fn)\n")
+    mods = [engine.Module("h2o3_tpu/fx.py", "h2o3_tpu/fx.py", src,
+                          __import__('ast').parse(src))]
+    mods[0].lines = src.splitlines()
+    decls, findings = rules_spans.collect(mods)
+    assert not findings and not rules_spans.check(mods)
+    assert set(decls) == {"fixture.warm", "fixture.cold",
+                          "fixture.receiver", "fixture.wrapped"}
+
+
+def test_r011_relaxed_for_tests():
+    src = (
+        "from h2o3_tpu.obs.timeline import span\n"
+        "def test_x(n):\n"
+        "    with span('t.' + str(n)):\n"
+        "        pass\n")
+    found = engine.analyze_source(src, filename="tests/test_fixture.py")
+    assert "R011" not in _rules_of(found)
+
+
+def test_span_census_is_committed_and_current():
+    """obs/SPANS.md must match a fresh census — renaming or adding a
+    span without regenerating fails here, keeping trace search honest."""
+    from h2o3_tpu.analysis import rules_spans
+    mods = engine.load_modules([engine.package_root()])
+    want = rules_spans.census_markdown(mods)
+    path = os.path.join(engine.package_root(), "obs", "SPANS.md")
+    assert os.path.exists(path), \
+        "run: python -m h2o3_tpu.analysis --write-census"
+    with open(path, encoding="utf-8") as fh:
+        have = fh.read()
+    assert have == want, \
+        "stale span census — run: python -m h2o3_tpu.analysis " \
+        "--write-census"
+    # the census knows the load-bearing production spans
+    assert "`rest.request`" in have and "`slo.alert`" in have
 
 
 # ---------------------------------------------------------------------------

@@ -360,6 +360,15 @@ def _fake_worker(sock, key, stall_ops=False):
                         "spans": _remote_trace_spans(op[len("trace:"):])}
             elif op == "timeline":
                 data = {"host": 1, "spans": []}
+            elif op.startswith("profiler:start:"):
+                data = {"host": 1, "status": "started",
+                        "kind": "sampling", "dir": "/tmp/h2o3-prof-h1"}
+            elif op == "profiler:stop":
+                data = {"host": 1, "status": "stopped",
+                        "kind": "sampling", "dir": "/tmp/h2o3-prof-h1",
+                        "samples": 10,
+                        "collapsed": ("worker.py:replay;worker.py:score 7\n"
+                                      "worker.py:replay 3\n")}
             else:
                 data = None
             MH._send_frame(sock, key, {"ack": msg["seq"], "data": data})
@@ -466,6 +475,93 @@ def test_cluster_scrape_merges_both_hosts(gbm_model, cluster_secret):
         assert wm["hosts"] == [0, 1] and wm["lagging_hosts"] == []
         series = wm["metrics"]["h2o3_score_rows_total"]["series"]
         assert {"labels": {"host": "1"}, "value": 17.0} in series
+    finally:
+        srv.stop()
+        sock.close()
+
+
+def test_cluster_profiler_merges_host_flamegraphs(cluster_secret, tmp_path):
+    """ISSUE 7: POST /3/Profiler?cluster=1 fans start/stop over the
+    replay channel and merges every host's sampling output into one
+    host-prefixed flamegraph."""
+    srv, bc, sock = _cloud_server()
+    try:
+        _, body = _req(srv, "/3/Profiler", method="POST",
+                       data={"action": "start", "kind": "sampling",
+                             "cluster": "1", "trace_dir": str(tmp_path)})
+        out = json.loads(body)
+        assert out["status"] == "started"
+        assert {h["host"] for h in out["hosts"]} == {0, 1}
+        assert out["lagging_hosts"] == []
+        time.sleep(0.15)                     # let the local sampler sample
+        _, body = _req(srv, "/3/Profiler", method="POST",
+                       data={"action": "stop", "cluster": "1"})
+        out = json.loads(body)
+        assert out["status"] == "stopped"
+        assert {h["host"] for h in out["hosts"]} == {0, 1}
+        # per-host artifacts reported; the worker's collapsed text is
+        # merged, not echoed raw into the response
+        assert all("collapsed" not in h for h in out["hosts"])
+        merged = out["merged_flamegraph"]
+        assert os.path.exists(merged)
+        with open(merged) as fh:
+            text = fh.read()
+        assert "host0;" in text and "host1;" in text, text[:400]
+        assert "host1;worker.py:replay;worker.py:score 7" in text
+    finally:
+        srv.stop()
+        sock.close()
+
+
+def test_cluster_profiler_stop_reaches_workers_when_local_idle(
+        cluster_secret, tmp_path):
+    """A locally-dead session (out-of-band stop, coordinator restart)
+    must not strand the workers' samplers: stop?cluster=1 still fans
+    out, answers 200 with status=idle, and merges the workers' parts."""
+    srv, bc, sock = _cloud_server()
+    try:
+        _req(srv, "/3/Profiler", method="POST",
+             data={"action": "start", "kind": "sampling",
+                   "cluster": "1", "trace_dir": str(tmp_path)})
+        # out-of-band LOCAL stop kills the coordinator's session only
+        _req(srv, "/3/Profiler", method="POST", data={"action": "stop"})
+        _, body = _req(srv, "/3/Profiler", method="POST",
+                       data={"action": "stop", "cluster": "1"})
+        out = json.loads(body)
+        assert out["status"] == "idle"
+        assert any(h["host"] == 1 and h.get("status") == "stopped"
+                   for h in out["hosts"])
+        with open(out["merged_flamegraph"]) as fh:
+            text = fh.read()
+        assert "host1;worker.py:replay;worker.py:score 7" in text
+        assert "host0;" not in text          # no local artifact to merge
+    finally:
+        srv.stop()
+        sock.close()
+
+
+def test_cluster_profiler_absorbs_stalled_host(cluster_secret, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("H2O3_OBS_COLLECT_TIMEOUT_S", "0.5")
+    srv, bc, sock = _cloud_server(stall_ops=True)
+    try:
+        t0 = time.monotonic()
+        _, body = _req(srv, "/3/Profiler", method="POST",
+                       data={"action": "start", "kind": "sampling",
+                             "cluster": "1", "trace_dir": str(tmp_path)})
+        out = json.loads(body)
+        assert out["status"] == "started" and out["lagging_hosts"] == [1]
+        time.sleep(0.15)
+        _, body = _req(srv, "/3/Profiler", method="POST",
+                       data={"action": "stop", "cluster": "1"})
+        out = json.loads(body)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"stalled host held the profiler {elapsed:.1f}s"
+        assert out["status"] == "stopped" and out["lagging_hosts"] == [1]
+        # the local capture still lands, prefixed with this host's id
+        with open(out["merged_flamegraph"]) as fh:
+            text = fh.read()
+        assert "host0;" in text and "host1;" not in text
     finally:
         srv.stop()
         sock.close()
