@@ -157,7 +157,10 @@ def scoring_bench() -> dict:
     and WITH an active trace id (what a real REST request carries) — and
     the headline number is the traced run, so the reported throughput is
     what production serving actually sees; the delta is
-    tracing_overhead_pct."""
+    tracing_overhead_pct. A third interleaved mode additionally emits one
+    structured log record per dispatch (utils/log: JSON build + ring +
+    durable JSONL append — the per-request access-log worst case) and
+    reports the delta over the traced run as logging_overhead_pct."""
     import numpy as np
     from h2o3_tpu.core.frame import Frame
     from h2o3_tpu.core.kvstore import DKV
@@ -187,24 +190,39 @@ def scoring_bench() -> dict:
             r = serving.score_frame(m, sf)
         return time.perf_counter() - t0, r
 
-    # alternating best-of-3 per mode: one span per iteration costs
-    # microseconds, so a naive single pair of loops measures scheduler
-    # jitter, not tracing — min-of-N against interleaved runs cancels it
+    from h2o3_tpu.utils import log as _ulog
+
+    def timed_loop_logged():
+        t0 = time.perf_counter()
+        for i in range(iters):
+            r = serving.score_frame(m, sf)
+            _ulog.info("bench scored batch %d rows=%d", i, batch)
+        return time.perf_counter() - t0, r
+
+    # alternating best-of-5 per mode: one span (or log record) per
+    # iteration costs microseconds, so a naive single pair of loops
+    # measures scheduler jitter, not instrumentation — min-of-N against
+    # interleaved runs cancels it
     prev_trace = tracing.set_current(None)
-    dt_off = dt_on = float("inf")
+    dt_off = dt_on = dt_log = float("inf")
     out = None
-    for _ in range(3):
+    for _ in range(5):
         tracing.set_current(None)                    # tracing off
         dt, out = timed_loop()
         dt_off = min(dt_off, dt)
         tracing.set_current(tracing.new_trace_id())  # traced, like REST
         dt, out = timed_loop()
         dt_on = min(dt_on, dt)
+        # traced + one structured log record per dispatch (access-log
+        # shape): the logging pillar's warm-path cost
+        dt, out = timed_loop_logged()
+        dt_log = min(dt_log, dt)
     tracing.set_current(prev_trace)
     assert out is not None and len(out) >= batch
     warm_compiles = om.xla_compile_count() - c0
     rows_per_sec = batch * iters / dt_on
     overhead_pct = 100.0 * (dt_on - dt_off) / dt_off
+    logging_overhead_pct = 100.0 * (dt_log - dt_on) / dt_on
     om.REGISTRY.gauge("h2o3_bench_scoring_rows_per_sec",
                       "warm-cache bucketed serving throughput"
                       ).set(rows_per_sec)
@@ -213,6 +231,7 @@ def scoring_bench() -> dict:
     return {"rows_per_sec": round(rows_per_sec),
             "rows_per_sec_untraced": round(batch * iters / dt_off),
             "tracing_overhead_pct": round(overhead_pct, 2),
+            "logging_overhead_pct": round(logging_overhead_pct, 2),
             "batch_rows": batch, "iters": iters,
             "bucket": serving.row_bucket(batch),
             "warm_compiles": int(warm_compiles)}
@@ -446,6 +465,7 @@ def main():
         "radix_shallow": bool(HP.radix_supported()),
         "scoring_rows_per_sec": (scoring or {}).get("rows_per_sec"),
         "tracing_overhead_pct": (scoring or {}).get("tracing_overhead_pct"),
+        "logging_overhead_pct": (scoring or {}).get("logging_overhead_pct"),
         "trace_id": bench_trace,
         "paths": paths,
         "ingest": ingest,

@@ -139,12 +139,14 @@ def load_modules(paths) -> list:
 
 # Rules waived wholesale for test files: tests deliberately jit lambdas,
 # call time.time() in fixtures, and seed impurity to prove the runtime
-# handles it — R001/R004 are perf rules for production paths, and R011's
+# handles it — R001/R004 are perf rules for production paths, R011's
 # span census is a production-vocabulary concern (throwaway fixture
-# spans are the point of a tracing test). Everything else (locks,
-# metrics, routes, R007-R010 concurrency) applies to tests too: a racy
-# test harness or a leaked test thread flakes the suite.
-TEST_RELAXED = {"R001", "R004", "R011"}
+# spans are the point of a tracing test), and R012's logging discipline
+# is for records an operator must find later (a test printing its
+# diagnostics is fine). Everything else (locks, metrics, routes,
+# R007-R010 concurrency) applies to tests too: a racy test harness or a
+# leaked test thread flakes the suite.
+TEST_RELAXED = {"R001", "R004", "R011", "R012"}
 
 
 def _is_test_file(rel: str) -> bool:
@@ -156,9 +158,9 @@ def analyze_modules(mods: list, rules=None) -> list:
     """Run every rule over the parsed modules; returns findings with
     inline suppressions already applied (but baseline NOT applied)."""
     from h2o3_tpu.analysis import callgraph, rules_jax, rules_locks, \
-        rules_metrics, rules_routes, rules_spans
+        rules_logging, rules_metrics, rules_routes, rules_spans
     findings: list = []
-    per_file = [rules_jax.check, rules_locks.check]
+    per_file = [rules_jax.check, rules_locks.check, rules_logging.check]
     project = [rules_metrics.check, rules_routes.check, rules_spans.check,
                callgraph.check]
     if rules:

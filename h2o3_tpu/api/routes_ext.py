@@ -46,21 +46,9 @@ def _h_capabilities(h, categ=None):
              "capabilities": caps})
 
 
-def _h_jstack(h):
-    """JStackHandler: per-thread stack dump (the Python analog of the JVM
-    thread dump — real, not stubbed)."""
-    import threading
-    import traceback
-    import sys
-    traces = []
-    frames = sys._current_frames()
-    for t in threading.enumerate():
-        fr = frames.get(t.ident)
-        stack = traceback.format_stack(fr) if fr is not None else []
-        traces.append({"thread_name": t.name, "daemon": t.daemon,
-                       "stack": "".join(stack)})
-    h._send({"__meta": {"schema_type": "JStackV3"},
-             "traces": traces})
+# (GET /3/JStack moved to api/server._h_jstack: all-thread stacks per
+# node with a cluster merge over the replay channel, plus the watchdog's
+# stalled-operation report.)
 
 
 def _nt_sum(a):
@@ -824,7 +812,6 @@ def build_routes():
         (R(r"/3/Ping"), "GET", _h_ping),
         (R(r"/3/Capabilities"), "GET", _h_capabilities),
         (R(r"/3/Capabilities/([^/]+)"), "GET", _h_capabilities),
-        (R(r"/3/JStack"), "GET", _h_jstack),
         (R(r"/3/NetworkTest"), "GET", _h_network_test),
         (R(r"/3/WaterMeterCpuTicks/([^/]+)"), "GET", _h_water_meter),
         (R(r"/3/WaterMeter/percentiles"), "GET", _h_water_meter),

@@ -187,6 +187,15 @@ class _Handler(BaseHTTPRequestHandler):
                 or _tracing.new_trace_id()
         self._trace_id = tid
         prev_trace = _tracing.set_current(tid)
+        # stall sentinel: a handler wedged past H2O3_WATCHDOG_STALL_S
+        # (a collective-rendezvous deadlock under a dispatch, a replay
+        # barrier that never acks) trips a pinned diagnostic trace with
+        # a cluster JStack instead of hanging silently
+        from h2o3_tpu.obs import watchdog as _wd
+        with _wd.watch("rest", desc=f"{method} {self.path}", trace=tid):
+            self._route_traced(method, tid, prev_trace, t0)
+
+    def _route_traced(self, method, tid, prev_trace, t0):
         try:
             if tid is not None:
                 with _span("rest.request", method=method) as sp:
@@ -273,7 +282,8 @@ def _is_obs_path(path: str) -> bool:
     profiles THIS node, and the jax profiler is process-global state the
     replay barrier must not serialize behind."""
     return path in ("/metrics", "/3/Timeline", "/3/WaterMeter",
-                    "/3/Profiler", "/3/Traces", "/3/Alerts") \
+                    "/3/Profiler", "/3/Traces", "/3/Alerts",
+                    "/3/JStack") \
         or path.startswith("/3/Logs") or path.startswith("/3/Trace/")
 
 
@@ -685,10 +695,114 @@ def _h_automl(h: _Handler, pid):
              "leader": rows[0] if rows else None})
 
 
-def _h_logs(h: _Handler, *_):
+def _h_logs_download(h: _Handler):
+    """GET /3/Logs/download — the legacy one-shot dump: this host's
+    recent formatted log lines (water/util/GetLogsFromNode analog)."""
     from h2o3_tpu.utils import log as _log
     h._send({"__meta": {"schema_type": "LogsV3"},
              "log": "\n".join(_log.recent(500))})
+
+
+def _h_logs_search(h: _Handler):
+    """GET /3/Logs?level=&since=&trace=&grep=&limit= — structured log
+    search over ring + durable segments, CLUSTER-scoped: the same
+    filters fan out to every worker over the `logs:` collect op and the
+    records merge time-sorted (newest first) with host labels already on
+    each record. A lagging host is flagged, never waited on."""
+    import json as _json
+    from h2o3_tpu.obs import timeline as _obs_tl
+    from h2o3_tpu.utils import log as _log
+    p = h._params()
+    try:
+        since = float(p["since"]) if p.get("since") else None
+        limit = int(p.get("limit") or 200)
+    except ValueError:
+        return h._error("since/limit must be numeric", 400)
+    filters = {"level": p.get("level") or None, "since": since,
+               "trace": p.get("trace") or None,
+               "grep": p.get("grep") or None, "limit": limit}
+    recs = _log.search(**filters)
+    hosts = [{"host": _obs_tl.host_id(), "n_records": len(recs),
+              "files": [f["name"] for f in _log.list_files()]}]
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None and str(p.get("scope", "")).lower() != "local":
+        op = "logs:search:" + _json.dumps(filters)
+        seen = {(r.get("host"), r.get("id")) for r in recs}
+        for i, remote in enumerate(bc.collect(op,
+                                              timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                rr = [r for r in remote.get("records", [])
+                      if (r.get("host"), r.get("id")) not in seen]
+                seen.update((r.get("host"), r.get("id")) for r in rr)
+                recs.extend(rr)
+                hosts.append({"host": remote.get("host", i + 1),
+                              "n_records": len(rr),
+                              "files": remote.get("files", [])})
+            else:
+                hosts.append({"host": i + 1, "n_records": None,
+                              "lagging": True})
+    recs.sort(key=lambda r: r.get("t") or 0.0, reverse=True)
+    h._send({"__meta": {"schema_type": "LogsV3"},
+             "records": recs[:limit], "n_records": min(len(recs), limit),
+             "hosts": hosts})
+
+
+def _h_logs_node_file(h: _Handler, node, name):
+    """GET /3/Logs/nodes/{node}/files/{name} — the named NODE's durable
+    log file content (GetLogsFromNode routed over the replay channel),
+    not the coordinator's ring. `node` is a host rank or "self"; `name`
+    a file basename from GET /3/Logs hosts[].files, or "default" for
+    the node's newest file."""
+    from h2o3_tpu.obs import timeline as _obs_tl
+    from h2o3_tpu.utils import log as _log
+    local = _obs_tl.host_id()
+    if node in ("self", "-1", str(local)):
+        content = _log.read_file(name)
+        if content is None:
+            return h._error(f"log file {name!r} not found on node "
+                            f"{local}", 404)
+        return h._send({"__meta": {"schema_type": "LogsV3"},
+                        "node": local, "name": name, "log": content})
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is None:
+        return h._error(f"unknown node {node!r} (single-host cloud)", 404)
+    for remote in bc.collect(f"logs:file:{node}:{name}",
+                             timeout=_collect_timeout()):
+        if isinstance(remote, dict) and remote.get("log") is not None:
+            return h._send({"__meta": {"schema_type": "LogsV3"},
+                            "node": remote.get("host"),
+                            "name": remote.get("name", name),
+                            "log": remote["log"]})
+    return h._error(f"log file {name!r} not found on node {node!r} "
+                    "(host absent, lagging, or no such file)", 404)
+
+
+def _h_jstack(h: _Handler):
+    """GET /3/JStack — all-thread stack dumps per node with a cluster
+    merge (water/api/JStackHandler analog): this host's threads plus
+    every worker's over the `jstack` collect op, and the watchdog's
+    currently-stalled operations so a live hang is visible in the same
+    response that shows the threads stuck in it."""
+    from h2o3_tpu.obs import timeline as _obs_tl
+    from h2o3_tpu.obs import watchdog as _wd
+    traces = [{"node": f"h2o3-{_obs_tl.host_id()}",
+               "host": _obs_tl.host_id(),
+               "thread_traces": _wd.thread_dump()}]
+    lagging = []
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        for i, remote in enumerate(bc.collect("jstack",
+                                              timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                traces.append({"node": f"h2o3-{remote.get('host', i + 1)}",
+                               "host": remote.get("host", i + 1),
+                               "thread_traces": remote.get("threads", [])})
+            else:
+                lagging.append(i + 1)
+    h._send({"__meta": {"schema_type": "JStackV3"},
+             "traces": traces, "lagging_hosts": lagging,
+             "stalled": _wd.WATCHDOG.stalled(),
+             "trips": _wd.WATCHDOG.trips()})
 
 
 def _collect_timeout() -> float:
@@ -740,12 +854,17 @@ def _h_trace(h: _Handler, tid):
     the flight recorder's durable segments (so a trace evicted from the
     ring — or recorded by a PREVIOUS process over the same ice_root — is
     still answerable), then every worker's fragments over the replay
-    channel. Bounded by the same collect deadline as /3/Timeline."""
+    channel. Correlated structured LOG records (utils/log, matched by
+    trace id cluster-wide) interleave into the view as a time-sorted
+    `logs` array. Bounded by the same collect deadline as /3/Timeline."""
     from h2o3_tpu.obs import recorder as _obs_rec
     from h2o3_tpu.obs import timeline as _obs_tl
+    from h2o3_tpu.utils import log as _log
     spans, disk = _obs_rec.RECORDER.read_through(
         tid, _obs_tl.SPANS.trace_snapshot(tid))
     seen = {(s.get("host"), s.get("id")) for s in spans}
+    logs = _log.trace_records(tid)
+    seen_logs = {(r.get("host"), r.get("id")) for r in logs}
     hosts = [{"host": _obs_tl.host_id(), "n_spans": len(spans),
               "from_disk": disk}]
     bc = getattr(h.server, "broadcaster", None)
@@ -760,15 +879,20 @@ def _h_trace(h: _Handler, tid):
                       if (s.get("host"), s.get("id")) not in seen]
                 seen.update((s.get("host"), s.get("id")) for s in rs)
                 spans.extend(rs)
+                rl = [r for r in remote.get("logs", [])
+                      if (r.get("host"), r.get("id")) not in seen_logs]
+                seen_logs.update((r.get("host"), r.get("id")) for r in rl)
+                logs.extend(rl)
                 hosts.append({"host": remote.get("host", i + 1),
                               "n_spans": len(rs)})
             else:
                 hosts.append({"host": i + 1, "n_spans": None,
                               "lagging": True})
     spans.sort(key=lambda s: s.get("start") or 0.0)
+    logs.sort(key=lambda r: r.get("t") or 0.0)
     h._send({"__meta": {"schema_type": "TraceV3"},
              "trace_id": tid, "spans": spans, "hosts": hosts,
-             "n_spans": len(spans)})
+             "n_spans": len(spans), "logs": logs, "n_logs": len(logs)})
 
 
 def _h_traces(h: _Handler):
@@ -847,16 +971,23 @@ def _h_metrics(h: _Handler):
     ?format=openmetrics), the single-host body carries histogram
     EXEMPLARS — the trace ids latency observations recorded — which
     Prometheus stores under --enable-feature=exemplar-storage; the
-    cluster merge stays 0.0.4 (exemplars are process-local)."""
+    cluster merge propagates them too (host-tagged), so click-through
+    works on the federated scrape as well as the per-host one."""
     from h2o3_tpu.obs import metrics as _obs_m
     _obs_m.install_runtime_gauges()
     p = h._params()
     ctype = "text/plain; version=0.0.4; charset=utf-8"
+    openmetrics = "openmetrics" in (h.headers.get("Accept") or "") \
+        or p.get("format") == "openmetrics"
     if p.get("scope") == "cluster":
         snaps, _ = _cluster_metric_snapshots(h)
-        body = _obs_m.cluster_prometheus_text(snaps).encode()
-    elif "openmetrics" in (h.headers.get("Accept") or "") \
-            or p.get("format") == "openmetrics":
+        if openmetrics:
+            body = _obs_m.cluster_openmetrics_text(snaps).encode()
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8")
+        else:
+            body = _obs_m.cluster_prometheus_text(snaps).encode()
+    elif openmetrics:
         body = _obs_m.REGISTRY.openmetrics_text().encode()
         ctype = "application/openmetrics-text; version=1.0.0; charset=utf-8"
     else:
@@ -1009,8 +1140,11 @@ ROUTES = [
     (re.compile(r"/99/Grids/([^/]+)"), "GET", _h_grid),
     (re.compile(r"/99/AutoMLBuilder"), "POST", _h_automl_build),
     (re.compile(r"/99/AutoML/([^/]+)"), "GET", _h_automl),
-    (re.compile(r"/3/Logs/download"), "GET", _h_logs),
-    (re.compile(r"/3/Logs/nodes/([^/]+)/files/([^/]+)"), "GET", _h_logs),
+    (re.compile(r"/3/Logs"), "GET", _h_logs_search),
+    (re.compile(r"/3/Logs/download"), "GET", _h_logs_download),
+    (re.compile(r"/3/Logs/nodes/([^/]+)/files/([^/]+)"), "GET",
+     _h_logs_node_file),
+    (re.compile(r"/3/JStack"), "GET", _h_jstack),
     (re.compile(r"/3/Timeline"), "GET", _h_timeline),
     (re.compile(r"/3/Trace/([^/]+)"), "GET", _h_trace),
     (re.compile(r"/3/Traces"), "GET", _h_traces),
@@ -1132,6 +1266,18 @@ class H2OServer:
         # burn-rate evaluator (idle when the env is unset)
         from h2o3_tpu.obs import slo as _slo
         _slo.install_from_env()
+        # stall watchdog: start the sentinel and hand it the cluster
+        # fan-out (read dynamically — the multihost bootstrap and the
+        # test harness both attach the broadcaster around start())
+        from h2o3_tpu.obs import watchdog as _wd
+
+        def _wd_collect(op, timeout):
+            bc = getattr(self.httpd, "broadcaster", None)
+            return bc.collect(op, timeout=timeout) if bc is not None \
+                else []
+
+        _wd.WATCHDOG.set_collector(_wd_collect)
+        _wd.WATCHDOG.start()
         if background:
             self.thread = threading.Thread(target=self.httpd.serve_forever,
                                            daemon=True, name="h2o3-rest")
@@ -1152,5 +1298,6 @@ def start_server(port: int = 54321) -> H2OServer:
 if __name__ == "__main__":
     import sys
     port = int(sys.argv[1]) if len(sys.argv) > 1 else 54321
-    print(f"h2o3-tpu REST server on :{port}")
+    from h2o3_tpu.utils import log as _ulog
+    _ulog.info("h2o3-tpu REST server on :%s", port)
     H2OServer(port).start(background=False)

@@ -298,7 +298,9 @@ class Broadcaster:
                 self._bufs.append(b"")
                 self._dead.append(False)
             except Exception as ex:  # noqa: BLE001 — drop peer, re-arm slot
-                print(f"replay channel: rejected peer {addr}: {ex}")
+                from h2o3_tpu.utils import log as _ulog
+                _ulog.warn("replay channel: rejected peer %s: %s",
+                           addr, ex)
                 conn.close()
         srv.close()
 
@@ -364,7 +366,18 @@ class Broadcaster:
                   sampled=False):
         import socket as _socket
         import time as _time
-        with self._lock:
+        # watchdog: the ack barrier is the classic wedge point — a worker
+        # that stopped acking stalls every REST thread behind this lock.
+        # The watch deadline must undercut H2O3_REPLAY_ACK_TIMEOUT_S (the
+        # wait's own bound, after which the context EXITS): at half the
+        # ack timeout the sentinel captures the cluster JStack while the
+        # barrier is still stuck, not after it already raised
+        from h2o3_tpu.obs import watchdog as _wd
+        with _wd.watch("replay", desc=f"broadcast {method} {path}",
+                       deadline_s=min(_ack_timeout() / 2,
+                                      _wd._stall_s()),
+                       trace=trace), \
+                self._lock:
             self._seq += 1
             deadline = _time.monotonic() + _ack_timeout()
             msg = {"seq": self._seq, "method": method, "path": path,
@@ -491,13 +504,44 @@ def _collect_local(op: str):
                     "metrics": _m.REGISTRY.to_dict()}
         if op.startswith("trace:"):
             # GET /3/Trace/{id} read-through: this host's ring spans for
-            # ONE trace plus whatever its flight recorder retained
+            # ONE trace plus whatever its flight recorder retained, plus
+            # the trace-correlated structured log records (the
+            # interleaved `logs` view on the coordinator)
             from h2o3_tpu.obs import recorder as _rec
             from h2o3_tpu.obs import timeline as _tl
+            from h2o3_tpu.utils import log as _ulog
             tid = op[len("trace:"):]
             spans, _n = _rec.RECORDER.read_through(
                 tid, _tl.SPANS.trace_snapshot(tid, limit=512), limit=512)
-            return {"host": _tl.host_id(), "spans": spans}
+            return {"host": _tl.host_id(), "spans": spans,
+                    "logs": _ulog.trace_records(tid, limit=256)}
+        if op == "jstack":
+            # GET /3/JStack cluster merge + the watchdog's cluster
+            # capture: this host's all-thread dump
+            from h2o3_tpu.obs import timeline as _tl
+            from h2o3_tpu.obs import watchdog as _wd
+            return {"host": _tl.host_id(), "threads": _wd.thread_dump()}
+        if op.startswith("logs:search:"):
+            # GET /3/Logs cluster search: same filters, this host's
+            # ring + durable segments
+            import json as _json
+            from h2o3_tpu.obs import timeline as _tl
+            from h2o3_tpu.utils import log as _ulog
+            filters = _json.loads(op[len("logs:search:"):])
+            return {"host": _tl.host_id(),
+                    "records": _ulog.search(**filters),
+                    "files": [f["name"] for f in _ulog.list_files()]}
+        if op.startswith("logs:file:"):
+            # GET /3/Logs/nodes/{node}/files/{name}: only the NAMED node
+            # ships content; everyone else acks with a bare host marker
+            from h2o3_tpu.obs import timeline as _tl
+            from h2o3_tpu.utils import log as _ulog
+            node, _, name = op[len("logs:file:"):].partition(":")
+            me = _tl.host_id()
+            if node not in (str(me), "any"):
+                return {"host": me}
+            return {"host": me, "name": name,
+                    "log": _ulog.read_file(name)}
         if op.startswith("profiler:"):
             # cluster-wide capture fan-out (POST /3/Profiler?cluster=1):
             # start/stop this host's profiler session; a sampling stop
@@ -561,6 +605,7 @@ def worker_loop(coordinator_host: str, port: int):
             # this host's fragment in
             from h2o3_tpu.obs import tracing as _tr
             from h2o3_tpu.obs.timeline import span as _span
+            from h2o3_tpu.utils import log as _ulog
             with _tr.trace(msg.get("trace")), \
                     _span("replay.request", path=msg["path"],
                           method=msg["method"]) as _sp:
@@ -570,14 +615,22 @@ def worker_loop(coordinator_host: str, port: int):
                     _sp.attrs["sampled"] = 1
                     from h2o3_tpu.obs import recorder as _rec
                     _rec.RECORDER.pin(msg.get("trace"))
+                # structured + trace-correlated: this record is what the
+                # coordinator's GET /3/Trace/{id} interleaves for the
+                # worker's fragment, and what GET /3/Logs?trace= finds
+                _ulog.info("replay %s %s seq=%s", msg["method"],
+                           msg["path"], msg["seq"])
                 try:
                     replay_request(msg["method"], msg["path"],
                                    msg["params"])
                 except Exception as e:
                     # the error attr makes THIS host's recorder retain
                     # its fragment of the failed trace — the 5xx status
-                    # lives only on the coordinator's root span
+                    # lives only on the coordinator's root span; the
+                    # ERROR record marks the trace for retention too
                     _sp.attrs["error"] = repr(e)
+                    _ulog.err("replay %s %s failed: %r", msg["method"],
+                              msg["path"], e)
                     raise
         except Exception:                 # keep replaying; process 0 owns
             import traceback              # error reporting to the client
@@ -601,8 +654,9 @@ def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
         srv = H2OServer(port)
         if nproc > 1:
             srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)
-        print(f"h2o3-tpu cloud: {cloud.n_devices} chips over "
-              f"{nproc} hosts; REST on :{port}")
+        from h2o3_tpu.utils import log as _ulog
+        _ulog.info("h2o3-tpu cloud: %s chips over %s hosts; REST on :%s",
+                   cloud.n_devices, nproc, port)
         srv.start(background=False)
     else:
         host = os.environ.get("H2O3_COORDINATOR_ADDRESS",

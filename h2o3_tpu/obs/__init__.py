@@ -16,8 +16,18 @@ sampling captures behind `POST /3/Profiler`; the metrics registry gains
 cluster federation (`GET /metrics?scope=cluster` merges every host's
 snapshot under a per-host `host=` label).
 
+Hang diagnostics (ISSUE 8): `watchdog` watches REST dispatch,
+micro-batch waits, replay ack barriers and device dispatches for stalls
+past H2O3_WATCHDOG_STALL_S and turns a hang into a pinned diagnostic
+trace (cluster JStack + log tail, durable under ice_root); the
+structured logger (utils/log) correlates every record to the active
+trace/span and marks ERROR-logged traces for recorder retention.
+
 Env surface:
   H2O3_OBS_TIMELINE_CAPACITY  span ring size (default 4096)
+  H2O3_WATCHDOG               "0" disables the stall sentinel
+  H2O3_WATCHDOG_STALL_S       stall deadline for watched ops (300)
+  H2O3_WATCHDOG_POLL_S        sentinel scan period (stall/4, max 5)
   H2O3_OBS_TRACE_DIR          xprof bridge: jax.profiler trace output dir
   H2O3_OBS_TRACE_SPAN         span-name prefix that triggers the capture
   H2O3_TRACING                "0" disables REST trace-id minting

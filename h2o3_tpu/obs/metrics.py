@@ -240,11 +240,24 @@ class Histogram(_Metric):
         return lines
 
     def _json(self):
+        bounds = [_fmt_num(b) for b in self.buckets] + ["+Inf"]
         with self._lock:
-            return [{"labels": dict(k), "sum": s["sum"], "count": s["count"],
-                     "buckets": dict(zip([_fmt_num(b) for b in self.buckets]
-                                         + ["+Inf"], s["counts"]))}
-                    for k, s in sorted(self._series.items())]
+            out = []
+            for k, s in sorted(self._series.items()):
+                d = {"labels": dict(k), "sum": s["sum"],
+                     "count": s["count"],
+                     "buckets": dict(zip(bounds, s["counts"]))}
+                ex = s.get("exemplars")
+                if ex:
+                    # exemplars ride the JSON snapshot so the CLUSTER
+                    # merge can re-emit them host-tagged (the ISSUE-7
+                    # gap: the federated scrape used to strip them)
+                    d["exemplars"] = [
+                        {"le": bounds[i], "trace_id": tid,
+                         "value": v, "ts": ts}
+                        for i, (tid, v, ts) in sorted(ex.items())]
+                out.append(d)
+            return out
 
 
 class MetricsRegistry:
@@ -387,17 +400,39 @@ def merge_cluster_snapshots(snapshots: list) -> dict:
             for s in m.get("series") or []:
                 s2 = dict(s)
                 s2["labels"] = dict(s.get("labels") or {}, host=str(host))
+                if s.get("exemplars"):
+                    # host-tag each exemplar too: the trace id resolves
+                    # at GET /3/Trace/{id} on the coordinator either
+                    # way, but Grafana shows WHICH host observed it
+                    s2["exemplars"] = [dict(e, host=str(host))
+                                       for e in s["exemplars"]]
                 dst["series"].append(s2)
     return merged
 
 
-def _render_series(name: str, kind: str, series: list) -> list:
+def _exemplar_suffix(exemplars: list, le: str) -> str:
+    """OpenMetrics exemplar suffix for one merged bucket line, or ""."""
+    for e in exemplars or ():
+        if e.get("le") == le and e.get("trace_id"):
+            lbls = f'trace_id="{_escape(str(e["trace_id"]))}"'
+            if e.get("host") is not None:
+                lbls += f',host="{_escape(str(e["host"]))}"'
+            return (f" # {{{lbls}}} {_fmt_num(e.get('value', 0.0))}"
+                    f" {float(e.get('ts', 0.0)):.3f}")
+    return ""
+
+
+def _render_series(name: str, kind: str, series: list,
+                   exemplars: bool = False) -> list:
     """Exposition lines for one metric's merged JSON series (the
     registry's _expose over live objects, re-done over snapshots that
-    crossed the wire as JSON)."""
+    crossed the wire as JSON). With `exemplars` (the cluster OpenMetrics
+    renderer) histogram bucket lines re-emit the host-tagged exemplars
+    the snapshots carried."""
     lines = []
     for s in series:
         key = _label_key(s.get("labels") or {})
+        ex = s.get("exemplars") if exemplars else None
         if kind == "histogram":
             buckets = s.get("buckets") or {}
             cum = 0
@@ -406,10 +441,12 @@ def _render_series(name: str, kind: str, series: list) -> list:
                     continue
                 cum += int(c)
                 lines.append(f"{name}_bucket"
-                             f"{_fmt_labels(key, (('le', ub),))} {cum}")
+                             f"{_fmt_labels(key, (('le', ub),))} {cum}"
+                             + _exemplar_suffix(ex, ub))
             cum += int(buckets.get("+Inf", 0))
             lines.append(f"{name}_bucket"
-                         f"{_fmt_labels(key, (('le', '+Inf'),))} {cum}")
+                         f"{_fmt_labels(key, (('le', '+Inf'),))} {cum}"
+                         + _exemplar_suffix(ex, "+Inf"))
             lines.append(f"{name}_sum{_fmt_labels(key)}"
                          f" {_fmt_num(s.get('sum', 0.0))}")
             lines.append(f"{name}_count{_fmt_labels(key)}"
@@ -430,6 +467,27 @@ def cluster_prometheus_text(snapshots: list) -> str:
         out.append(f"# HELP {name} {_escape(m['help'])}")
         out.append(f"# TYPE {name} {m['kind']}")
         out.extend(_render_series(name, m["kind"], m["series"]))
+    return "\n".join(out) + "\n"
+
+
+def cluster_openmetrics_text(snapshots: list) -> str:
+    """OpenMetrics 1.0 exposition of the merged cluster view — the
+    GET /metrics?scope=cluster body when the scraper negotiates
+    OpenMetrics: same merge as cluster_prometheus_text, but histogram
+    buckets keep their (host-tagged) exemplars so Grafana click-through
+    works on the federated scrape too."""
+    merged = merge_cluster_snapshots(snapshots)
+    out = []
+    for name in sorted(merged):
+        m = merged[name]
+        family = name
+        if m["kind"] == "counter" and family.endswith("_total"):
+            family = family[: -len("_total")]
+        out.append(f"# HELP {family} {_escape(m['help'])}")
+        out.append(f"# TYPE {family} {m['kind']}")
+        out.extend(_render_series(name, m["kind"], m["series"],
+                                  exemplars=True))
+    out.append("# EOF")
     return "\n".join(out) + "\n"
 
 

@@ -62,6 +62,7 @@ import time
 
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs import segments as _segs
 
 SPANS_SEEN = _om.counter(
     "h2o3_recorder_spans_total",
@@ -153,6 +154,10 @@ class FlightRecorder:
         # fragment already durable (the rest must follow it to disk)
         self._pinned: dict = {}
         self._sticky: dict = {}
+        # traces a structured ERROR log record was correlated to (the
+        # utils/log keep-rule producer): retained like error spans even
+        # when every span in them closed fast and 2xx
+        self._errored: dict = {}
         # recently-downsampled fragments, kept briefly in memory: a
         # LATER fragment of the same trace may yet error (fast-OK
         # request root closes before its background job fails) and must
@@ -179,6 +184,7 @@ class FlightRecorder:
             self._buf.clear()
             self._pinned.clear()
             self._sticky.clear()
+            self._errored.clear()
             self._dropped.clear()
             self._dropped_n = 0
 
@@ -211,6 +217,24 @@ class FlightRecorder:
             return
         with self._lock:
             self._remember(self._pinned, trace_id)
+
+    def mark_error(self, trace_id):
+        """Mark a trace errored from OUTSIDE the span path — the
+        structured logger calls this for every ERROR-level record that
+        carries a trace id, so "request logged an error" is a keep rule
+        even when no span recorded a 5xx status or an `error` attr.
+        Fragments of the trace already downsampled are healed to disk
+        immediately (the ERROR may arrive after a fast-OK root closed)."""
+        if trace_id is None or not enabled():
+            return
+        with self._lock:
+            self._remember(self._errored, trace_id)
+            prior = self._dropped.pop(trace_id, None)
+            if prior:
+                self._dropped_n -= len(prior)   # h2o3-ok: R003 under self._lock — the with-block two lines up
+                SPANS_SEEN.inc(len(prior), disposition="healed")
+                self._remember(self._sticky, trace_id)
+                self._append_locked(prior)
 
     # ---- ingest (called by SpanTimeline.end, outside the ring lock) -----
     def on_span_end(self, sp):
@@ -267,6 +291,8 @@ class FlightRecorder:
 
     def _finalize_locked(self, tid, spans: list, overflow: bool = False):
         reason = _must_retain(spans)
+        if reason is None and tid in self._errored:
+            reason = "error"        # an ERROR log record named this trace
         if reason is None and tid in self._pinned:
             reason = "sampled"
         if reason is None and tid in self._sticky:
@@ -312,15 +338,9 @@ class FlightRecorder:
         self._written = 0
 
     def _segment_alive_locked(self) -> bool:
-        """True while the active segment path still names our open file.
-        Checked by PATH + inode, not fstat st_nlink: overlayfs (the
-        usual container fs) keeps nlink at 1 on an fd whose upper-layer
-        file was unlinked."""
-        try:
-            return os.stat(self._path).st_ino == \
-                os.fstat(self._fh.fileno()).st_ino
-        except OSError:
-            return False
+        """True while the active segment path still names our open file
+        (obs/segments.alive — the shared overlayfs-safe inode check)."""
+        return _segs.alive(self._path, self._fh)
 
     def _append_locked(self, spans: list):
         try:
@@ -350,41 +370,11 @@ class FlightRecorder:
             self._close_locked()
 
     def _segments(self) -> list:
-        """All segment files under the root, oldest first (mtime, then
-        name for stability)."""
-        d = self.root()
-        try:
-            names = [n for n in os.listdir(d) if n.endswith(".jsonl")]
-        except OSError:
-            return []
-        paths = [os.path.join(d, n) for n in names]
-        out = []
-        for p in paths:
-            try:
-                st = os.stat(p)
-            except OSError:
-                continue
-            out.append((st.st_mtime, p, st.st_size))
-        out.sort()
-        return out
+        """All segment files under the root, oldest first."""
+        return _segs.list_segments(self.root())
 
     def _gc_locked(self):
-        budget = _retain_bytes()
-        segs = self._segments()
-        total = sum(sz for _, _, sz in segs)
-        for _, p, sz in segs:
-            if total <= budget:
-                break
-            if p == self._path:
-                continue            # never delete the active segment
-            try:
-                os.unlink(p)
-            except FileNotFoundError:
-                pass                # another process's GC won the race
-            except OSError:
-                continue            # undeletable (perms/ro-fs): its
-                #                     bytes are still on disk and count
-            total -= sz
+        _segs.gc(self.root(), _retain_bytes(), keep_path=self._path)
 
     def disk_bytes(self) -> int:
         # gauge callback: every /metrics scrape doubles as the periodic
@@ -407,8 +397,6 @@ class FlightRecorder:
         JSON parse: any span carrying a trace id as its own or a link
         contains it literally, so the filter is exact for that use."""
         segs = self._segments()
-        if newest_first:
-            segs = list(reversed(segs))
         with self._lock:
             fh = self._fh
             if fh is not None:
@@ -416,21 +404,8 @@ class FlightRecorder:
                     fh.flush()
                 except OSError:
                     pass
-        for _, p, _sz in segs:
-            try:
-                with open(p, encoding="utf-8") as fh:
-                    lines = fh.readlines()
-            except OSError:
-                continue
-            if newest_first:
-                lines = reversed(lines)
-            for line in lines:
-                if contains is not None and contains not in line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except (json.JSONDecodeError, ValueError):
-                    continue        # torn append from a crashed writer
+        yield from _segs.iter_jsonl(segs, newest_first=newest_first,
+                                    contains=contains)
 
     def load_trace(self, trace_id: str, limit: int = 2048) -> list:
         """Every durably-retained span of one trace (the GET /3/Trace/{id}

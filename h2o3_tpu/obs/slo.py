@@ -35,10 +35,20 @@ SLO spec fields (JSON object per SLO):
   windows       [[short_s, long_s, burn_factor], ...] (default
                 [[300, 3600, 14.4], [1800, 21600, 6.0]])
 
+Durability: the sample ring is periodically persisted to
+`<ice_root>/obs/slo/samples-h<host>.json` and reloaded on start, so
+multi-window burn HISTORY survives a process restart — the warm-up
+coverage scaling then applies only to genuinely unseen history, not to
+history the previous process already observed. Restored rings carry the
+old process's cumulative totals; fresh totals (which restart at zero)
+are rebased onto them so deltas stay monotone across the boundary.
+
 Env surface:
-  H2O3_SLO_FILE    path to the spec file (unset = engine idle)
-  H2O3_SLO_EVAL_S  background evaluation period (default 30; 0 = only
-                   evaluate on GET /3/Alerts)
+  H2O3_SLO_FILE       path to the spec file (unset = engine idle)
+  H2O3_SLO_EVAL_S     background evaluation period (default 30; 0 = only
+                      evaluate on GET /3/Alerts)
+  H2O3_SLO_PERSIST_S  min seconds between sample-ring persists
+                      (default 30; 0 disables persistence)
 """
 
 from __future__ import annotations
@@ -137,6 +147,10 @@ class SLOEngine:
         self._specs: list = list(specs or [])
         self._samples: dict = {}    # name -> deque[(ts, total, bad)]
         self._state: dict = {}      # name -> alert state dict
+        self._offset: dict = {}     # name -> (total0, bad0): restored
+        #                             history's final cumulative counts,
+        #                             added to fresh post-restart totals
+        self._last_persist = 0.0
         self._thread = None
         # output metrics live on THIS engine's registry: a scratch
         # engine over an isolated registry (tests) must not publish
@@ -170,6 +184,7 @@ class SLOEngine:
                     self._transitions.name, self._transitions.help)
             self._samples.clear()
             self._state.clear()
+            self._offset.clear()
             self._burn.clear()
             self._active.clear()
 
@@ -179,6 +194,90 @@ class SLOEngine:
     def specs(self) -> list:
         with self._lock:
             return list(self._specs)
+
+    # ---- sample-ring durability -----------------------------------------
+    @staticmethod
+    def persist_path() -> str:
+        """Per-host state file under the ice root (two processes sharing
+        an ice root in tests must not clobber each other's history)."""
+        from h2o3_tpu.io import spill as _spill
+        from h2o3_tpu.obs import timeline as _tl
+        return os.path.join(_spill.get_ice_root(), "obs", "slo",
+                            f"samples-h{_tl.host_id()}.json")
+
+    @staticmethod
+    def _persist_min_s() -> float:
+        try:
+            return float(os.environ.get("H2O3_SLO_PERSIST_S", "30") or 30)
+        except ValueError:
+            return 30.0
+
+    def persist(self):
+        """Write the sample rings (and alert states) atomically. The
+        snapshot is taken under the lock; the file write happens outside
+        it (the R008 discipline: no disk I/O while locked)."""
+        path = self.persist_path()
+        with self._lock:
+            state = {
+                "version": 1,
+                "saved_at": time.time(),
+                "samples": {name: [list(s) for s in ring]
+                            for name, ring in self._samples.items()},
+                "firing": {name: {k: v for k, v in st.items()
+                                  if k in ("firing", "since", "trace",
+                                           "window")}
+                           for name, st in self._state.items()},
+            }
+        tmp = path + f".tmp{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(state, fh)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def restore(self) -> bool:
+        """Reload persisted burn history for the CONFIGURED specs and
+        rebase the registry's CURRENT totals onto each ring's final
+        cumulative counts, so the first post-restore delta is the real
+        traffic since the save — not a negative (fresh process) and not
+        a double count (an in-process re-install over a registry that
+        already holds live totals). Returns True when any history was
+        restored."""
+        path = self.persist_path()
+        try:
+            with open(path, encoding="utf-8") as fh:
+                state = json.load(fh)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return False
+        got = False
+        with self._lock:
+            by_name = {s.name: s for s in self._specs}
+            for name, samples in (state.get("samples") or {}).items():
+                spec = by_name.get(name)
+                if spec is None or not samples:
+                    continue
+                ring = deque((float(t), float(tot), float(bad))
+                             for t, tot, bad in samples)
+                self._samples[name] = ring
+                # offset = persisted_last - current: future totals read
+                # persisted_last + (traffic since this restore), whether
+                # the registry restarted at zero or kept counting
+                cur_total, cur_bad = self._totals(spec)
+                self._offset[name] = (ring[-1][1] - cur_total,
+                                      ring[-1][2] - cur_bad)
+                st = self._state.setdefault(
+                    name, {"slo": name, "firing": False, "since": None,
+                           "trace": None, "burn": {}, "window": None})
+                st.update({k: v for k, v in
+                           (state.get("firing") or {}).get(name, {}).items()
+                           if k in ("firing", "since", "trace", "window")})
+                got = True
+        return got
 
     # ---- SLI extraction -------------------------------------------------
     def _totals(self, spec: SLOSpec):
@@ -245,6 +344,13 @@ class SLOEngine:
         with self._lock:
             for spec in self._specs:
                 total, bad = self._totals(spec)
+                off = self._offset.get(spec.name)
+                if off:
+                    # restored history: fresh totals restart at zero —
+                    # rebase onto the persisted cumulative counts so the
+                    # cross-restart delta is traffic, not a negative
+                    total += off[0]
+                    bad += off[1]
                 ring = self._samples.setdefault(spec.name, deque())
                 max_w = max((w[1] for w in spec.windows),
                             default=3600.0)
@@ -303,6 +409,12 @@ class SLOEngine:
         for spec, state, burn, window, trace_id in transitions:
             self._transitions.inc(slo=spec.name, state=state)
             _alert_span(spec, state, burn, window, trace_id)
+        # periodic durability (gated, outside the lock): burn history
+        # survives a restart instead of resetting with the process
+        min_s = self._persist_min_s()
+        if self._specs and min_s > 0 and now - self._last_persist >= min_s:
+            self._last_persist = now
+            self.persist()
         return alerts
 
     def alerts(self) -> list:
@@ -355,4 +467,7 @@ def install_from_env():
     if not path or not os.path.isfile(path):
         return None
     ENGINE.load(path)
+    # reload persisted burn history (multi-window history survives the
+    # restart; warm-up scaling then covers only genuinely unseen time)
+    ENGINE.restore()
     return ENGINE.start()

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs import watchdog as _wd
 from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.parallel import mesh as _mesh
@@ -124,11 +125,21 @@ def cached_jit(fn, **jit_kwargs):
 def _traced_dispatch(name: str, jfn, arrays, fn):
     """Dispatch `jfn(*arrays)`, recording an mrtask phase span when the
     calling thread is inside an active trace (obs/tracing). Untraced
-    callers — training inner loops, bench — pay a single TLS read."""
-    if _tracing.current() is not None:
-        with _span(name, fn=getattr(fn, "__name__", "<fn>")):
-            return jfn(*arrays)
-    return jfn(*arrays)
+    callers — training inner loops, bench — pay the trace TLS read plus
+    one watchdog registration (a slotted dict insert/remove under a
+    leaf lock, a few microseconds).
+
+    Every dispatch is watchdog-watched: a device program blocked past
+    H2O3_WATCHDOG_STALL_S (the XLA:CPU collective-rendezvous deadlock —
+    two in-flight multi-replica executions starving each other's
+    thread-pool slots) trips a pinned diagnostic trace with a cluster
+    JStack instead of hanging the process silently."""
+    fname = getattr(fn, "__name__", "<fn>")
+    with _wd.watch("device", desc=f"{name}:{fname}"):
+        if _tracing.current() is not None:
+            with _span(name, fn=fname):
+                return jfn(*arrays)
+        return jfn(*arrays)
 
 
 def prefetch_chunks(handles):

@@ -165,10 +165,25 @@ class MicroBatcher:
                         r.error = r.error or err
                         r.event.set()
                 raise
-        elif not req.event.wait(timeout=_wait_s()):
-            WAIT_TIMEOUTS.inc()
-            raise TimeoutError("micro-batched scoring dispatch timed out "
-                               f"after {_wait_s():g}s (H2O3_SCORE_WAIT_S)")
+        else:
+            # watchdog-watched: a follower stuck behind a wedged leader
+            # dispatch is a stall the sentinel should diagnose (cluster
+            # JStack shows WHERE the leader is stuck) before the bounded
+            # wait below turns it into a plain timeout — so the watch
+            # deadline must undercut H2O3_SCORE_WAIT_S, after which this
+            # context exits and the sentinel has nothing left to see
+            from h2o3_tpu.obs import watchdog as _wd
+            with _wd.watch("microbatch",
+                           desc=f"follower wait {model.key}",
+                           deadline_s=min(_wait_s() / 2,
+                                          _wd._stall_s()),
+                           trace=req.trace):
+                ok = req.event.wait(timeout=_wait_s())
+            if not ok:
+                WAIT_TIMEOUTS.inc()
+                raise TimeoutError(
+                    "micro-batched scoring dispatch timed out "
+                    f"after {_wait_s():g}s (H2O3_SCORE_WAIT_S)")
         if req.error is not None:
             raise req.error
         return req.result

@@ -132,4 +132,4 @@ if __name__ == "__main__":
     import json
     import h2o3_tpu
     h2o3_tpu.init()
-    print(json.dumps(run_all(), indent=2, default=float))
+    print(json.dumps(run_all(), indent=2, default=float))   # h2o3-ok: R012 `python -m ...selfbench` CLI: the JSON report on stdout IS the interface

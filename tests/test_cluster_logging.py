@@ -1,0 +1,648 @@
+"""ISSUE 8 — structured logging, cluster log routing, JStack, watchdog.
+
+Covers: JSON log records with host/thread/level/trace/span correlation,
+the durable JSONL tier under <ice_root>/obs/logs (torn lines, retention
+GC, cross-process search), the ERROR-record flight-recorder keep rule,
+GET /3/Logs search + node-routed file download + GET /3/JStack (single
+host and through a protocol-faithful fake worker on the real replay
+channel), log records interleaved into GET /3/Trace/{id}, the stall
+watchdog (seeded REST stall → pinned diagnostic trace with a cluster
+JStack + correlated ERROR records, durable across a process restart),
+SLO sample-ring persistence, and host-tagged exemplars surviving the
+cluster metrics merge."""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.deploy import multihost as MH
+from h2o3_tpu.obs import metrics as om
+from h2o3_tpu.obs import recorder as rec_mod
+from h2o3_tpu.obs import tracing
+from h2o3_tpu.obs import watchdog as wd_mod
+from h2o3_tpu.obs.timeline import SPANS, span
+from h2o3_tpu.utils import log as ulog
+
+
+@pytest.fixture()
+def ice_root(tmp_path, monkeypatch):
+    """Point the durable tiers (logs, recorder segments) at a tmp ice
+    root; the probabilistic lottery is off so only keep rules retain."""
+    from h2o3_tpu.io import spill
+    monkeypatch.setenv("H2O3_OBS_SAMPLE", "0")
+    prev = spill.get_ice_root()
+    spill.set_ice_root(str(tmp_path))
+    rec_mod.RECORDER.set_root(None)     # default root = <ice_root>/obs/...
+    yield tmp_path
+    ulog.flush()
+    spill.set_ice_root(prev)
+    rec_mod.RECORDER.set_root(None)
+
+
+# ---------------------------------------------------------------------------
+# structured records
+def test_record_shape_and_trace_span_correlation(ice_root):
+    with tracing.trace("log-shape-1"):
+        with span("t.logshape") as sp:
+            ulog.info("shaped record %d", 42)
+    recs = [r for r in ulog.records(50) if r["msg"] == "shaped record 42"]
+    assert recs, "record missing from the ring"
+    r = recs[-1]
+    assert r["level"] == "INFO" and r["logger"].startswith("h2o3_tpu")
+    assert r["host"] == 0 and r["thread"] == threading.current_thread().name
+    assert r["trace"] == "log-shape-1" and r["span"] == sp.span_id
+    assert r["src"].startswith("test_cluster_logging.py:")
+    # and it is durable: a line in a per-process JSONL segment
+    ulog.flush()
+    assert any(f["name"].startswith(f"h0-p{os.getpid()}-")
+               for f in ulog.list_files())
+    got = ulog.search(trace="log-shape-1")
+    assert any(x["id"] == r["id"] for x in got)
+
+
+def test_named_child_loggers_flow_through(ice_root):
+    ulog.get_logger("serving").warning("child says hi")
+    recs = ulog.search(grep="child says hi", limit=5)
+    assert recs and recs[0]["logger"] == "h2o3_tpu.serving"
+    assert recs[0]["level"] == "WARNING"
+
+
+def test_log_dir_rotating_file_handler(tmp_path, monkeypatch):
+    """The latent seed crash: logging.handlers was referenced without
+    importing it, so H2O3_LOG_DIR raised AttributeError on first use."""
+    monkeypatch.setenv("H2O3_LOG_DIR", str(tmp_path / "classic"))
+    ulog.reinit()
+    try:
+        ulog.info("rotating file works")
+        ulog.flush()
+        text = (tmp_path / "classic" / "h2o3_tpu.log").read_text()
+        assert "rotating file works" in text
+    finally:
+        monkeypatch.delenv("H2O3_LOG_DIR")
+        ulog.reinit()
+
+
+def test_search_filters_and_torn_line(ice_root):
+    t0 = time.time()
+    ulog.debug("noise dbg")            # default INFO level: not emitted
+    ulog.info("alpha needle")
+    ulog.err("bravo needle")
+    ulog.flush()
+    # level is a MINIMUM severity
+    assert {r["msg"] for r in ulog.search(level="ERROR", since=t0)} \
+        == {"bravo needle"}
+    assert {r["msg"] for r in ulog.search(grep="needle", since=t0)} \
+        == {"alpha needle", "bravo needle"}
+    assert ulog.search(grep="noise dbg", since=t0) == []
+    # a torn trailing line (crashed writer) is skipped, not fatal
+    d = os.path.join(str(ice_root), "obs", "logs")
+    with open(os.path.join(d, "p99999-0-000001.jsonl"), "w") as fh:
+        fh.write(json.dumps({"t": time.time(), "id": 7, "host": 9,
+                             "level": "INFO", "msg": "other proc"}) + "\n")
+        fh.write('{"t": 1.0, "id": 8, "torn...')
+    got = ulog.search(grep="other proc")
+    assert len(got) == 1 and got[0]["host"] == 9
+
+
+def test_retention_gc_bounds_disk(ice_root, monkeypatch):
+    monkeypatch.setenv("H2O3_LOG_RETAIN_MB", "0.02")    # 20 kB budget
+    monkeypatch.setenv("H2O3_LOG_SEGMENT_MB", "0.005")  # 5 kB segments
+    for i in range(400):
+        ulog.info("gc filler record %06d %s", i, "x" * 64)
+    ulog.flush()
+    # bounded by budget + one active segment of slack
+    assert ulog.disk_bytes() <= 0.02e6 + 0.005e6 + 4096
+
+
+def test_error_record_is_a_keep_rule(ice_root):
+    """A trace whose every span closed fast-OK but which logged an ERROR
+    must be retained by the flight recorder (the new keep-rule
+    producer); the same trace without the ERROR loses the lottery."""
+    with tracing.trace("errlog-keep-1"):
+        with span("rest.request", status=200):
+            ulog.err("something went sideways")
+    with tracing.trace("errlog-drop-1"):
+        with span("rest.request", status=200):
+            ulog.info("all fine here")
+    kept = rec_mod.RECORDER.load_trace("errlog-keep-1")
+    assert [s["name"] for s in kept] == ["rest.request"]
+    assert rec_mod.RECORDER.load_trace("errlog-drop-1") == []
+
+
+def test_error_record_heals_already_dropped_fragment(ice_root):
+    """The ERROR may land AFTER its trace's fast-OK fragment lost the
+    lottery (a background job logs the failure later): mark_error must
+    resurrect the stashed fragment — disposition `healed`."""
+    tid = "errlog-heal-1"
+    with tracing.trace(tid):
+        with span("rest.request", status=200):
+            pass                      # fast-OK: downsampled + stashed
+    assert rec_mod.RECORDER.load_trace(tid) == []
+    with tracing.trace(tid):
+        ulog.err("late failure for %s", tid)
+    assert [s["name"] for s in rec_mod.RECORDER.load_trace(tid)] \
+        == ["rest.request"]
+
+
+# ---------------------------------------------------------------------------
+# REST surface — single host
+@pytest.fixture(scope="module")
+def server():
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get(s, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{s.port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_rest_logs_search_and_node_file(server, ice_root):
+    ulog.info("rest-visible record one")
+    ulog.err("rest-visible record two")
+    out = _get(server, "/3/Logs?grep=rest-visible")
+    msgs = [r["msg"] for r in out["records"]]
+    assert "rest-visible record one" in msgs
+    assert "rest-visible record two" in msgs
+    assert out["hosts"][0]["host"] == 0 and out["hosts"][0]["files"]
+    # level filter is a minimum severity
+    out = _get(server, "/3/Logs?grep=rest-visible&level=ERROR")
+    assert [r["msg"] for r in out["records"]] == ["rest-visible record two"]
+    # node-routed file fetch: the node's durable JSONL, not the ring
+    name = out["hosts"][0]["files"][0] if out["hosts"][0]["files"] \
+        else "default"
+    body = _get(server, f"/3/Logs/nodes/self/files/{name}")
+    assert body["node"] == 0
+    assert '"msg":"rest-visible record one"' in body["log"]
+    # unknown file name on a known node → 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/3/Logs/nodes/self/files/no-such-file.jsonl")
+    assert ei.value.code == 404
+    # bad numeric param → 400, never a 5xx
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/3/Logs?since=abc")
+    assert ei.value.code == 400
+    # legacy dump still answers
+    assert "rest-visible record one" in _get(server, "/3/Logs/download")["log"]
+
+
+def test_rest_trace_interleaves_logs(server, ice_root):
+    tid = "interleave-1"
+    _get(server, "/3/Frames", headers={"X-H2O3-Trace-Id": tid})
+    with tracing.trace(tid):
+        ulog.info("correlated while traced")
+    out = _get(server, f"/3/Trace/{tid}")
+    assert out["n_spans"] >= 1
+    assert any(r["msg"] == "correlated while traced" for r in out["logs"])
+    # logs come back time-sorted
+    ts = [r["t"] for r in out["logs"]]
+    assert ts == sorted(ts)
+
+
+def test_rest_jstack_single_host(server):
+    out = _get(server, "/3/JStack")
+    assert out["lagging_hosts"] == []
+    node = out["traces"][0]
+    assert node["node"] == "h2o3-0" and node["host"] == 0
+    names = [t["name"] for t in node["thread_traces"]]
+    assert "MainThread" in names
+    assert any("h2o3-rest" in n for n in names)
+    rest = next(t for t in node["thread_traces"]
+                if "h2o3-rest" in t["name"])
+    assert rest["daemon"] and rest["stack"]
+    assert isinstance(out["stalled"], list)
+
+
+# ---------------------------------------------------------------------------
+# cluster fan-out through a REAL Broadcaster + protocol-faithful fake
+# worker (the test_tracing harness, extended with the logs/jstack ops)
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+WORKER_LOG_CONTENT = (
+    json.dumps({"t": time.time(), "id": 1, "host": 1, "level": "INFO",
+                "logger": "h2o3_tpu", "thread": "h1-replay",
+                "msg": "worker file record"}) + "\n")
+
+
+def _worker_records(trace=None):
+    rec = {"t": time.time(), "id": 501, "host": 1, "level": "INFO",
+           "logger": "h2o3_tpu", "thread": "h1-replay",
+           "msg": "replay POST /3/Predictions seq=9"}
+    if trace:
+        rec["trace"] = trace
+    return [rec]
+
+
+def _fake_worker(sock, key):
+    while True:
+        try:
+            msg = MH._recv_frame(sock, key)
+        except Exception:   # noqa: BLE001 — coordinator closed mid-frame
+            return
+        if msg is None:
+            return
+        if "op" in msg:
+            op = msg["op"]
+            if op == "jstack":
+                data = {"host": 1, "threads": [
+                    {"name": "h1-main", "ident": 1, "daemon": False,
+                     "alive": True, "stack": "worker.py:1 replay_loop\n"}]}
+            elif op.startswith("logs:search:"):
+                filt = json.loads(op[len("logs:search:"):])
+                data = {"host": 1,
+                        "records": _worker_records(filt.get("trace")),
+                        "files": ["p777-1-000001.jsonl"]}
+            elif op.startswith("logs:file:"):
+                node, _, name = op[len("logs:file:"):].partition(":")
+                data = {"host": 1}
+                if node == "1":
+                    data = {"host": 1, "name": name,
+                            "log": WORKER_LOG_CONTENT}
+            elif op.startswith("trace:"):
+                tid = op[len("trace:"):]
+                now = time.time()
+                data = {"host": 1, "logs": _worker_records(tid),
+                        "spans": [{"name": "replay.request", "id": 11,
+                                   "parent": 0, "host": 1, "start": now,
+                                   "end": now, "duration_ms": 1.0,
+                                   "attrs": {}, "trace": tid}]}
+            elif op == "timeline":
+                data = {"host": 1, "spans": []}
+            elif op == "metrics":
+                data = {"host": 1, "metrics": {}}
+            else:
+                data = None
+            try:
+                MH._send_frame(sock, key, {"ack": msg["seq"],
+                                           "data": data})
+            except OSError:
+                return              # coordinator closed mid-collect
+        else:
+            try:
+                MH._send_frame(sock, key, {"ack": msg["seq"]})
+            except OSError:
+                return
+
+
+@pytest.fixture()
+def cluster_secret(monkeypatch):
+    monkeypatch.setenv("H2O3_CLUSTER_SECRET", "cluster-logging-secret")
+
+
+@pytest.fixture()
+def cloud_server(cluster_secret):
+    from h2o3_tpu.api.server import H2OServer
+    port = _free_port()
+    out = {}
+
+    def _accept():
+        out["bc"] = MH.Broadcaster(1, port)
+
+    t = threading.Thread(target=_accept, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    sock = None
+    while sock is None and time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection(("127.0.0.1", port))
+        except OSError:
+            time.sleep(0.05)
+    secret = os.environ["H2O3_CLUSTER_SECRET"].encode()
+    chal = MH._recv_frame(sock, secret)
+    nonce_w = "cafef00d" * 4
+    MH._send_frame(sock, secret,
+                   {"hello": 1, "echo": chal["challenge"],
+                    "nonce": nonce_w})
+    key = MH._session_key(secret, chal["challenge"], nonce_w)
+    assert MH._recv_frame(sock, key) == {"welcome": 1}
+    t.join(timeout=10)
+    assert not t.is_alive() and "bc" in out
+    wt = threading.Thread(target=_fake_worker, args=(sock, key),
+                          daemon=True)
+    wt.start()
+    srv = H2OServer(port=0).start()
+    srv.httpd.broadcaster = out["bc"]
+    yield srv
+    srv.stop()
+    sock.close()
+
+
+def test_node_routed_log_file_fetch(cloud_server, ice_root):
+    """GET /3/Logs/nodes/1/files/{name} answers with the WORKER's file
+    content — not the coordinator's ring or files."""
+    ulog.info("coordinator-only record")
+    out = _get(cloud_server, "/3/Logs/nodes/1/files/worker.jsonl")
+    assert out["node"] == 1 and out["log"] == WORKER_LOG_CONTENT
+    assert "coordinator-only record" not in out["log"]
+    # a node nobody owns → 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(cloud_server, "/3/Logs/nodes/7/files/worker.jsonl")
+    assert ei.value.code == 404
+
+
+def test_cluster_log_search_merges_hosts(cloud_server, ice_root):
+    ulog.info("merge-me coordinator record")
+    out = _get(cloud_server, "/3/Logs?grep=&limit=300")
+    hosts = {h["host"] for h in out["hosts"]}
+    assert hosts == {0, 1}
+    by_host = {}
+    for r in out["records"]:
+        by_host.setdefault(r["host"], []).append(r["msg"])
+    assert any("merge-me coordinator" in m for m in by_host.get(0, []))
+    assert any("replay POST" in m for m in by_host.get(1, []))
+    # trace filter fans out too
+    out = _get(cloud_server, "/3/Logs?trace=tr-xyz")
+    assert any(r["host"] == 1 and r.get("trace") == "tr-xyz"
+               for r in out["records"])
+
+
+def test_cluster_jstack_merge(cloud_server):
+    out = _get(cloud_server, "/3/JStack")
+    nodes = {t["node"]: t for t in out["traces"]}
+    assert set(nodes) == {"h2o3-0", "h2o3-1"}
+    assert any("h2o3-rest" in t["name"]
+               for t in nodes["h2o3-0"]["thread_traces"])
+    assert nodes["h2o3-1"]["thread_traces"][0]["name"] == "h1-main"
+
+
+def test_trace_view_includes_worker_logs(cloud_server, ice_root):
+    tid = "tr-worker-logs-1"
+    _get(cloud_server, "/3/Frames", headers={"X-H2O3-Trace-Id": tid})
+    with tracing.trace(tid):
+        ulog.info("coordinator correlated")
+    out = _get(cloud_server, f"/3/Trace/{tid}")
+    hosts_in_logs = {r["host"] for r in out["logs"]}
+    assert hosts_in_logs == {0, 1}, out["logs"]
+    assert any(r["msg"].startswith("replay POST") for r in out["logs"])
+    assert {s["host"] for s in out["spans"]} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# the stall watchdog
+def _restart_sentinel():
+    """Force the sentinel onto the CURRENT env's poll period (an earlier
+    test may have started it with the default 5s sleep)."""
+    wd_mod.WATCHDOG._thread = None
+    wd_mod.WATCHDOG._ensure_thread()
+
+
+def test_watchdog_trips_on_seeded_rest_stall(server, ice_root,
+                                             monkeypatch):
+    """A REST handler blocked past H2O3_WATCHDOG_STALL_S trips the
+    watchdog: pinned flight-recorder trace with a JStack that shows the
+    stalled thread, the stall descriptor, recent logs, a correlated
+    ERROR record, and the trips counter — while the request is STILL
+    hanging. The artifact then survives a process restart."""
+    from h2o3_tpu.api import server as srv_mod
+    monkeypatch.setenv("H2O3_WATCHDOG_STALL_S", "0.3")
+    monkeypatch.setenv("H2O3_WATCHDOG_POLL_S", "0.05")
+    _restart_sentinel()
+    release = threading.Event()
+
+    def _h_stall(h):
+        release.wait(timeout=10)
+        h._send({"ok": True})
+
+    row = (re.compile(r"/3/TestStall"), "GET", _h_stall)
+    srv_mod.ROUTES.append(row)
+    trips0 = len(wd_mod.WATCHDOG.trips())
+    t = threading.Thread(
+        target=lambda: _get(server, "/3/TestStall",
+                            headers={"X-H2O3-Trace-Id": "stall-req-1"}),
+        daemon=True)
+    try:
+        t.start()
+        deadline = time.monotonic() + 8
+        while len(wd_mod.WATCHDOG.trips()) <= trips0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        trips = wd_mod.WATCHDOG.trips()
+        assert len(trips) > trips0, "watchdog never tripped"
+        trip = trips[-1]
+        assert "rest" in trip["kinds"]
+        assert any("/3/TestStall" in d for d in trip["stalls"])
+    finally:
+        release.set()
+        t.join(timeout=15)
+        srv_mod.ROUTES.remove(row)
+    tid = trip["trace"]
+    assert wd_mod.TRIPS.value(kind="rest") >= 1
+    # the pinned diagnostic trace: watchdog.trip span with the cluster
+    # JStack, the stall list and the recent-log tail
+    spans = rec_mod.RECORDER.load_trace(tid)
+    names = {s["name"] for s in spans}
+    assert "watchdog.trip" in names, spans
+    sp = next(s for s in spans if s["name"] == "watchdog.trip")
+    assert any(st["kind"] == "rest" and "/3/TestStall" in st["desc"]
+               for st in sp["attrs"]["stalls"])
+    assert "TestStall" in sp["attrs"]["jstack"] \
+        or "release.wait" in sp["attrs"]["jstack"]
+    assert isinstance(sp["attrs"]["logs"], list)
+    # correlated ERROR record, retrievable over REST with the spans
+    out = _get(server, f"/3/Trace/{tid}")
+    assert any(r["level"] == "ERROR" and "watchdog" in r["msg"]
+               for r in out["logs"])
+    assert any(s["name"] == "watchdog.trip" for s in out["spans"])
+
+    # ---- durability: a FRESH process over the same ice_root retrieves
+    # the same diagnostic artifact (the hang's postmortem survives the
+    # inevitable restart that follows a hang)
+    code = (
+        "import json\n"
+        "from h2o3_tpu.obs import recorder\n"
+        "from h2o3_tpu.utils import log as ulog\n"
+        "r = recorder.FlightRecorder()\n"
+        f"spans = r.load_trace({tid!r})\n"
+        f"logs = ulog.search(trace={tid!r})\n"
+        "print(json.dumps({'names': [s['name'] for s in spans],"
+        " 'has_jstack': any('jstack' in (s.get('attrs') or {})"
+        " for s in spans),"
+        " 'err': [l['level'] for l in logs]}))\n")
+    env = dict(os.environ, H2O3_TPU_ICE_ROOT=str(ice_root),
+               JAX_PLATFORMS="cpu")
+    env.pop("PYTEST_CURRENT_TEST", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    got = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "watchdog.trip" in got["names"], got
+    assert got["has_jstack"] and "ERROR" in got["err"], got
+
+
+def test_watchdog_device_and_replay_kinds(ice_root, monkeypatch):
+    """The other watch points register entries of their own kind: a
+    seeded stall in each trips with its kind label (the metric Grafana
+    breaks down by)."""
+    monkeypatch.setenv("H2O3_WATCHDOG_STALL_S", "0.15")
+    monkeypatch.setenv("H2O3_WATCHDOG_POLL_S", "0.05")
+    _restart_sentinel()
+    before = wd_mod.TRIPS.value(kind="device")
+    ev = threading.Event()
+
+    def _stall():
+        with wd_mod.watch("device", desc="mrtask.map_reduce:_hist"):
+            ev.wait(timeout=5)
+
+    t = threading.Thread(target=_stall, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 6
+    while wd_mod.TRIPS.value(kind="device") <= before \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ev.set()
+    t.join(timeout=10)
+    assert wd_mod.TRIPS.value(kind="device") >= before + 1
+
+
+def test_watchdog_no_trip_under_deadline(ice_root, monkeypatch):
+    monkeypatch.setenv("H2O3_WATCHDOG_STALL_S", "5")
+    before = len(wd_mod.WATCHDOG.trips())
+    with wd_mod.watch("rest", desc="GET /3/Quick"):
+        time.sleep(0.05)
+    assert wd_mod.WATCHDOG.stalled() == []
+    assert len(wd_mod.WATCHDOG.trips()) == before
+
+
+def test_watchdog_watch_disabled_is_nullcontext(monkeypatch):
+    monkeypatch.setenv("H2O3_WATCHDOG", "0")
+    # the enable flag is cached for the dispatch hot path; reset it so
+    # the env change takes (monkeypatch restores the cache on teardown)
+    monkeypatch.setattr(wd_mod, "_ENABLED", None)
+    with wd_mod.watch("rest", desc="off") as ent:
+        assert ent is None
+    assert wd_mod.WATCHDOG.stalled() == []
+
+
+# ---------------------------------------------------------------------------
+# SLO sample-ring persistence
+def _slo_engine(reg):
+    spec = {"name": "t-persist", "metric": "h2o3_persist_lat_seconds",
+            "objective": 0.9, "threshold_ms": 500.0,
+            "windows": [[2.0, 8.0, 2.0]]}
+    from h2o3_tpu.obs import slo as slo_mod
+    eng = slo_mod.SLOEngine(registry=reg)
+    eng.configure([slo_mod.SLOSpec(spec)])
+    return eng
+
+
+def test_slo_samples_persist_and_restore(ice_root, monkeypatch):
+    from h2o3_tpu.obs import slo as slo_mod
+    monkeypatch.setenv("H2O3_SLO_PERSIST_S", "0")   # explicit persists only
+    reg1 = om.MetricsRegistry()
+    h1 = reg1.histogram("h2o3_persist_lat_seconds", "t",
+                        buckets=(0.25, 0.5, 1.0))
+    eng1 = _slo_engine(reg1)
+    now = time.time()
+    for i in range(20):
+        h1.observe(2.0)                 # all bad: burning hard
+        eng1.evaluate(now=now - 10 + i * 0.5)
+    eng1.persist()
+    path = slo_mod.SLOEngine.persist_path()
+    assert os.path.exists(path), path
+    ring1 = list(eng1._samples["t-persist"])
+
+    # "restart": fresh engine over a fresh registry whose totals are 0
+    reg2 = om.MetricsRegistry()
+    # h2o3-ok: R005 same metric re-declared on an ISOLATED registry — this test simulates a restarted process
+    h2 = reg2.histogram("h2o3_persist_lat_seconds", "t",
+                        buckets=(0.25, 0.5, 1.0))
+    eng2 = _slo_engine(reg2)
+    assert eng2.restore()
+    assert list(eng2._samples["t-persist"]) == ring1
+    # post-restart totals rebase onto the persisted cumulative counts:
+    # the first evaluate appends a MONOTONE sample (no negative delta),
+    # and coverage includes pre-restart history (no warm-up clamp)
+    h2.observe(2.0)
+    eng2.evaluate(now=now + 1)
+    ring2 = list(eng2._samples["t-persist"])
+    assert ring2[-1][1] == ring1[-1][1] + 1         # total grew by 1
+    assert ring2[-1][1] >= ring2[-2][1]
+    burn = eng2._burn_rate(eng2.specs()[0], ring2, 8.0, now + 1)
+    assert burn > 2.0, "restored history lost: long-window burn clamped"
+
+
+def test_slo_restore_skips_unknown_specs(ice_root, monkeypatch):
+    from h2o3_tpu.obs import slo as slo_mod
+    monkeypatch.setenv("H2O3_SLO_PERSIST_S", "0")
+    reg = om.MetricsRegistry()
+    # h2o3-ok: R005 same metric on an ISOLATED registry — restart simulation
+    reg.histogram("h2o3_persist_lat_seconds", "t", buckets=(0.5,))
+    eng = _slo_engine(reg)
+    eng.evaluate()
+    eng.persist()
+    other = slo_mod.SLOEngine(registry=om.MetricsRegistry())
+    other.configure([slo_mod.SLOSpec(
+        {"name": "different", "objective": 0.9})])
+    assert not other.restore()          # nothing matched its specs
+    assert "t-persist" not in other._samples
+
+
+# ---------------------------------------------------------------------------
+# host-tagged exemplars through the cluster merge
+def test_exemplars_survive_cluster_merge():
+    reg = om.MetricsRegistry()
+    h = reg.histogram("h2o3_exm_lat_seconds", "t", buckets=(0.5, 1.0))
+    h.observe(0.2, exemplar="trace-aa")
+    h.observe(2.0, exemplar="trace-bb")
+    snap = json.loads(json.dumps(reg.to_dict()))    # wire round-trip
+    ex = snap["h2o3_exm_lat_seconds"]["series"][0]["exemplars"]
+    assert {e["trace_id"] for e in ex} == {"trace-aa", "trace-bb"}
+    merged = om.merge_cluster_snapshots([(0, reg.to_dict()), (1, snap)])
+    series = merged["h2o3_exm_lat_seconds"]["series"]
+    for s in series:
+        for e in s["exemplars"]:
+            assert e["host"] == s["labels"]["host"]
+    text = om.cluster_openmetrics_text([(0, reg.to_dict()), (1, snap)])
+    assert re.search(r'le="0\.5"} 1 # {trace_id="trace-aa",host="1"} 0\.2',
+                     text), text
+    assert 'trace_id="trace-bb",host="0"' in text
+    assert text.rstrip().endswith("# EOF")
+    # the 0.0.4 cluster body stays exemplar-free (Prometheus rejects
+    # exemplar syntax outside OpenMetrics)
+    assert "trace_id" not in om.cluster_prometheus_text(
+        [(0, reg.to_dict()), (1, snap)])
+
+
+def test_slo_restore_rebases_against_live_totals(ice_root, monkeypatch):
+    """An IN-PROCESS re-install (persist + restore over a registry that
+    kept its totals) must not double-count: the offset rebases against
+    the registry's CURRENT totals, so the first post-restore sample
+    continues the persisted history instead of jumping by it."""
+    monkeypatch.setenv("H2O3_SLO_PERSIST_S", "0")
+    reg = om.MetricsRegistry()
+    # h2o3-ok: R005 same metric on an ISOLATED registry — restart simulation
+    h = reg.histogram("h2o3_persist_lat_seconds", "t", buckets=(0.5,))
+    eng = _slo_engine(reg)
+    now = time.time()
+    h.observe(2.0)
+    h.observe(2.0)
+    eng.evaluate(now=now)
+    eng.persist()
+    last_total = eng._samples["t-persist"][-1][1]
+    # re-install over the SAME (live, non-zero) registry
+    eng2 = _slo_engine(reg)
+    assert eng2.restore()
+    eng2.evaluate(now=now + 1)
+    ring = list(eng2._samples["t-persist"])
+    assert ring[-1][1] == last_total, \
+        f"double-counted: {ring[-1][1]} != {last_total}"

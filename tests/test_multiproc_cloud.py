@@ -97,6 +97,9 @@ def test_two_process_cloud_matches_single(tmp_path):
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)
     env["H2O3_CLUSTER_SECRET"] = "multiproc-test-secret"
+    # isolated shared ice root: both processes' durable logs/traces land
+    # here (h0-/h1- prefixed files), not in a dirty ~/.h2o3_tpu_ice
+    env["H2O3_TPU_ICE_ROOT"] = str(tmp_path / "ice")
     # profiler stop ships each worker's flamegraph inside the collect
     # ack; give the sampler-join + file write headroom over the default
     env["H2O3_OBS_COLLECT_TIMEOUT_S"] = "10"
@@ -167,6 +170,33 @@ def test_two_process_cloud_matches_single(tmp_path):
         assert "replay.request" in by_host[1]
         assert any(n.startswith("mrtask.") for n in by_host[1]), \
             f"no MRTask spans from the remote host: {by_host[1]}"
+        # ---- ISSUE 8: a trace-correlated WORKER log record (the replay
+        # INFO line) interleaves into the stitched trace view
+        assert any(r.get("host") == 1 and r.get("trace") == tid
+                   for r in tr.get("logs", [])), tr.get("logs")
+
+        # ---- ISSUE 8: cluster structured logging. Fetch the WORKER's
+        # durable log file by node name — content must be host-1 records,
+        # not the coordinator's ring
+        lg = _get(rest, "/3/Logs?grep=replay&limit=200")
+        hosts = {h["host"]: h for h in lg["hosts"]}
+        assert set(hosts) == {0, 1}, lg["hosts"]
+        assert any(r["host"] == 1 and r["msg"].startswith("replay ")
+                   for r in lg["records"])
+        wname = (hosts[1].get("files") or ["default"])[0]
+        nf = _get(rest, f"/3/Logs/nodes/1/files/{wname}")
+        assert nf["node"] == 1 and nf["log"]
+        worker_recs = [json.loads(l) for l in nf["log"].splitlines() if l]
+        assert worker_recs and all(r["host"] == 1 for r in worker_recs)
+        # trace-scoped cluster search finds the worker's correlated record
+        lt = _get(rest, f"/3/Logs?trace={tid}")
+        assert any(r["host"] == 1 for r in lt["records"]), lt["records"]
+
+        # ---- ISSUE 8: cluster JStack — one GET renders every node's
+        # all-thread stacks
+        js = _get(rest, "/3/JStack")
+        assert {t["node"] for t in js["traces"]} == {"h2o3-0", "h2o3-1"}
+        assert all(t["thread_traces"] for t in js["traces"])
 
         # ---- cluster metrics federation: one scrape of host 0 carries
         # every host's series under host= labels

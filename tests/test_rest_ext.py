@@ -61,7 +61,9 @@ def test_diagnostics_routes(server):
     caps = _get(server, "/3/Capabilities")["capabilities"]
     assert any(c["name"] == "Algos" for c in caps)
     js = _get(server, "/3/JStack")["traces"]
-    assert any("h2o3-rest" in t["thread_name"] for t in js)
+    # cluster schema: one entry per node, each with its thread dump
+    assert js and js[0]["node"].startswith("h2o3-")
+    assert any("h2o3-rest" in t["name"] for t in js[0]["thread_traces"])
     nt = _get(server, "/3/NetworkTest")
     assert nt["results"] and nt["results"][0]["micros"] > 0
     _post(server, "/3/LogAndEcho", message="hello from test")

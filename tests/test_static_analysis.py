@@ -596,3 +596,48 @@ def test_rest_returns_503_with_retry_after(glm, monkeypatch):
         assert out["row_count"] == 1
     finally:
         s.stop()
+
+
+# ---------------------------------------------------------------------------
+# R012: logging discipline (ISSUE 8)
+def test_r012_detects_print_and_bare_getlogger():
+    src = (
+        "import logging\n"
+        "def work():\n"
+        "    print('done')\n"
+        "    lg = logging.getLogger('mine')\n"
+        "    lg.info('x')\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/fixture_prints.py") if f.rule == "R012"]
+    assert len(found) == 2
+    assert any("print()" in f.message for f in found)
+    assert any("getLogger" in f.message for f in found)
+
+
+def test_r012_clean_on_structured_logger():
+    src = (
+        "from h2o3_tpu.utils import log as _log\n"
+        "def work():\n"
+        "    _log.info('done %s', 1)\n"
+        "    _log.get_logger('sub').warning('x')\n")
+    assert "R012" not in _rules_of(engine.analyze_source(
+        src, filename="h2o3_tpu/fixture_prints.py"))
+
+
+def test_r012_exempts_cli_main_modules_and_tests():
+    src = "def main():\n    print('usage: ...')\n"
+    assert "R012" not in _rules_of(engine.analyze_source(
+        src, filename="h2o3_tpu/analysis/__main__.py"))
+    assert "R012" not in _rules_of(engine.analyze_source(
+        src, filename="tests/test_fixture.py"))
+    # a non-CLI library module IS flagged
+    assert "R012" in _rules_of(engine.analyze_source(
+        src, filename="h2o3_tpu/core/fixture.py"))
+
+
+def test_r012_inline_suppression():
+    src = ("def main():\n"
+           "    print('report')   # h2o3-ok: R012 CLI output\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/fixture_prints.py") if f.rule == "R012"]
+    assert len(found) == 1 and found[0].suppressed
