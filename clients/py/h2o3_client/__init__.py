@@ -1,0 +1,127 @@
+"""h2o3_client — the thin out-of-process Python REST client.
+
+The in-process surface (`h2o3_tpu.client`) evaluates Rapids directly;
+this package is for callers on the OTHER side of the REST boundary — load
+generators, notebooks on a laptop, sidecar services — and it encodes the
+client half of the server's backpressure and elasticity contracts:
+
+  * **503 + Retry-After** (micro-batch queue-depth backpressure, and the
+    brief unavailability window while a worker is excised/replaced) is
+    retried with capped jittered exponential backoff honoring the
+    server's Retry-After hint, instead of surfacing the first 503.
+  * Transient transport drops (connection reset/refused mid-restart) are
+    retried the same way when `retry_connect=True`.
+
+Stdlib-only (urllib), like the server. Usage:
+
+    from h2o3_client import H2OClient
+    c = H2OClient("http://127.0.0.1:54321")
+    cloud = c.get("/3/Cloud")
+    preds = c.post("/3/Predictions/models/m1", rows=[[1.0, 2.0]])
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+__all__ = ["H2OClient", "H2ORetryError"]
+
+
+class H2ORetryError(RuntimeError):
+    """The retry budget ran out; `.last` holds the final HTTPError."""
+
+    def __init__(self, msg, last=None):
+        super().__init__(msg)
+        self.last = last
+
+
+class H2OClient:
+    """One REST endpoint + a retry policy.
+
+    max_retries   attempts AFTER the first (default 6)
+    backoff_base  first backoff, seconds (default 0.05)
+    backoff_cap   per-sleep ceiling, seconds (default 2.0) — also caps a
+                  server Retry-After hint so a stale hint can't park the
+                  caller
+    timeout       per-request socket timeout, seconds (default 60)
+    retry_connect also retry dropped/refused connections (worker
+                  replacement windows), not just 503s
+    rng           random source for jitter (tests pass a seeded one)
+    """
+
+    def __init__(self, url: str, max_retries: int = 6,
+                 backoff_base: float = 0.05, backoff_cap: float = 2.0,
+                 timeout: float = 60.0, retry_connect: bool = False,
+                 headers: dict | None = None, rng=None):
+        self.url = url.rstrip("/")
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.timeout = float(timeout)
+        self.retry_connect = bool(retry_connect)
+        self.headers = dict(headers or {})
+        self._rng = rng if rng is not None else random.Random()
+        self.retries_performed = 0     # observability for tests/tools
+
+    # ---- public verbs ----------------------------------------------------
+    def get(self, path: str, **params):
+        return self.request("GET", path, params or None)
+
+    def post(self, path: str, **params):
+        return self.request("POST", path, params or None)
+
+    def delete(self, path: str, **params):
+        return self.request("DELETE", path, params or None)
+
+    # ---- core ------------------------------------------------------------
+    def _backoff_s(self, attempt: int, retry_after) -> float:
+        """Capped exponential with full jitter; a server Retry-After hint
+        (already load-aware) is honored up to the cap, jittered ±50% so a
+        herd of 503'd clients doesn't return in lockstep."""
+        if retry_after is not None:
+            base = min(float(retry_after), self.backoff_cap)
+            return base * (0.5 + self._rng.random())
+        ceiling = min(self.backoff_base * (2 ** attempt), self.backoff_cap)
+        return ceiling * self._rng.random()
+
+    def request(self, method: str, path: str, params=None):
+        body = None
+        url = self.url + path
+        headers = dict(self.headers)
+        if params is not None and method in ("POST", "PUT"):
+            body = json.dumps(params).encode()
+            headers["Content-Type"] = "application/json"
+        elif params:
+            url += "?" + urllib.parse.urlencode(params)
+        last = None
+        for attempt in range(self.max_retries + 1):
+            req = urllib.request.Request(url, data=body, method=method,
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    raw = r.read()
+                    return json.loads(raw) if raw else None
+            except urllib.error.HTTPError as ex:
+                if ex.code != 503:
+                    raise               # real errors surface immediately
+                last = ex
+                ex.read()               # drain so the connection recycles
+                retry_after = ex.headers.get("Retry-After")
+            except (urllib.error.URLError, ConnectionError, OSError) as ex:
+                if not self.retry_connect:
+                    raise
+                last = ex
+                retry_after = None
+            if attempt >= self.max_retries:
+                break
+            self.retries_performed += 1
+            time.sleep(self._backoff_s(attempt, retry_after))
+        raise H2ORetryError(
+            f"{method} {path}: exhausted {self.max_retries} retries "
+            f"(last: {last})", last=last)

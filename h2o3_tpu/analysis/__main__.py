@@ -18,7 +18,7 @@ from h2o3_tpu.analysis import engine
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m h2o3_tpu.analysis",
-        description="JAX-aware static analyzer (rules R001-R010)")
+        description="JAX-aware static analyzer (rules R001-R013)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the h2o3_tpu "
                          "package)")

@@ -141,12 +141,15 @@ def load_modules(paths) -> list:
 # call time.time() in fixtures, and seed impurity to prove the runtime
 # handles it — R001/R004 are perf rules for production paths, R011's
 # span census is a production-vocabulary concern (throwaway fixture
-# spans are the point of a tracing test), and R012's logging discipline
+# spans are the point of a tracing test), R012's logging discipline
 # is for records an operator must find later (a test printing its
-# diagnostics is fine). Everything else (locks, metrics, routes,
-# R007-R010 concurrency) applies to tests too: a racy test harness or a
-# leaked test thread flakes the suite.
-TEST_RELAXED = {"R001", "R004", "R011", "R012"}
+# diagnostics is fine), and R013's socket deadlines are a production
+# liveness concern (test fixtures connect to loopback listeners they
+# themselves bound, with their own bounded retries and suite timeouts).
+# Everything else (locks, metrics, routes, R007-R010 concurrency)
+# applies to tests too: a racy test harness or a leaked test thread
+# flakes the suite.
+TEST_RELAXED = {"R001", "R004", "R011", "R012", "R013"}
 
 
 def _is_test_file(rel: str) -> bool:
@@ -158,9 +161,11 @@ def analyze_modules(mods: list, rules=None) -> list:
     """Run every rule over the parsed modules; returns findings with
     inline suppressions already applied (but baseline NOT applied)."""
     from h2o3_tpu.analysis import callgraph, rules_jax, rules_locks, \
-        rules_logging, rules_metrics, rules_routes, rules_spans
+        rules_logging, rules_metrics, rules_routes, rules_sockets, \
+        rules_spans
     findings: list = []
-    per_file = [rules_jax.check, rules_locks.check, rules_logging.check]
+    per_file = [rules_jax.check, rules_locks.check, rules_logging.check,
+                rules_sockets.check]
     project = [rules_metrics.check, rules_routes.check, rules_spans.check,
                callgraph.check]
     if rules:

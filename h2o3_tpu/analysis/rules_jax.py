@@ -28,7 +28,7 @@ from h2o3_tpu.analysis.engine import Finding, Module
 RULES = {"R001", "R002", "R004"}
 
 # names that wrap jax.jit (call makes a fresh jit wrapper per evaluation)
-_JIT_MAKERS = {"jit", "pjit", "jit_rows", "mr_define"}
+_JIT_MAKERS = {"jit", "pjit", "jit_rows", "mr_define", "guarded_jit"}
 # transform entry points whose function args run under trace
 _TRACED_ARG_FNS = _JIT_MAKERS | {
     "shard_map", "vmap", "pmap", "grad", "value_and_grad", "hessian",

@@ -284,7 +284,8 @@ def _is_obs_path(path: str) -> bool:
     return path in ("/metrics", "/3/Timeline", "/3/WaterMeter",
                     "/3/Profiler", "/3/Traces", "/3/Alerts",
                     "/3/JStack") \
-        or path.startswith("/3/Logs") or path.startswith("/3/Trace/")
+        or path.startswith("/3/Logs") or path.startswith("/3/Trace/") \
+        or path.startswith("/3/Cloud/")
 
 
 def _json_default(o):
@@ -298,14 +299,56 @@ def _json_default(o):
 # ---------------------------------------------------------------------------
 # handlers
 def _h_cloud(h: _Handler):
+    """GET /3/Cloud — device census plus the elastic-membership view:
+    the cloud EPOCH (bumps on every excision/join/drain), per-worker
+    states, and the DKV re-home status. `locked` is the reference's
+    Paxos.lockCloud flag — false here whenever an elastic broadcaster
+    can still admit joiners."""
+    from h2o3_tpu.core.kvstore import DKV as _dkv
+    from h2o3_tpu.deploy.membership import MEMBERSHIP as _mb
     info = h2o3_tpu.cluster_info()
+    # getattr chain: worker-side replays dispatch through _ReplayHandler,
+    # which carries no HTTP server object
+    bc = getattr(getattr(h, "server", None), "broadcaster", None)
+    elastic = bc is not None and hasattr(bc, "drain")
+    workers = _mb.nodes()
+    # healthy = no UNRESOLVED death: a worker dead at the CURRENT epoch
+    # is a live incident; once a later membership change (replacement
+    # join, drain) moves the epoch past it, the death is history and the
+    # cloud reports healthy again
+    healthy = not any(w["state"] == "dead" and w["epoch"] == _mb.epoch
+                      for w in workers)
     h._send({"__meta": {"schema_type": "CloudV3"},
              "cloud_name": info["cloud_name"],
              "cloud_size": info["cloud_size"],
-             "cloud_healthy": True, "consensus": True, "locked": True,
+             "cloud_healthy": healthy,
+             "consensus": True, "locked": not elastic,
+             "epoch": _mb.epoch,
+             "workers": workers,
+             "rehome": _dkv.rehome_status(),
              "version": h2o3_tpu.__version__,
              "nodes": [{"h2o": d, "healthy": True}
                        for d in info["devices"]]})
+
+
+def _h_cloud_drain(h: _Handler):
+    """POST /3/Cloud/drain?node=N — graceful worker departure: finish
+    in-flight jobs and micro-batches (bounded by H2O3_DRAIN_TIMEOUT_S),
+    send the worker a clean leave, bump the epoch. Coordinator-control
+    only: never broadcast (workers hold no broadcaster)."""
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is None or not hasattr(bc, "drain"):
+        return h._error("drain requires an elastic multi-host cloud", 400)
+    p = h._params()
+    try:
+        node = int(p.get("node", ""))
+    except ValueError:
+        return h._error("node must be a worker id", 400)
+    try:
+        out = bc.drain(node)
+    except ValueError as ex:
+        return h._error(str(ex), 404)
+    h._send({"__meta": {"schema_type": "CloudDrainV3"}, **out})
 
 
 def _h_import(h: _Handler):
@@ -1112,6 +1155,7 @@ def _h_metadata_endpoints(h: _Handler):
 
 ROUTES = [
     (re.compile(r"/3/Cloud"), "GET", _h_cloud),
+    (re.compile(r"/3/Cloud/drain"), "POST", _h_cloud_drain),
     (re.compile(r"/3/About"), "GET", _h_about),
     (re.compile(r"/3/ImportFiles"), "GET", _h_import),
     (re.compile(r"/3/ParseSetup"), "POST", _h_parse_setup),

@@ -16,10 +16,80 @@ What survives from DKV's design:
 
 from __future__ import annotations
 
+import bisect
+import hashlib
+import io as _io
+import threading
 import time
 from typing import Any
 
+import numpy as np
+
 from h2o3_tpu.analysis.lockdep import make_rlock
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs.timeline import span as _span
+
+REHOMED_KEYS = _om.counter(
+    "h2o3_dkv_rehome_keys_total",
+    "DKV keys re-homed after a membership change (consistent-hash ring "
+    "moved their home node)")
+REHOMED_BYTES = _om.counter(
+    "h2o3_dkv_rehome_bytes_total",
+    "compact codec bytes shipped by DKV re-home migrations (packed "
+    "data+mask planes via the tier pager, never device arrays)")
+
+
+class HashRing:
+    """Consistent-hash key→home-node map — the Key.java:169 home-node
+    hash rebuilt so membership changes move a BOUNDED key set.
+
+    The reference hashes `key % cloud_size`: adding or losing one node
+    re-homes nearly every key. A ring of `vnodes` virtual points per node
+    moves only the keys whose arc changed — on average 1/n of them for a
+    single node join/leave."""
+
+    def __init__(self, nodes, vnodes: int = 64):
+        self.nodes = sorted(set(int(n) for n in nodes))
+        self.vnodes = int(vnodes)
+        points = []
+        for n in self.nodes:
+            for v in range(self.vnodes):
+                points.append((self._hash(f"node:{n}:{v}"), n))
+        points.sort()
+        self._points = points
+        self._keys = [p[0] for p in points]
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(
+            hashlib.md5(s.encode()).digest()[:8], "big")
+
+    def node_for(self, key: str) -> int:
+        if not self._points:
+            return 0
+        h = self._hash(key)
+        i = bisect.bisect_right(self._keys, h)
+        if i == len(self._points):
+            i = 0
+        return self._points[i][1]
+
+
+def _plane_payload(data: np.ndarray, mask) -> bytes:
+    """Serialize packed codec planes to the compact wire form a re-home
+    move ships (npz of the dtype-packed data + optional u8 mask — the
+    tier pager's host-tier representation, never decoded f32, never a
+    device array)."""
+    buf = _io.BytesIO()
+    if mask is None:
+        np.savez(buf, data=data)
+    else:
+        np.savez(buf, data=data, mask=mask)
+    return buf.getvalue()
+
+
+def _plane_restore(payload: bytes):
+    with np.load(_io.BytesIO(payload)) as z:
+        return z["data"], (z["mask"] if "mask" in z.files else None)
 
 
 class _DKV:
@@ -30,11 +100,33 @@ class _DKV:
         # every subsystem, so it is the lock the order graph must see
         self._mutex = make_rlock("dkv")
         self._counter = 0
+        # ---- elastic membership (deploy/membership) ---------------------
+        # consistent-hash home-node map + background re-home state. On a
+        # single-host cloud everything homes on node 0 and none of this
+        # moves; a membership epoch bump re-homes only the keys whose
+        # ring arc changed, shipping compact codec bytes in a background
+        # worker with read-through (the OLD home keeps serving until the
+        # key's planes landed).
+        self._ring = HashRing([0])
+        self._homes: dict[str, int] = {}
+        self._migrating: set = set()
+        self._rehome_epoch = 1
+        self._rehome_keys_moved = 0
+        self._rehome_bytes_moved = 0
+        self._rehome_thread = None
+        self._rehome_queue: list = []
+        self._rehome_hook = None    # test seam: called per migrated key
 
     # ---- basic ops (DKV.put/get/remove) ---------------------------------
     def put(self, key: str, value: Any) -> str:
         with self._mutex:
             self._store[key] = value
+            # preserve an existing home: overwriting a key mid-migration
+            # must not flip home_of to the new ring assignment before the
+            # planes landed (the read-through contract) — only NEW keys
+            # take the ring's current answer
+            if key not in self._homes:
+                self._homes[key] = self._ring.node_for(key)
         return key
 
     def get(self, key: str, default=None):
@@ -64,6 +156,8 @@ class _DKV:
         with self._mutex:
             v = self._store.pop(key, None)
             self._locks.pop(key, None)
+            self._homes.pop(key, None)
+            self._migrating.discard(key)
         if v is not None and hasattr(v, "_on_remove"):
             v._on_remove()
 
@@ -75,6 +169,9 @@ class _DKV:
         with self._mutex:
             self._store.clear()
             self._locks.clear()
+            self._homes.clear()
+            self._migrating.clear()
+            self._rehome_queue.clear()
 
     # ---- atomic update (water/Atomic.java:10) ---------------------------
     def atomic(self, key: str, fn):
@@ -128,6 +225,156 @@ class _DKV:
         return {"keys": len(keys), "frames": nframes,
                 "frame_bytes": fbytes, "write_locked": locked}
 
+    # ---- elastic membership: homes + background re-home -----------------
+    def home_of(self, key: str) -> int:
+        """The node currently SERVING this key. During a migration the
+        old home keeps answering (read-through) — home_of flips to the
+        ring's new assignment only once the key's planes landed."""
+        with self._mutex:
+            if key in self._homes:
+                return self._homes[key]
+            return self._ring.node_for(key)
+
+    def ring_nodes(self) -> list:
+        with self._mutex:
+            return list(self._ring.nodes)
+
+    def set_membership(self, nodes, epoch: int = None):
+        """Rebuild the consistent-hash ring for a new membership epoch
+        and queue the BOUNDED set of keys whose home moved for
+        background re-home. Returns the list of keys that will move.
+        Called by the deploy/membership listener on every epoch bump."""
+        ring = HashRing(nodes)
+        with self._mutex:
+            if epoch is not None:
+                self._rehome_epoch = epoch
+            self._ring = ring
+            moved = [k for k, home in self._homes.items()
+                     if ring.node_for(k) != home
+                     and k not in self._migrating]
+            self._migrating.update(moved)
+            self._rehome_queue.extend(moved)
+            if moved:
+                self._ensure_rehome_worker_locked()
+        return moved
+
+    def _ensure_rehome_worker_locked(self):
+        # a live _rehome_thread is still inside its drain loop and will
+        # observe the keys just queued (retirement happens under this
+        # mutex); None means retired or never started — spawn
+        if self._rehome_thread is not None:
+            return
+        t = threading.Thread(target=self._rehome_loop, daemon=True,
+                             name="h2o3-dkv-rehome")
+        self._rehome_thread = t   # h2o3-ok: R003 _locked helper — every caller holds self._mutex (retirement in _rehome_loop is mutex-held too)
+        t.start()
+
+    def _rehome_loop(self):
+        """Background DKV re-home: drain the moved-key queue, shipping
+        each key's compact codec-byte planes to its new home. Read
+        serving is untouched while this runs — DKV.get answers from the
+        registry and home_of() keeps naming the old home until the
+        per-key swap below."""
+        while True:
+            with self._mutex:
+                if not self._rehome_queue:
+                    # retire UNDER the mutex: set_membership's spawn
+                    # check is serialized against this, so an enqueue
+                    # either lands before this check (we keep draining)
+                    # or sees _rehome_thread cleared and spawns a fresh
+                    # worker — queued keys can never strand
+                    self._rehome_thread = None
+                    return
+                key = self._rehome_queue.pop(0)
+            try:
+                self._migrate_key(key)
+            except Exception as ex:   # noqa: BLE001 — a failed move must
+                from h2o3_tpu.utils import log as _ulog  # not kill the loop
+                _ulog.err("dkv re-home of %r failed: %r", key, ex)
+                with self._mutex:
+                    self._migrating.discard(key)
+
+    def _migrate_key(self, key: str):
+        """Move one key to its ring home: pack each chunk's codec-byte
+        planes (the tier pager's host-tier form — compact bytes, not
+        device arrays), round-trip them through the wire encoding, verify
+        bit-exactness per plane, install the shipped copies, then flip
+        home_of. Values without packed chunks (models, jobs) move as
+        zero-byte control records."""
+        v = self.raw_get(key)
+        if v is None:                     # removed while queued
+            with self._mutex:
+                self._migrating.discard(key)
+            return
+        hook = self._rehome_hook
+        if hook is not None:
+            hook(key)                     # test seam: pause mid-migration
+        moved_bytes = 0
+        with _span("membership.rehome", key=key):
+            for ch in self._value_chunks(v):
+                if not self._chunk_shippable(ch):
+                    # multi-controller SPMD shard: the planes live
+                    # partitioned across the device runtime, not on one
+                    # node — the move is control-plane only (home flips,
+                    # no payload; the replay channel keeps every process
+                    # holding its own shards)
+                    continue
+                data, mask = ch.staging_view()
+                payload = _plane_payload(data, mask)
+                rdata, rmask = _plane_restore(payload)
+                if rdata.tobytes() != data.tobytes() or (
+                        (mask is None) != (rmask is None)) or (
+                        mask is not None
+                        and rmask.tobytes() != mask.tobytes()):
+                    raise RuntimeError(
+                        f"re-home payload of {key!r} not bit-exact")
+                moved_bytes += len(payload)
+                # install the SHIPPED copy as the chunk's host planes —
+                # the new home serves exactly the bytes that moved
+                with ch._io:
+                    if ch._host is not None:
+                        ch._host = (rdata,
+                                    None if rmask is None else rmask)
+        with self._mutex:
+            self._homes[key] = self._ring.node_for(key)
+            self._migrating.discard(key)
+            self._rehome_keys_moved += 1
+            self._rehome_bytes_moved += moved_bytes
+        REHOMED_KEYS.inc()
+        if moved_bytes:
+            REHOMED_BYTES.inc(moved_bytes)
+
+    @staticmethod
+    def _chunk_shippable(ch) -> bool:
+        """A chunk's planes can be packaged from THIS process: host codec
+        bytes exist, or the device arrays are fully addressable. SPMD
+        global shards (multi-controller clouds) are not — device_get
+        from one process would raise."""
+        dev = ch._dev
+        if dev is None or ch._host is not None:
+            return True
+        return bool(getattr(dev[0], "is_fully_addressable", True))
+
+    @staticmethod
+    def _value_chunks(v):
+        """The tier chunks backing a DKV value (a Frame's Vec planes);
+        empty for plain control objects."""
+        out = []
+        for vec in getattr(v, "vecs", []) or []:
+            ch = getattr(vec, "_chunk", None)
+            if ch is not None:
+                out.append(ch)
+        return out
+
+    def rehome_status(self) -> dict:
+        """GET /3/Cloud's re-home view (and the test harness's barrier)."""
+        with self._mutex:
+            return {"epoch": self._rehome_epoch,
+                    "pending": len(self._migrating),
+                    "keys_moved": self._rehome_keys_moved,
+                    "bytes_moved": self._rehome_bytes_moved,
+                    "nodes": list(self._ring.nodes)}
+
     # ---- key minting (water/Key.make) -----------------------------------
     def make_key(self, prefix: str = "obj") -> str:
         with self._mutex:
@@ -136,3 +383,10 @@ class _DKV:
 
 
 DKV = _DKV()
+
+# module-level registration reading the module global (the microbatch
+# pattern: survives a test harness swapping DKV out)
+_om.gauge("h2o3_dkv_rehome_pending",
+          "DKV keys queued or mid-flight in the background re-home "
+          "worker (reads serve through the old home until this drains)",
+          fn=lambda: float(len(DKV._migrating)))

@@ -206,12 +206,45 @@ def _session_key(secret: bytes, nonce_c: str, nonce_w: str) -> bytes:
                     hashlib.sha256).digest()
 
 
+def _form_timeout_s() -> float:
+    """Bound on the coordinator's initial cloud-formation accept loop —
+    a missing worker pod must surface as a loud error, not an accept()
+    parked forever (the R013 unbounded-network-wait class)."""
+    return float(os.environ.get("H2O3_CLOUD_FORM_TIMEOUT_S", "600") or 600)
+
+
+def _reconnect_window_s() -> float:
+    """How long a worker whose coordinator socket dropped keeps retrying
+    the handshake before exiting nonzero. 0 disables reconnection (the
+    pre-elastic behavior: an orphaned worker exits its loop cleanly)."""
+    return float(os.environ.get("H2O3_REPLAY_RECONNECT_S", "60") or 0)
+
+
+def _challenge_peer(conn, secret: bytes):
+    """Coordinator side of the mutual challenge-response on one fresh
+    connection (no welcome — the caller validates the peer id and sends
+    it under the session key). Returns (hello, session_key)."""
+    import secrets as _secrets
+    conn.settimeout(10.0)
+    nonce_c = _secrets.token_hex(16)
+    _send_frame(conn, secret, {"challenge": nonce_c})
+    hello = _recv_frame(conn, secret)
+    if (not hello or hello.get("echo") != nonce_c
+            or not isinstance(hello.get("hello"), int)):
+        raise RuntimeError("bad hello")
+    key = _session_key(secret, nonce_c, str(hello.get("nonce", "")))
+    return hello, key
+
+
 class _ReplayHandler:
     """Duck-typed stand-in for the HTTP handler. Routes need
     _params/_send/_error; byte-streaming routes (DownloadDataset, mojo /
     POJO downloads) additionally drive the raw http.server surface, so
     those are no-ops writing to a sink — on workers the device readback
     is the collective part, the bytes only matter on process 0."""
+
+    server = None          # workers hold no HTTP server / broadcaster:
+    #                        handlers must getattr their way to both
 
     def __init__(self, params):
         self._p = dict(params)
@@ -261,35 +294,45 @@ class Broadcaster:
     peers that pass the mutual challenge-response under the cluster
     secret; unauthenticated connections are dropped and the slot re-armed."""
 
-    def __init__(self, n_workers: int, port: int):
-        import secrets as _secrets
+    def __init__(self, n_workers: int, port: int, keep_listener=False):
         import socket
+        import time as _time
         from h2o3_tpu.analysis.lockdep import make_lock
         secret = _cluster_secret()
+        self._secret = secret
         self._lock = make_lock("replay_channel")
         self._conns = []          # [(sock, session_key)]
         self._owed: list = []     # per-conn acks abandoned by a timed-out
         self._bufs: list = []     # collect; drained before the next send
         self._dead: list = []     # peers that errored: excluded from
+        self._pids: list = []     # worker process ids, by slot
         self._seq = 0             # collects (broadcast still fails loudly)
+        self._closed = False
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("0.0.0.0", port))
-        srv.listen(n_workers)
+        srv.listen(max(n_workers, 1))
+        # polling accept with an overall formation deadline: a worker pod
+        # that never comes up is a loud error within
+        # H2O3_CLOUD_FORM_TIMEOUT_S, not an accept() parked forever
+        srv.settimeout(1.0)
+        form_deadline = _time.monotonic() + _form_timeout_s()
         seen = set()
         while len(self._conns) < n_workers:
-            conn, addr = srv.accept()
+            if _time.monotonic() > form_deadline:
+                srv.close()
+                raise RuntimeError(
+                    f"replay channel: only {len(self._conns)} of "
+                    f"{n_workers} workers joined within "
+                    f"{_form_timeout_s():g}s (H2O3_CLOUD_FORM_TIMEOUT_S)")
             try:
-                conn.settimeout(10.0)
-                nonce_c = _secrets.token_hex(16)
-                _send_frame(conn, secret, {"challenge": nonce_c})
-                hello = _recv_frame(conn, secret)
-                if (not hello or hello.get("echo") != nonce_c
-                        or not isinstance(hello.get("hello"), int)
-                        or hello["hello"] in seen):
+                conn, addr = srv.accept()
+            except socket.timeout:
+                continue
+            try:
+                hello, key = _challenge_peer(conn, secret)
+                if hello["hello"] in seen:
                     raise RuntimeError(f"bad hello from {addr}")
-                nonce_w = str(hello.get("nonce", ""))
-                key = _session_key(secret, nonce_c, nonce_w)
                 _send_frame(conn, key, {"welcome": hello["hello"]})
                 conn.settimeout(None)
                 seen.add(hello["hello"])
@@ -297,12 +340,21 @@ class Broadcaster:
                 self._owed.append(0)
                 self._bufs.append(b"")
                 self._dead.append(False)
+                self._pids.append(hello["hello"])
             except Exception as ex:  # noqa: BLE001 — drop peer, re-arm slot
                 from h2o3_tpu.utils import log as _ulog
                 _ulog.warn("replay channel: rejected peer %s: %s",
                            addr, ex)
                 conn.close()
-        srv.close()
+        # elastic membership (deploy/membership.ElasticBroadcaster) keeps
+        # the listener open to admit joining/replacement workers; the
+        # fixed-membership base closes it — the reference's
+        # Paxos.lockCloud() moment
+        if keep_listener:
+            self._srv = srv
+        else:
+            srv.close()
+            self._srv = None
 
     def _recv_frame_at(self, i: int, timeout=None):
         """Like _recv_frame but RESUMABLE: bytes consumed before a timeout
@@ -493,6 +545,13 @@ class Broadcaster:
 def _collect_local(op: str):
     """Worker-side observability snapshot for Broadcaster.collect."""
     try:
+        if op == "ping":
+            # membership heartbeat: liveness + this worker's view of the
+            # cloud epoch (deploy/membership heartbeat loop)
+            from h2o3_tpu.deploy import membership as _mb
+            from h2o3_tpu.obs import timeline as _tl
+            return {"host": _tl.host_id(), "ok": True,
+                    "epoch": _mb.MEMBERSHIP.epoch}
         if op == "timeline":
             from h2o3_tpu.obs import timeline as _tl
             return {"host": _tl.host_id(),
@@ -555,48 +614,173 @@ def _collect_local(op: str):
     return None
 
 
-def worker_loop(coordinator_host: str, port: int):
-    """Worker side: authenticate the coordinator, then block on the
-    broadcast socket and replay each request in sequence order."""
+def _worker_connect(coordinator_host: str, port: int, pid: int,
+                    secret: bytes, join=False, connect_wait_s=120.0):
+    """Worker side of one connection: reach the coordinator (bounded by
+    `connect_wait_s`), run the mutual challenge-response, return
+    (sock, key, welcome). `join=True` marks the hello as an elastic
+    (re)join so the coordinator's acceptor syncs epoch + snapshot."""
     import secrets as _secrets
     import socket
     import time as _time
-    secret = _cluster_secret()
-    import jax
-    pid = jax.process_index()
-    for _ in range(120):                  # wait for process 0 to listen
+    deadline = _time.monotonic() + connect_wait_s
+    while True:                           # wait for process 0 to listen
         try:
-            sock = socket.create_connection((coordinator_host, port))
+            sock = socket.create_connection((coordinator_host, port),
+                                            timeout=10.0)
             break
         except OSError:
-            _time.sleep(1)
-    else:
-        raise RuntimeError("broadcast coordinator unreachable")
-    chal = _recv_frame(sock, secret)
-    if not chal or "challenge" not in chal:
-        raise RuntimeError("replay channel: no challenge from coordinator")
-    nonce_w = _secrets.token_hex(16)
-    _send_frame(sock, secret,
-                {"hello": pid, "echo": chal["challenge"], "nonce": nonce_w})
-    key = _session_key(secret, chal["challenge"], nonce_w)
-    welcome = _recv_frame(sock, key)      # proves coordinator freshness too
-    if not welcome or welcome.get("welcome") != pid:
-        raise RuntimeError("replay channel: coordinator failed handshake")
-    expect = 1
+            if _time.monotonic() >= deadline:
+                raise RuntimeError("broadcast coordinator unreachable") \
+                    from None
+            _time.sleep(0.5)
+    sock.settimeout(30.0)                 # handshake is bounded; replay
+    #                                       waits below are not (heartbeat
+    #                                       pings arrive as collect ops)
+    try:
+        chal = _recv_frame(sock, secret)
+        if not chal or "challenge" not in chal:
+            raise RuntimeError(
+                "replay channel: no challenge from coordinator")
+        nonce_w = _secrets.token_hex(16)
+        hello = {"hello": pid, "echo": chal["challenge"], "nonce": nonce_w}
+        if join:
+            hello["join"] = 1
+        _send_frame(sock, secret, hello)
+        key = _session_key(secret, chal["challenge"], nonce_w)
+        welcome = _recv_frame(sock, key)  # proves coordinator freshness too
+        if not welcome or welcome.get("welcome") != pid:
+            raise RuntimeError("replay channel: coordinator failed "
+                               "handshake")
+    except Exception:
+        sock.close()
+        raise
+    sock.settimeout(None)
+    return sock, key, welcome
+
+
+def _observe_epoch(e):
+    """Track the coordinator's cloud epoch on this worker (rides every
+    elastic broadcast frame + the join welcome)."""
+    if e is None:
+        return
+    from h2o3_tpu.deploy import membership as _mb
+    _mb.MEMBERSHIP.observe_epoch(int(e))
+
+
+def worker_loop(coordinator_host: str, port: int, pid=None, join=False):
+    """Worker side: authenticate the coordinator, then block on the
+    broadcast socket and replay each request in sequence order.
+
+    Elastic additions: a dropped coordinator socket no longer orphans
+    the worker permanently — it retries the handshake (as a re-join,
+    syncing the current epoch + replayed-state snapshot) with bounded
+    backoff for H2O3_REPLAY_RECONNECT_S before raising, logging a
+    structured WARN per attempt. A `leave` op (coordinator-driven
+    drain) exits cleanly."""
+    import time as _time
+    from h2o3_tpu.utils import log as _ulog
+    secret = _cluster_secret()
+    if pid is None:
+        import jax
+        pid = jax.process_index()
+    sock, key, welcome = _worker_connect(coordinator_host, port, pid,
+                                         secret, join=join)
     while True:
-        msg = _recv_frame(sock, key)
-        if msg is None:
+        reason = _replay_session(sock, key, welcome)
+        if reason == "leave":
             return
+        window = _reconnect_window_s()
+        if window <= 0:
+            return                        # legacy: orphaned worker exits
+        give_up = _time.monotonic() + window
+        attempt = 0
+        sock = None
+        while sock is None:
+            attempt += 1
+            try:
+                sock, key, welcome = _worker_connect(
+                    coordinator_host, port, pid, secret, join=True,
+                    connect_wait_s=min(5.0, window))
+            except (OSError, RuntimeError) as ex:
+                remaining = give_up - _time.monotonic()
+                _ulog.warn("replay channel: reconnect attempt %s failed: "
+                           "%r (giving up in %.0fs)", attempt, ex,
+                           max(remaining, 0.0))
+                if remaining <= 0:
+                    raise RuntimeError(
+                        "replay channel: coordinator gone and re-join "
+                        f"failed for {window:g}s "
+                        "(H2O3_REPLAY_RECONNECT_S)") from ex
+                _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
+
+
+def _replay_session(sock, key, welcome) -> str:
+    """Drive one authenticated replay connection until it ends. Returns
+    "leave" (clean coordinator-driven exit) or "eof" (socket dropped —
+    the caller decides whether to re-join)."""
+    from h2o3_tpu.deploy import chaos as _chaos
+    _observe_epoch(welcome.get("epoch"))
+    # join-sync: replay the coordinator's state snapshot (its bounded
+    # log of already-broadcast mutating requests) BEFORE entering the
+    # live stream, so a replacement worker converges on the same DKV /
+    # model state the survivors hold
+    if welcome.get("snapshot_truncated"):
+        from h2o3_tpu.utils import log as _ulog
+        _ulog.err("join-sync snapshot TRUNCATED (coordinator's request "
+                  "log overflowed H2O3_REPLAY_LOG_MAX): replayed state "
+                  "may trail the survivors — this worker serves, but "
+                  "/3/Cloud marks it unsynced")
+    for req in welcome.get("snapshot") or []:
+        try:
+            replay_request(req["method"], req["path"], req["params"])
+        except Exception as ex:  # noqa: BLE001 — snapshot best-effort
+            from h2o3_tpu.utils import log as _ulog
+            _ulog.warn("join-sync replay %s %s failed: %r",
+                       req.get("method"), req.get("path"), ex)
+    expect = int(welcome.get("seq", 1))
+    while True:
+        try:
+            msg = _recv_frame(sock, key)
+        except OSError:
+            return "eof"
+        if msg is None:
+            return "eof"
+        if msg.get("op") == "leave":      # drain completed: clean exit.
+            # OUT-OF-BAND control frame (seq -1, checked BEFORE the
+            # continuity guard): it goes only to the drained worker, so
+            # consuming a shared sequence number here would leave a hole
+            # that kills every SURVIVOR on its next frame
+            try:
+                _send_frame(sock, key, {"ack": msg.get("seq", -1)})
+            except OSError:
+                pass
+            return "leave"
         if msg.get("seq") != expect:      # replayed/reordered frame
             raise RuntimeError(f"replay channel: bad seq {msg.get('seq')}"
                                f" (expected {expect})")
         expect += 1
+        _observe_epoch(msg.get("epoch"))
         if "op" in msg:                   # observability collect: the data
-            _send_frame(sock, key,        # rides the ack, no route replay
-                        {"ack": msg["seq"],
-                         "data": _collect_local(msg["op"])})
+            # chaos: a delayed/dropped collect ack at a seeded point (the
+            # lagging-worker shape membership detection must absorb)
+            act = _chaos.at("collect.ack")
+            if act is not None and act["action"] == "drop":
+                continue
+            try:
+                _send_frame(sock, key,    # rides the ack, no route replay
+                            {"ack": msg["seq"],
+                             "data": _collect_local(msg["op"])})
+            except OSError:
+                return "eof"
             continue
-        _send_frame(sock, key, {"ack": msg["seq"]})  # ack, then execute
+        # chaos: kill the worker process at a seeded replay point — the
+        # "lost pod" the membership layer must excise and replace
+        _chaos.maybe_raise("worker.replay")
+        try:
+            _send_frame(sock, key, {"ack": msg["seq"]})  # ack, then execute
+        except OSError:
+            return "eof"
         try:
             # replay under the ORIGINATING request's trace id (when the
             # coordinator attached one): every span this replay opens —
@@ -640,10 +824,16 @@ def worker_loop(coordinator_host: str, port: int):
 def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
     """Container entrypoint: bootstrap the (possibly multi-host) cloud;
     process 0 serves REST and broadcasts mutating requests, workers replay
-    them so every host issues the same device programs."""
+    them so every host issues the same device programs.
+
+    H2O3_ELASTIC (default on) runs the replay channel under the
+    deploy/membership epoch state machine: a dead worker is excised
+    instead of wedging the cloud, and replacements may join."""
     import jax
+    from h2o3_tpu.deploy import chaos as _chaos
     cloud = bootstrap(n_rows_shards=n_rows_shards,
                       n_model_shards=n_model_shards)
+    _chaos.install_from_env()
     nproc = jax.process_count()
     bport = port + _BCAST_PORT_OFFSET
     if jax.process_index() == 0:
@@ -653,7 +843,11 @@ def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
         # H2OServer enforces the bind-all-requires-auth posture itself
         srv = H2OServer(port)
         if nproc > 1:
-            srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)
+            if os.environ.get("H2O3_ELASTIC", "1") != "0":
+                from h2o3_tpu.deploy.membership import ElasticBroadcaster
+                srv.httpd.broadcaster = ElasticBroadcaster(nproc - 1, bport)
+            else:
+                srv.httpd.broadcaster = Broadcaster(nproc - 1, bport)
         from h2o3_tpu.utils import log as _ulog
         _ulog.info("h2o3-tpu cloud: %s chips over %s hosts; REST on :%s",
                    cloud.n_devices, nproc, port)
@@ -662,6 +856,19 @@ def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
         host = os.environ.get("H2O3_COORDINATOR_ADDRESS",
                               "127.0.0.1:0").split(":")[0]
         worker_loop(host, bport)
+
+
+def join_cloud(coordinator_host: str, rest_port: int, pid: int):
+    """Replacement-worker entrypoint: skip jax.distributed formation
+    (the dead worker's slot in the fixed device runtime is gone) and
+    join the REPLAY CHANNEL as an elastic member — handshake, sync the
+    current epoch + replayed-state snapshot, then serve replays. This is
+    the `kubectl` / StatefulSet-restart path: a new pod replaces a lost
+    one without reforming the whole cloud."""
+    from h2o3_tpu.deploy import chaos as _chaos
+    _chaos.install_from_env()
+    worker_loop(coordinator_host, rest_port + _BCAST_PORT_OFFSET,
+                pid=pid, join=True)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
 
 
 class H2OAggregatorEstimator(ModelBase):
@@ -64,6 +65,8 @@ class H2OAggregatorEstimator(ModelBase):
         r2 = radius * radius
         B = 4096
         Xj = jnp.asarray(X)
+
+        @_compat.guard_collective
 
         @jax.jit
         def dists(batch, E):

@@ -28,6 +28,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
 
 
 class H2OCoxProportionalHazardsEstimator(ModelBase):
@@ -143,9 +144,9 @@ class H2OCoxProportionalHazardsEstimator(ModelBase):
             return -ll
 
         beta = jnp.zeros(p, jnp.float32)
-        grad_fn = jax.jit(jax.grad(nll_fn))
-        hess_fn = jax.jit(jax.hessian(nll_fn))
-        val_fn = jax.jit(nll_fn)
+        grad_fn = _compat.guard_collective(jax.jit(jax.grad(nll_fn)))
+        hess_fn = _compat.guard_collective(jax.jit(jax.hessian(nll_fn)))
+        val_fn = _compat.guard_collective(jax.jit(nll_fn))
         prev = float(val_fn(beta))
         history = []
         for it in range(int(self.params["max_iterations"])):

@@ -26,6 +26,7 @@ import optax
 
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
 
 
 def _activation(name: str):
@@ -161,6 +162,8 @@ class H2ODeepLearningEstimator(ModelBase):
                             or None)
         opt_state = opt.init(params)
 
+        @_compat.guard_collective
+
         @jax.jit
         def step(params, opt_state, xb, yb, wb, rng):
             l, g = jax.value_and_grad(loss_fn)(params, xb, yb, wb, rng)
@@ -216,7 +219,8 @@ class H2ODeepLearningEstimator(ModelBase):
         # the outer program; the legacy big-batch path still runs fused.
         fwd = self.__dict__.get("_forward_jit")
         if fwd is None:
-            fwd = self._forward_jit = jax.jit(self._forward)
+            fwd = self._forward_jit = _compat.guard_collective(
+                jax.jit(self._forward))
         Xz = jnp.where(jnp.isnan(X), 0.0, X)
         out = fwd(self._params_net, Xz)
         if self.params.get("autoencoder"):

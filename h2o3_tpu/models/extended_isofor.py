@@ -24,6 +24,10 @@ from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.tree import engine as E
 from h2o3_tpu.models.tree.isofor import _avg_path_jnp
 from h2o3_tpu.models.tree.shared_tree import SharedTreeEstimator
+from h2o3_tpu.parallel import compat as _compat
+
+
+@_compat.guard_collective
 
 
 @functools.partial(jax.jit, static_argnames=("d", "ext"))
@@ -59,6 +63,9 @@ def _eif_level(X, w, leaf, active, normA, pointA, didA, valA, key, *, d, ext):
     return leaf, splits, normA, pointA, didA, valA
 
 
+@_compat.guard_collective
+
+
 @functools.partial(jax.jit, static_argnames=("D",))
 def _eif_final(w, leaf, active, valA, *, D):
     L = 2 ** D
@@ -70,6 +77,8 @@ def _eif_final(w, leaf, active, valA, *, D):
 
 def _eif_walk(X, norms, points, dids, vals, D):
     """Mean path length over hyperplane trees: fixed-depth gather walk."""
+
+    @_compat.guard_collective
 
     @jax.jit
     def run(X, norms, points, dids, vals):

@@ -35,6 +35,7 @@ from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models import metrics as M
 from h2o3_tpu.models.model import ModelBase
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.obs.timeline import span as _span
 
 _IRLSM_ITERS = _om.counter("h2o3_glm_irlsm_iterations_total",
@@ -70,7 +71,7 @@ def _linkinv(link, eta, tweedie_link_power=1.0):
 
 
 # ---------------------------------------------------------------------------
-@jax.jit
+@_compat.guarded_jit
 def _gram_pass(X, w, z):
     """GLMIterationTask: G = XᵀWX, q = XᵀWz in one fused device program."""
     Xw = X * w[:, None]
@@ -114,7 +115,7 @@ def _irls_weights(family, link, eta, y, w_obs, tweedie_var_power=1.5,
     raise ValueError(family)
 
 
-@jax.jit
+@_compat.guarded_jit
 def _eta_pass(X, beta):
     return X @ beta
 
@@ -257,7 +258,7 @@ def _nll_value_grad(fam, Xi, y, w, *, K=1, l2=0.0, p_pen=0,
             pen = 0.5 * l2 * (flat[:p_pen] ** 2).sum()
         return nll + pen
 
-    gv = jax.jit(jax.value_and_grad(vg))
+    gv = _compat.guard_collective(jax.jit(jax.value_and_grad(vg)))
 
     def value_grad(x):
         f, g = gv(jnp.asarray(x, jnp.float32))
@@ -292,7 +293,7 @@ def _ordinal_value_grad(Xi, yi_np, w, K, l2=0.0, p_pen=0):
         nll = -(w * jnp.log(py)).sum()
         return nll + 0.5 * l2 * (beta[:p_pen] ** 2).sum()
 
-    gv = jax.jit(jax.value_and_grad(vg))
+    gv = _compat.guard_collective(jax.jit(jax.value_and_grad(vg)))
 
     def value_grad(x):
         f, g = gv(jnp.asarray(x, jnp.float32))
@@ -571,7 +572,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
             alpha[0] if isinstance(alpha, (list, tuple)) else float(alpha))
         l2 = float(lam) * (1 - alpha) * wn
 
-        @jax.jit
+        @_compat.guarded_jit
         def nll(flat):
             flat = flat.astype(jnp.float32)
             beta, b0 = flat[:C], flat[C]
@@ -585,7 +586,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
                 ll = 0.5 * (w * (y - eta) ** 2).sum()
             return ll + 0.5 * l2 * (beta ** 2).sum()
 
-        gv = jax.jit(jax.value_and_grad(nll))
+        gv = _compat.guard_collective(jax.jit(jax.value_and_grad(nll)))
 
         def value_grad(x):
             f, g = gv(jnp.asarray(x, jnp.float32))
@@ -631,7 +632,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         vals = jnp.where(jnp.isnan(vals), 0.0, vals)
         beta = jnp.asarray(st.beta[:C], jnp.float32)
 
-        @jax.jit
+        @_compat.guarded_jit
         def sc(vals):
             eta = jax.ops.segment_sum(vals * beta[ci], ri,
                                       num_segments=n) + float(st.beta[C])
@@ -851,11 +852,11 @@ class H2OGeneralizedLinearEstimator(ModelBase):
         max_it = int(self.params["max_iterations"])
         beps = float(self.params["beta_epsilon"])
 
-        @jax.jit
+        @_compat.guarded_jit
         def probs_fn(B):
             return jax.nn.softmax(Xi @ B.T, axis=1)
 
-        @jax.jit
+        @_compat.guarded_jit
         def class_gram(B, c, yk):
             P = jax.nn.softmax(Xi @ B.T, axis=1)
             pc = jnp.clip(P[:, c], 1e-6, 1 - 1e-6)   # f32-safe
@@ -866,7 +867,7 @@ class H2OGeneralizedLinearEstimator(ModelBase):
             Xw = Xi * wi[:, None]
             return Xi.T @ Xw, Xw.T @ z
 
-        @jax.jit
+        @_compat.guarded_jit
         def obj_fn(B):
             P = jax.nn.softmax(Xi @ B.T, axis=1)
             py = jnp.take_along_axis(P, jnp.asarray(yi)[:, None], 1)[:, 0]

@@ -19,6 +19,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
 
 
 class H2OGeneralizedLowRankEstimator(ModelBase):
@@ -56,6 +57,8 @@ class H2OGeneralizedLowRankEstimator(ModelBase):
         B = jnp.asarray(rng.normal(0, 0.1, (k, p)), jnp.float32)
         A = jnp.zeros((n, k), jnp.float32)
 
+        @_compat.guard_collective
+
         @jax.jit
         def step_A(Xz, M, B):
             # exact masked per-row ridge: A_r = (B·diag(m_r)·Bᵀ+γI)⁻¹ B(m_r·x_r)
@@ -65,6 +68,8 @@ class H2OGeneralizedLowRankEstimator(ModelBase):
             rhs = (Xz * M) @ B.T
             return jax.vmap(jnp.linalg.solve)(G, rhs)
 
+        @_compat.guard_collective
+
         @jax.jit
         def step_B(Xz, M, A):
             # exact masked per-column ridge over archetypes
@@ -72,6 +77,8 @@ class H2OGeneralizedLowRankEstimator(ModelBase):
                 + (gy + 1e-6) * jnp.eye(k)[None]
             rhs = (A.T @ (Xz * M)).T                  # (p, k)
             return jax.vmap(jnp.linalg.solve)(G, rhs).T
+
+        @_compat.guard_collective
 
         @jax.jit
         def objective(Xz, M, A, B):

@@ -25,26 +25,17 @@ import numpy as np
 
 from h2o3_tpu.core.kvstore import DKV
 
-# XLA's CPU client shares ONE collective thread pool across concurrently
-# launched programs: two in-flight 8-replica executions each park a subset
-# of their participants at the AllGather rendezvous and starve each other
-# forever (collective_ops_utils.h "may be stuck"). Concurrent builds on a
-# host-platform mesh therefore run their train() calls one at a time —
-# the WHOLE call, not just dispatch, because async execution outlives the
-# launch and must not overlap the next build's collectives. Accelerator
-# runtimes queue per-device and interleave fine, so they keep full
-# overlap. (Other concurrent multi-replica dispatch paths share the
-# hazard on host meshes — see the ROADMAP item on hoisting this into the
-# shared dispatch layer.)
-_HOST_COLLECTIVE_LOCK = threading.Lock()
-
-
-def _needs_device_serialization() -> bool:
-    try:
-        import jax
-        return jax.default_backend() == "cpu" and jax.device_count() > 1
-    except Exception:  # noqa: BLE001 — no jax, nothing to serialize
-        return False
+# Concurrent multi-replica dispatch on a host (CPU) mesh hangs at the
+# XLA collective rendezvous; the serialization that used to live here as
+# a private module lock is now owned by the shared dispatch layer
+# (parallel/compat): every JIT launch takes the fine-grained
+# host_collective_guard (launch→block_until_ready), and whole trains
+# take compat.train_guard — still end-to-end on host meshes, because a
+# training body's EAGER ops on sharded arrays (row slicing → gather
+# collectives) cannot be call-site-guarded. Accelerator runtimes keep
+# full overlap; on host meshes host-side work between a train's device
+# launches still overlaps OTHER guarded dispatch (serving, rapids),
+# just not other trains.
 
 
 class H2OGridSearch:
@@ -130,11 +121,8 @@ class H2OGridSearch:
             params["model_id"] = model_id
             try:
                 m = self._cls(**params)
-                if _needs_device_serialization():
-                    with _HOST_COLLECTIVE_LOCK:
-                        m.train(x=x, y=y, training_frame=training_frame,
-                                validation_frame=validation_frame)
-                else:
+                from h2o3_tpu.parallel import compat as _compat
+                with _compat.train_guard():
                     m.train(x=x, y=y, training_frame=training_frame,
                             validation_frame=validation_frame)
                 with self._lock:

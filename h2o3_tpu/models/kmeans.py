@@ -24,6 +24,10 @@ import numpy as np
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models import metrics as M
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
+
+
+@_compat.guard_collective
 
 
 @jax.jit
@@ -42,12 +46,18 @@ def _lloyd_step(X, C, w):
     return assign, sums, counts, wss
 
 
+@_compat.guard_collective
+
+
 @jax.jit
 def _totss(X, w):
     n = w.sum()
     mean = (w[:, None] * X).sum(axis=0) / n
     d = X - mean[None, :]
     return (w[:, None] * d * d).sum()
+
+
+@_compat.guard_collective
 
 
 @jax.jit

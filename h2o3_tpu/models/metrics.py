@@ -21,6 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from h2o3_tpu.parallel import compat as _compat
 
 NBINS_AUC = 4096
 GAINS_GROUPS = 16
@@ -36,6 +37,7 @@ def _wmask(y, w):
 
 # ===========================================================================
 # Regression (hex/ModelMetricsRegression.java)
+@_compat.guard_collective
 @jax.jit
 def _regression_pass(y, p, w):
     y, w = _wmask(y, w)
@@ -86,6 +88,7 @@ def regression_metrics(y, p, w=None) -> RegressionMetrics:
 
 # ===========================================================================
 # Binomial (hex/ModelMetricsBinomial.java + hex/AUC2.java)
+@_compat.guard_collective
 @jax.jit
 def _binomial_pass(y, p, w):
     """One sweep → logloss sum + per-score-bin pos/neg weight histograms."""
@@ -218,6 +221,7 @@ def _gains_lift(pos, neg) -> dict:
 # ===========================================================================
 # Multinomial (hex/ModelMetricsMultinomial.java)
 def _multinomial_pass(nclass):
+    @_compat.guard_collective
     @jax.jit
     def f(y, probs, w):
         y, w = _wmask(y, w)

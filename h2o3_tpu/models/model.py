@@ -35,6 +35,7 @@ from h2o3_tpu.core.jobs import Job
 from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.models import metrics as M
 from h2o3_tpu.parallel import mesh as _mesh
+from h2o3_tpu.parallel import compat as _compat
 
 
 # ===========================================================================
@@ -249,8 +250,8 @@ class DataInfo:
         fn = self.__dict__.get("_assemble_jit")
         if fn is None:
             out_sh = _mesh.cloud().rows_sharding(2)
-            fn = self._assemble_jit = jax.jit(self._assemble,
-                                              out_shardings=out_sh)
+            fn = self._assemble_jit = _compat.guard_collective(
+                jax.jit(self._assemble, out_shardings=out_sh))
         return fn(raw_cat, raw_num)
 
     def response(self, frame: Frame) -> jax.Array:
@@ -309,6 +310,9 @@ class DataInfo:
         f = Frame(names, vecs)
         DKV.remove(f.key)  # adaptation product is transient, not registered
         return f
+
+
+@_compat.guard_collective
 
 
 @jax.jit

@@ -16,6 +16,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models.model import ModelBase, DataInfo
+from h2o3_tpu.parallel import compat as _compat
 
 
 class H2ONaiveBayesEstimator(ModelBase):
@@ -45,6 +46,8 @@ class H2ONaiveBayesEstimator(ModelBase):
         cat_idx = [i for i, c in enumerate(di.predictors) if c in di.cat_cols]
         num_idx = [i for i, c in enumerate(di.predictors) if c not in di.cat_cols]
         cards = [di.cardinalities[di.predictors[i]] for i in cat_idx]
+
+        @_compat.guard_collective
 
         @jax.jit
         def tables(X, yi, w):

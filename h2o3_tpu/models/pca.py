@@ -22,12 +22,19 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
+
+
+@_compat.guard_collective
 
 
 @jax.jit
 def _gram(Xz, w):
     Xw = Xz * w[:, None]
     return Xz.T @ Xw, w.sum()
+
+
+@_compat.guard_collective
 
 
 @jax.jit

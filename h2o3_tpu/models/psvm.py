@@ -21,6 +21,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
 
 
 class H2OSupportVectorMachineEstimator(ModelBase):
@@ -70,6 +71,8 @@ class H2OSupportVectorMachineEstimator(ModelBase):
             Wr, br = rff
             return jnp.sqrt(2.0 / Wr.shape[1]) * jnp.cos(Xz @ Wr + br)
 
+        @_compat.guard_collective
+
         @jax.jit
         def loss(params, Xz, ysvm, w):
             beta, b0 = params
@@ -83,7 +86,9 @@ class H2OSupportVectorMachineEstimator(ModelBase):
         import optax
         opt = optax.lbfgs()
         opt_state = opt.init(params)
-        vg = jax.jit(jax.value_and_grad(loss))
+        vg = _compat.guard_collective(jax.jit(jax.value_and_grad(loss)))
+
+        @_compat.guard_collective
 
         @jax.jit
         def step(params, opt_state, Xz, ysvm, w):

@@ -23,9 +23,13 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from h2o3_tpu.parallel import compat as _compat
 
 _B = 256          # bins per refinement round
 _ITERS = 4        # 256^4 = 2^32 range resolution
+
+
+@_compat.guard_collective
 
 
 @functools.partial(jax.jit, static_argnames=("iters",))

@@ -16,11 +16,18 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
+
+
+@_compat.guard_collective
 
 
 @jax.jit
 def _gram_xtx(X):
     return X.T @ X
+
+
+@_compat.guard_collective
 
 
 @jax.jit

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.obs.timeline import span as _span
 
 # Σ rows·trees processed — the headline GBM throughput numerator; bench.py
@@ -199,6 +200,7 @@ def find_best_splits(hist, mn, mx, min_rows, min_split_improvement,
 
 # ===========================================================================
 # The fused per-level program — zero host syncs.
+@_compat.guard_collective
 @functools.partial(jax.jit, static_argnames=("d", "B", "mtries"))
 def _level_step(X, stats, w_in, leaf, heap, active, colA, thrA, nalA, valA,
                 gains, col_mask, key, *, d, B, mtries,
@@ -243,6 +245,7 @@ def _level_step(X, stats, w_in, leaf, heap, active, colA, thrA, nalA, valA,
     return leaf, heap, active, colA, thrA, nalA, valA, gains
 
 
+@_compat.guard_collective
 @functools.partial(jax.jit, static_argnames=("D",))
 def _final_leaves(stats, leaf, active, w_in, valA, *, D):
     L = 2 ** D
@@ -265,6 +268,7 @@ def gamma_pass(heap, w, res, hess, val, *, nodes, scale=1.0,
                                reg_alpha=reg_alpha)
 
 
+@_compat.guard_collective
 @functools.partial(jax.jit,
                    static_argnames=("nodes", "scale", "reg_lambda",
                                     "reg_alpha"))
@@ -280,6 +284,7 @@ def _gamma_pass_jit(heap, w, res, hess, val, *, nodes, scale=1.0,
                      val).astype(jnp.float32)
 
 
+@_compat.guard_collective
 @functools.partial(jax.jit, static_argnames=("nodes", "D"))
 def _node_covers_jit(heap, w, *, nodes, D):
     cov = jax.ops.segment_sum(w, heap, num_segments=nodes)
@@ -339,6 +344,7 @@ def stack_trees(tree_list, depth) -> TreeArrays:
         depth=depth, cover=cover)
 
 
+@_compat.guard_collective
 @functools.partial(jax.jit, static_argnames=("depth", "has_cat"))
 def _ensemble_walk(X, col, thr, nal, val, tw, catbits, iscat, *, depth,
                    has_cat):
@@ -401,6 +407,7 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
                           depth=trees.depth, has_cat=has_cat)
 
 
+@_compat.guard_collective
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _leaf_id_walk(X, col, thr, nal, *, depth):
     """Module-level (cached) version of the leaf-id walk — same per-call
@@ -497,7 +504,12 @@ class TreeGrower:
                     # fully async at fixed depth.
                     # h2o3-ok: R002 intentional per-level drain barrier (CPU collective flakiness), gated to the CPU backend
                     jax.block_until_ready(valA)
-                    if not bool(jnp.any(active)):
+                    # the early-exit probe is an EAGER cross-shard reduce:
+                    # it must take the same collective guard as the level
+                    # programs or a concurrent build can rendezvous-starve
+                    # against it on the host mesh
+                    if not _compat.run_host_serialized(
+                            lambda: bool(jnp.any(active))):
                         return colA, thrA, nalA, valA, heap, gains
             valA = _final_leaves(stats, leaf, active, w, valA, D=self.D)
             if _cpu_backend():

@@ -22,6 +22,7 @@ import numpy as np
 
 from h2o3_tpu.core.frame import Frame, Vec, T_STR
 from h2o3_tpu.models.model import ModelBase
+from h2o3_tpu.parallel import compat as _compat
 
 
 class H2OWord2vecEstimator(ModelBase):
@@ -98,6 +99,8 @@ class H2OWord2vecEstimator(ModelBase):
         syn0 = jnp.asarray(rng.uniform(-0.5 / dim, 0.5 / dim, (V, dim)),
                            jnp.float32)
         syn1 = jnp.zeros((V, dim), jnp.float32)
+
+        @_compat.guard_collective
 
         @jax.jit
         def step(syn0, syn1, c_idx, ctx_idx, neg_idx, lr):

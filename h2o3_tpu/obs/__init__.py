@@ -23,6 +23,13 @@ trace (cluster JStack + log tail, durable under ice_root); the
 structured logger (utils/log) correlates every record to the active
 trace/span and marks ERROR-logged traces for recorder retention.
 
+Elastic membership (ISSUE 10): `h2o3_cloud_epoch` /
+`h2o3_cloud_live_workers` gauges, excision/join/re-home/epoch-retry
+counters and the `membership.*` spans live in deploy/membership.py and
+core/kvstore.py; the membership env surface (H2O3_HEARTBEAT_S,
+H2O3_REPLAY_RECONNECT_S, H2O3_DRAIN_TIMEOUT_S, H2O3_CHAOS, …) is
+documented in the README "Elastic cloud & chaos testing" section.
+
 Env surface:
   H2O3_OBS_TIMELINE_CAPACITY  span ring size (default 4096)
   H2O3_WATCHDOG               "0" disables the stall sentinel

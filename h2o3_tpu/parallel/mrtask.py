@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from h2o3_tpu.deploy import chaos as _chaos
+from h2o3_tpu.deploy import membership as _mb
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs import watchdog as _wd
 from h2o3_tpu.obs.timeline import span as _span
@@ -106,14 +108,19 @@ def cached_jit(fn, **jit_kwargs):
     except (TypeError, ValueError, _Uncacheable):
         # unhashable captures, bound methods, cyclic closures, or an
         # uninitialized cell (ValueError): uncached fallback — exactly
-        # the pre-cached_jit behavior, never wrong results
-        return jax.jit(fn, **jit_kwargs)
+        # the pre-cached_jit behavior, never wrong results. Guarded like
+        # the cached path: every cached_jit call site is a potential
+        # multi-replica launch on a host mesh.
+        return _compat.guard_collective(jax.jit(fn, **jit_kwargs))
     with _JIT_CACHE_LOCK:
         jfn = _JIT_CACHE.get(key)
         if jfn is not None:
             _JIT_CACHE.move_to_end(key)
             return jfn
-    jfn = jax.jit(fn, **jit_kwargs)
+    # the host-mesh collective guard rides INSIDE the cached wrapper, so
+    # every call site of a cached_jit program serializes its launch→ready
+    # window on CPU meshes (see parallel/compat.py)
+    jfn = _compat.guard_collective(jax.jit(fn, **jit_kwargs))
     with _JIT_CACHE_LOCK:
         cur = _JIT_CACHE.setdefault(key, jfn)
         _JIT_CACHE.move_to_end(key)
@@ -122,7 +129,29 @@ def cached_jit(fn, **jit_kwargs):
     return cur
 
 
-def _traced_dispatch(name: str, jfn, arrays, fn):
+def _dispatch_once(jfn, arrays):
+    """One device launch. The host-mesh collective guard rides INSIDE
+    `jfn` (cached_jit / map_chunks wrap their jits with guard_collective
+    at creation), so this funnel adds no second lock acquisition. The
+    chaos hook lets the fault harness fail a seeded dispatch with
+    EpochChanged."""
+    _chaos.maybe_raise("mrtask.dispatch", exc=_mb.EpochChanged)
+    return jfn(*arrays)
+
+
+def _dispatch_retrying(jfn, arrays, retryable: bool):
+    """Membership-aware dispatch: an execution that straddles a cloud
+    epoch bump (a worker excised mid-collective) retries ONCE against
+    the new epoch with jittered backoff instead of failing the caller.
+    Single-host clouds and donated-buffer dispatches (whose inputs are
+    consumed by the first attempt) skip straight through."""
+    if retryable and _mb.MEMBERSHIP.multi:
+        return _mb.retry_once(lambda: _dispatch_once(jfn, arrays),
+                              op="mrtask")
+    return _dispatch_once(jfn, arrays)
+
+
+def _traced_dispatch(name: str, jfn, arrays, fn, retryable=True):
     """Dispatch `jfn(*arrays)`, recording an mrtask phase span when the
     calling thread is inside an active trace (obs/tracing). Untraced
     callers — training inner loops, bench — pay the trace TLS read plus
@@ -138,8 +167,8 @@ def _traced_dispatch(name: str, jfn, arrays, fn):
     with _wd.watch("device", desc=f"{name}:{fname}"):
         if _tracing.current() is not None:
             with _span(name, fn=fname):
-                return jfn(*arrays)
-        return jfn(*arrays)
+                return _dispatch_retrying(jfn, arrays, retryable)
+        return _dispatch_retrying(jfn, arrays, retryable)
 
 
 def prefetch_chunks(handles):
@@ -182,7 +211,10 @@ def map_reduce(fn, *arrays, donate=(), prefetch=()):
     """
     prefetch_chunks(prefetch)
     jfn = cached_jit(fn, donate_argnums=donate)
-    return _traced_dispatch("mrtask.map_reduce", jfn, arrays, fn)
+    # donated inputs are consumed by the first attempt — never retryable
+    # across an epoch bump
+    return _traced_dispatch("mrtask.map_reduce", jfn, arrays, fn,
+                            retryable=not donate)
 
 
 def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False,
@@ -213,11 +245,13 @@ def map_chunks(fn, *arrays, in_specs=None, out_specs=None, check_vma=False,
         hash(key)
     except (TypeError, ValueError, _Uncacheable):
         return _traced_dispatch(   # h2o3-ok: R001,R011 unhashable specs fall back to the uncached legacy path; same map_chunks stage either way
-            "mrtask.map_chunks", jax.jit(smapped), arrays, fn)
+            "mrtask.map_chunks",
+            _compat.guard_collective(jax.jit(smapped)), arrays, fn)
     with _JIT_CACHE_LOCK:
         jfn = _JIT_CACHE.get(key)
         if jfn is None:
-            jfn = _JIT_CACHE[key] = jax.jit(smapped)
+            jfn = _JIT_CACHE[key] = _compat.guard_collective(
+                jax.jit(smapped))
         _JIT_CACHE.move_to_end(key)
         while len(_JIT_CACHE) > _JIT_CACHE_MAX:
             _JIT_CACHE.popitem(last=False)
@@ -287,8 +321,9 @@ def jit_rows(fn=None, *, static_argnums=(), donate_argnums=()):
     if fn is None:
         return functools.partial(jit_rows, static_argnums=static_argnums,
                                  donate_argnums=donate_argnums)
-    return jax.jit(fn, static_argnums=static_argnums,
-                   donate_argnums=donate_argnums)
+    return _compat.guard_collective(
+        jax.jit(fn, static_argnums=static_argnums,
+                donate_argnums=donate_argnums))
 
 
 def row_mask(padded_len: int, nrows: int, dtype=jnp.float32):

@@ -23,6 +23,8 @@ import time
 import numpy as np
 
 from h2o3_tpu.analysis.lockdep import make_lock
+from h2o3_tpu.deploy import chaos as _chaos
+from h2o3_tpu.deploy import membership as _mb
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs.timeline import span as _span
@@ -229,7 +231,17 @@ class MicroBatcher:
                 for r in batch:
                     raw[off:off + r.n] = r.raw
                     off += r.n
-                out = _sc.score_rows(model, raw, total, links=links)
+                # membership-aware dispatch: a scoring batch straddling a
+                # cloud-epoch bump (a worker excised mid-request) retries
+                # once with jittered backoff against the new epoch instead
+                # of failing all N coalesced requests. The chaos hook lets
+                # the fault harness fail a seeded dispatch deterministically.
+                def _score():
+                    _chaos.maybe_raise("microbatch.dispatch",
+                                       exc=_mb.EpochChanged)
+                    return _sc.score_rows(model, raw, total, links=links)
+
+                out = _mb.retry_once(_score, op="microbatch")
             DISPATCHES.inc()
             # one served trace id rides each histogram as an OpenMetrics
             # exemplar, so a dispatch-latency spike resolves to a trace

@@ -13,20 +13,29 @@ import sys
 
 def main():
     pid, nproc, coord_port, rest_port = (int(a) for a in sys.argv[1:5])
+    join = len(sys.argv) > 5 and sys.argv[5] == "join"
     # sitecustomize imports jax at interpreter start, so the JAX_PLATFORMS
     # env var is read too late — force the backend via config (the same
     # workaround tests/conftest.py uses)
     import jax
     jax.config.update("jax_platforms", "cpu")
     os.environ.setdefault("H2O3_CLUSTER_SECRET", "multiproc-test-secret")
-    os.environ["H2O3_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
-    os.environ["H2O3_NUM_PROCESSES"] = str(nproc)
     os.environ["H2O3_PROCESS_ID"] = str(pid)
     os.environ["H2O3_INSECURE_BIND_ALL"] = "1"   # loopback-only test
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from h2o3_tpu.deploy import multihost
+    if join:
+        # replacement worker: the dead process's slot in the fixed jax
+        # runtime is gone — join the REPLAY CHANNEL only (single-process
+        # jax), sync epoch + snapshot, serve replays
+        import h2o3_tpu
+        h2o3_tpu.init()
+        multihost.join_cloud("127.0.0.1", rest_port, pid)
+        return
+    os.environ["H2O3_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
+    os.environ["H2O3_NUM_PROCESSES"] = str(nproc)
     multihost.serve(rest_port)
 
 

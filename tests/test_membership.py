@@ -1,0 +1,846 @@
+"""Elastic cloud membership + fault injection (ISSUE 10).
+
+The fake-worker harness drives a REAL ElasticBroadcaster (real sockets,
+real HMAC framing, real epoch state machine) against protocol-faithful
+fake workers, and proves the ROADMAP win condition at the replay-channel
+level: a worker killed mid-scoring-load is excised within the detection
+deadline, the epoch bumps, every client request still succeeds (zero
+failures, bounded latency blip), and a replacement joins with epoch +
+snapshot sync and serves. DKV re-home is covered separately: bounded key
+movement on the consistent-hash ring, bit-exact packed planes per codec,
+read-through mid-migration."""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from h2o3_tpu.core.frame import Frame
+from h2o3_tpu.core.kvstore import DKV, HashRing
+from h2o3_tpu.deploy import chaos
+from h2o3_tpu.deploy import membership as MB
+from h2o3_tpu.deploy import multihost as MH
+from h2o3_tpu.obs import metrics as om
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "clients", "py"))
+from h2o3_client import H2OClient, H2ORetryError  # noqa: E402
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture()
+def cloud_env(monkeypatch):
+    """Hermetic membership state: fresh epoch machine, no chaos rules,
+    heartbeat off unless a test opts in, fast ack deadline."""
+    monkeypatch.setenv("H2O3_CLUSTER_SECRET", "membership-test-secret")
+    monkeypatch.setenv("H2O3_HEARTBEAT_S", "0")
+    monkeypatch.setenv("H2O3_REPLAY_ACK_TIMEOUT_S", "1")
+    MB.MEMBERSHIP.reset()
+    chaos.reset()
+    yield
+    MB.MEMBERSHIP.reset()
+    chaos.reset()
+    DKV.set_membership([0], epoch=1)
+    deadline = time.monotonic() + 5
+    while DKV.rehome_status()["pending"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+
+
+def _handshake(port, pid, join=False):
+    """Protocol-faithful fake-worker handshake; returns (sock, key)."""
+    secret = os.environ["H2O3_CLUSTER_SECRET"].encode()
+    deadline = time.monotonic() + 10
+    sock = None
+    while sock is None:
+        try:
+            sock = socket.create_connection(("127.0.0.1", port),
+                                            timeout=5.0)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+    chal = MH._recv_frame(sock, secret)
+    nonce = f"{pid:08x}" * 4
+    hello = {"hello": pid, "echo": chal["challenge"], "nonce": nonce}
+    if join:
+        hello["join"] = 1
+    MH._send_frame(sock, secret, hello)
+    key = MH._session_key(secret, chal["challenge"], nonce)
+    welcome = MH._recv_frame(sock, key)
+    assert welcome and welcome.get("welcome") == pid, welcome
+    return sock, key, welcome
+
+
+class FakeWorker:
+    """Acks every frame like a live worker; records what it saw. Can be
+    muted (stops acking — the wedged-worker shape) or killed (socket
+    closed — the lost-pod shape)."""
+
+    def __init__(self, port, pid, join=False):
+        self.pid = pid
+        self.sock, self.key, self.welcome = _handshake(port, pid,
+                                                       join=join)
+        self.frames: list = []
+        self.muted = False
+        # strict sequence-continuity tracking, like the REAL worker's
+        # `bad seq` guard: a coordinator that skips a live worker's seq
+        # (the drain-hole bug class) shows up in self.seq_errors
+        self.expect = int(self.welcome.get("seq", 1))
+        self.seq_errors: list = []
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"fake-worker-{pid}")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                msg = MH._recv_frame(self.sock, self.key)
+            except Exception:   # noqa: BLE001 — closed mid-frame
+                return
+            if msg is None:
+                return
+            self.frames.append(msg)
+            if msg.get("op") == "leave":   # out-of-band: no seq consumed
+                try:
+                    MH._send_frame(self.sock, self.key,
+                                   {"ack": msg.get("seq", -1)})
+                except OSError:
+                    pass
+                return
+            if msg.get("seq") != self.expect:
+                self.seq_errors.append((msg.get("seq"), self.expect))
+            self.expect += 1
+            if self.muted:
+                continue
+            data = None
+            if msg.get("op") == "ping":
+                data = {"host": self.pid, "ok": True}
+            try:
+                if "op" in msg:
+                    MH._send_frame(self.sock, self.key,
+                                   {"ack": msg["seq"], "data": data})
+                else:
+                    MH._send_frame(self.sock, self.key,
+                                   {"ack": msg["seq"]})
+            except OSError:
+                return
+
+    def kill(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def seqs(self):
+        return [m.get("seq") for m in self.frames]
+
+
+def _start_elastic(n_workers, port):
+    """ElasticBroadcaster + n fake workers, fully formed."""
+    out = {}
+
+    def _mk():
+        out["bc"] = MB.ElasticBroadcaster(n_workers, port)
+
+    t = threading.Thread(target=_mk, daemon=True)
+    t.start()
+    workers = [FakeWorker(port, pid) for pid in range(1, n_workers + 1)]
+    t.join(timeout=15)
+    assert not t.is_alive() and "bc" in out
+    return out["bc"], workers
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring + DKV re-home
+def test_hash_ring_deterministic_and_bounded_movement():
+    r3 = HashRing([0, 1, 2])
+    keys = [f"frame_{i}" for i in range(2000)]
+    assert [r3.node_for(k) for k in keys[:10]] == \
+        [HashRing([0, 1, 2]).node_for(k) for k in keys[:10]]
+    # adding one node moves roughly 1/4 of keys — and ONLY onto the new
+    # node (no shuffling between survivors)
+    r4 = HashRing([0, 1, 2, 3])
+    moved = [k for k in keys if r3.node_for(k) != r4.node_for(k)]
+    assert 0 < len(moved) < len(keys) * 0.45
+    assert all(r4.node_for(k) == 3 for k in moved)
+    # losing a node moves only ITS keys
+    r2 = HashRing([0, 1])
+    lost = [k for k in keys if r3.node_for(k) != r2.node_for(k)]
+    assert all(r3.node_for(k) == 2 for k in lost)
+
+
+def _codec_frame():
+    n = 256
+    rng = np.random.default_rng(11)
+    cols = {
+        "const": np.full(n, 3.0),
+        "i8": np.where(np.arange(n) % 9 == 0, np.nan,
+                       (np.arange(n) % 90).astype(float)),
+        "i32": (np.arange(n) * 70000).astype(float),
+        "f32": np.where(np.arange(n) % 5 == 0, np.nan,
+                        rng.normal(size=n)),
+    }
+    return Frame.from_dict(cols)
+
+
+def test_rehome_bit_exact_and_read_through(cloud_env):
+    f = _codec_frame()
+    try:
+        base = f.to_numpy()
+        packed0 = [(np.asarray(v._chunk.staging_view()[0]).copy(),
+                    None if v._chunk.staging_view()[1] is None
+                    else np.asarray(v._chunk.staging_view()[1]).copy(),
+                    v.codec.kind) for v in f.vecs]
+        moved_evt = threading.Event()
+        release_evt = threading.Event()
+
+        def _pause(key):
+            if key == f.key:
+                moved_evt.set()
+                assert release_evt.wait(10)
+
+        DKV._rehome_hook = _pause
+        try:
+            # force every node's arc to change so f.key moves
+            moved = DKV.set_membership([0, 1, 2, 3], epoch=2)
+            if f.key not in moved:
+                moved2 = DKV.set_membership([5, 6], epoch=3)
+                assert f.key in moved + moved2
+            assert moved_evt.wait(10)
+            # READ-THROUGH: the key is mid-migration right now — reads
+            # serve correct values from the old home
+            assert f.key in DKV._migrating
+            got_mid = DKV.get(f.key).to_numpy()
+            assert np.array_equal(base, got_mid, equal_nan=True)
+            release_evt.set()
+        finally:
+            DKV._rehome_hook = None
+            release_evt.set()
+        deadline = time.monotonic() + 10
+        while DKV.rehome_status()["pending"] and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        st = DKV.rehome_status()
+        assert st["pending"] == 0 and st["keys_moved"] >= 1
+        assert st["bytes_moved"] > 0
+        # bit-exact packed planes per codec after the move
+        for v, (p0, m0, kind) in zip(f.vecs, packed0):
+            p1, m1 = v._chunk.staging_view()
+            assert v.codec.kind == kind
+            assert np.asarray(p1).dtype == p0.dtype
+            assert np.array_equal(p0, np.asarray(p1))
+            assert (m0 is None) == (m1 is None)
+            if m0 is not None:
+                assert np.array_equal(m0, np.asarray(m1))
+        assert np.array_equal(base, f.to_numpy(), equal_nan=True)
+    finally:
+        DKV.remove(f.key)
+
+
+# ---------------------------------------------------------------------------
+# chaos layer
+def test_chaos_spec_parse_and_determinism(cloud_env):
+    chaos.install("point=replay.send,worker=1,after=2,action=sever;"
+                  "point=microbatch.dispatch,action=fail,times=2")
+    # after=2: the first two matching hits pass clean, the 3rd fires,
+    # then the rule is spent (times=1) — deterministic, no randomness
+    assert chaos.at("replay.send", worker=1) is None
+    assert chaos.at("replay.send", worker=2) is None   # other worker
+    assert chaos.at("replay.send", worker=1) is None
+    assert chaos.at("replay.send", worker=1)["action"] == "sever"
+    assert chaos.at("replay.send", worker=1) is None   # spent
+    with pytest.raises(MB.EpochChanged):
+        chaos.maybe_raise("microbatch.dispatch", exc=MB.EpochChanged)
+    with pytest.raises(MB.EpochChanged):
+        chaos.maybe_raise("microbatch.dispatch", exc=MB.EpochChanged)
+    chaos.maybe_raise("microbatch.dispatch", exc=MB.EpochChanged)  # spent
+    assert om.REGISTRY.to_dict()  # registry alive
+    with pytest.raises(ValueError):
+        chaos.parse("action=sever")          # point required
+    with pytest.raises(ValueError):
+        chaos.parse("point=x,action=nope")   # unknown action
+
+
+def test_retry_once_semantics(cloud_env):
+    calls = {"n": 0}
+
+    def flaky_epoch():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise MB.EpochChanged()
+        return "ok"
+
+    before = MB.EPOCH_RETRIES.value(op="t")
+    assert MB.retry_once(flaky_epoch, op="t") == "ok"
+    assert MB.EPOCH_RETRIES.value(op="t") == before + 1
+
+    # a plain exception with a STABLE epoch propagates unchanged
+    def boom():
+        raise ValueError("real bug")
+
+    with pytest.raises(ValueError):
+        MB.retry_once(boom, op="t")
+
+    # a plain exception while the epoch moved is retried once
+    calls["n"] = 0
+
+    def flaky_while_epoch_moves():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            MB.MEMBERSHIP.observe_epoch(MB.MEMBERSHIP.epoch + 1)
+            raise RuntimeError("collective torn by excision")
+        return 42
+
+    assert MB.retry_once(flaky_while_epoch_moves, op="t") == 42
+    assert calls["n"] == 2
+
+
+def test_microbatch_retries_over_epoch_change(cloud_env):
+    """A scoring dispatch that fails at a seeded chaos point with
+    EpochChanged is retried once and the request SUCCEEDS."""
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    rng = np.random.default_rng(3)
+    fr = Frame.from_dict({"a": rng.normal(size=128),
+                          "b": rng.normal(size=128),
+                          "y": rng.normal(size=128)})
+    try:
+        m = H2OGeneralizedLinearEstimator(family="gaussian")
+        m.train(x=["a", "b"], y="y", training_frame=fr)
+        from h2o3_tpu import serving
+        rows = np.column_stack([rng.normal(size=8),
+                                rng.normal(size=8)]).tolist()
+        chaos.install("point=microbatch.dispatch,action=fail,times=1")
+        before = MB.EPOCH_RETRIES.value(op="microbatch")
+        preds = serving.score_payload(m, rows, ["a", "b"])
+        assert len(preds) == 8
+        assert MB.EPOCH_RETRIES.value(op="microbatch") == before + 1
+    finally:
+        chaos.reset()
+        DKV.remove(fr.key)
+        if getattr(m, "key", None):
+            DKV.remove(m.key)
+
+
+def test_mrtask_dispatch_retries_over_epoch_change(cloud_env):
+    from h2o3_tpu.parallel import mrtask
+    import jax.numpy as jnp
+    MB.MEMBERSHIP.register(1)            # multi-host fast-path gate on
+    x = mrtask.device_put_rows(np.arange(64, dtype=np.float32))
+    chaos.install("point=mrtask.dispatch,action=fail,times=1")
+    before = MB.EPOCH_RETRIES.value(op="mrtask")
+    out = mrtask.map_reduce(lambda a: jnp.sum(a), x)
+    assert float(out) == float(np.arange(64).sum())
+    assert MB.EPOCH_RETRIES.value(op="mrtask") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# elastic broadcaster: excision / join / drain / heartbeat
+def test_excision_on_ack_timeout_resumes_over_survivors(cloud_env):
+    port = _free_port()
+    bc, (w1, w2) = _start_elastic(2, port)
+    try:
+        bc.broadcast("POST", "/x", {"i": "1"})
+        assert MB.MEMBERSHIP.epoch == 1
+        w1.muted = True                   # wedged: receives, never acks
+        before = MB.EXCISIONS.value(reason="ack_timeout")
+        t0 = time.monotonic()
+        bc.broadcast("POST", "/x", {"i": "2"})   # must NOT raise
+        blip = time.monotonic() - t0
+        # bounded detection: one ack deadline (1s), not a wedged cloud
+        assert blip < 5.0
+        assert MB.MEMBERSHIP.epoch == 2
+        assert MB.MEMBERSHIP.state(1) == MB.DEAD
+        assert MB.EXCISIONS.value(reason="ack_timeout") == before + 1
+        # replay resumes over the surviving set
+        bc.broadcast("POST", "/x", {"i": "3"})
+        assert [m["params"]["i"] for m in w2.frames] == ["1", "2", "3"]
+        # collects skip the excised slot without raising
+        res = bc.collect("ping", timeout=1.0)
+        assert any(isinstance(r, dict) and r.get("host") == 2
+                   for r in res)
+    finally:
+        bc.close()
+
+
+def test_excision_on_severed_socket_via_chaos(cloud_env):
+    port = _free_port()
+    bc, (w1, w2) = _start_elastic(2, port)
+    try:
+        chaos.install("point=replay.send,worker=1,action=sever")
+        before = chaos.INJECTIONS.value(point="replay.send",
+                                        action="sever")
+        bc.broadcast("POST", "/x", {"i": "1"})   # survives the cut
+        assert chaos.INJECTIONS.value(point="replay.send",
+                                      action="sever") == before + 1
+        assert MB.MEMBERSHIP.state(1) == MB.DEAD
+        assert MB.MEMBERSHIP.epoch == 2
+        assert [m["params"]["i"] for m in w2.frames] == ["1"]
+    finally:
+        bc.close()
+
+
+def test_join_syncs_epoch_and_snapshot(cloud_env):
+    port = _free_port()
+    bc, (w1,) = _start_elastic(1, port)
+    try:
+        bc.broadcast("POST", "/3/Parse", {"f": "train.csv"})
+        bc.broadcast("GET", "/3/Cloud", {})      # GETs stay out of the log
+        bc.broadcast("POST", "/3/ModelBuilders/gbm", {"id": "m1"})
+        w3 = FakeWorker(port, 3, join=True)
+        # welcome carries the bumped epoch, next seq and the MUTATING
+        # request log (the replayed-state snapshot)
+        assert w3.welcome["epoch"] == 2 == MB.MEMBERSHIP.epoch
+        assert w3.welcome["snapshot_truncated"] is False
+        snap = [(r["method"], r["path"]) for r in w3.welcome["snapshot"]]
+        assert snap == [("POST", "/3/Parse"),
+                        ("POST", "/3/ModelBuilders/gbm")]
+        assert MB.MEMBERSHIP.state(3) == MB.ACTIVE
+        # the joiner is IN the broadcast set now
+        bc.broadcast("POST", "/x", {"i": "after-join"})
+        deadline = time.monotonic() + 5
+        while not w3.frames and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert [m["params"]["i"] for m in w3.frames] == ["after-join"]
+        assert w3.frames[0]["seq"] == w3.welcome["seq"]
+        assert w3.frames[0]["epoch"] == 2
+        # ...and answers collects
+        res = bc.collect("ping", timeout=1.0)
+        assert {r.get("host") for r in res if isinstance(r, dict)} \
+            >= {1, 3}
+    finally:
+        bc.close()
+
+
+def test_heartbeat_excises_idle_dead_worker(cloud_env, monkeypatch):
+    monkeypatch.setenv("H2O3_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("H2O3_HEARTBEAT_MISSES", "2")
+    port = _free_port()
+    bc, (w1, w2) = _start_elastic(2, port)
+    try:
+        w1.muted = True                   # alive socket, silent worker
+        deadline = time.monotonic() + 10
+        while MB.MEMBERSHIP.state(1) != MB.DEAD \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert MB.MEMBERSHIP.state(1) == MB.DEAD
+        assert MB.EXCISIONS.value(reason="heartbeat") >= 1
+        assert MB.MEMBERSHIP.state(2) == MB.ACTIVE
+    finally:
+        bc.close()
+
+
+# ---------------------------------------------------------------------------
+# REST surface: /3/Cloud epoch + drain; the zero-failed-request win
+def _rest(srv):
+    return f"http://127.0.0.1:{srv.port}"
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def elastic_server(cloud_env):
+    from h2o3_tpu.api.server import H2OServer
+    port = _free_port()
+    bc, workers = _start_elastic(2, port)
+    srv = H2OServer(port=0).start()
+    srv.httpd.broadcaster = bc
+    yield srv, bc, workers
+    srv.stop()
+    bc.close()
+
+
+def test_cloud_schema_shows_epoch_and_workers(elastic_server):
+    srv, bc, (w1, w2) = elastic_server
+    c = _get_json(_rest(srv) + "/3/Cloud")
+    assert c["epoch"] == 1 and c["locked"] is False
+    assert {w["pid"]: w["state"] for w in c["workers"]} == \
+        {1: "active", 2: "active"}
+    assert c["rehome"]["nodes"] == [0, 1, 2]
+    w1.muted = True
+    bc.broadcast("POST", "/x", {})       # excises w1 (1s ack deadline)
+    c = _get_json(_rest(srv) + "/3/Cloud")
+    assert c["epoch"] == 2
+    states = {w["pid"]: w["state"] for w in c["workers"]}
+    assert states[1] == "dead" and states[2] == "active"
+    assert c["cloud_healthy"] is False
+    # the epoch gauge is on /metrics
+    with urllib.request.urlopen(_rest(srv) + "/metrics",
+                                timeout=30) as r:
+        text = r.read().decode()
+    assert "h2o3_cloud_epoch 2" in text
+    # health RECOVERS once a replacement join moves the epoch past the
+    # death — a replaced cloud is not permanently "unhealthy"
+    FakeWorker(bc._srv.getsockname()[1], 5, join=True)
+    c = _get_json(_rest(srv) + "/3/Cloud")
+    assert c["epoch"] == 3 and c["cloud_healthy"] is True
+    # and the handler is replay-safe: a worker-side _ReplayHandler has
+    # no HTTP server object, yet GET /3/Cloud (which IS broadcast) must
+    # replay without error
+    out = MH.replay_request("GET", "/3/Cloud", {})
+    assert isinstance(out, dict) and "error" not in out, out
+
+
+def test_drain_finishes_inflight_and_leaves_cleanly(elastic_server,
+                                                    monkeypatch):
+    monkeypatch.setenv("H2O3_DRAIN_TIMEOUT_S", "5")
+    srv, bc, (w1, w2) = elastic_server
+    before = MB.EXCISIONS.value(reason="drain")
+    body = urllib.parse.urlencode({"node": "1"}).encode()
+    req = urllib.request.Request(_rest(srv) + "/3/Cloud/drain",
+                                 data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["node"] == 1 and out["quiesced"] is True
+    assert out["left_cleanly"] is True
+    assert out["epoch"] == 2
+    assert MB.MEMBERSHIP.state(1) == MB.LEFT
+    assert MB.EXCISIONS.value(reason="drain") == before + 1
+    # the worker saw the leave op and exited its loop
+    assert w1.frames[-1]["op"] == "leave"
+    # draining an unknown node → 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(urllib.request.Request(
+            _rest(srv) + "/3/Cloud/drain",
+            data=urllib.parse.urlencode({"node": "9"}).encode(),
+            method="POST"), timeout=30)
+    assert ei.value.code == 404
+
+
+
+def test_kill_and_replace_worker_zero_failed_requests(elastic_server):
+    """The ROADMAP win condition, fake-worker edition: kill a worker
+    mid-scoring-load → excised within the ack deadline, epoch bumps,
+    ZERO failed client requests, latency blip bounded; a replacement
+    joins (epoch + snapshot sync) and serves collects."""
+    srv, bc, (w1, w2) = elastic_server
+    from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator
+    rng = np.random.default_rng(5)
+    fr = Frame.from_dict({"a": rng.normal(size=128),
+                          "b": rng.normal(size=128),
+                          "y": rng.normal(size=128)})
+    m = H2OGeneralizedLinearEstimator(family="gaussian",
+                                      model_id="memb_km")
+    m.train(x=["a", "b"], y="y", training_frame=fr)
+    try:
+        client = H2OClient(_rest(srv), retry_connect=True, timeout=60)
+        rows = np.column_stack([rng.normal(size=4),
+                                rng.normal(size=4)]).tolist()
+        failures: list = []
+        latencies: list = []
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    out = client.post("/3/Predictions/models/memb_km",
+                                      rows=rows, columns=["a", "b"])
+                    assert out["row_count"] == 4
+                except Exception as ex:   # noqa: BLE001 — the assertion
+                    failures.append(repr(ex))
+                    return
+                latencies.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)                   # load flowing
+        n_before_kill = len(latencies)
+        w1.kill()                         # the lost pod
+        # keep scoring through the excision window
+        deadline = time.monotonic() + 10
+        while MB.MEMBERSHIP.state(1) != MB.DEAD \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert MB.MEMBERSHIP.state(1) == MB.DEAD
+        time.sleep(0.5)                   # load continues on survivors
+        # replacement joins mid-load and serves
+        w3 = FakeWorker(bc._srv.getsockname()[1], 3, join=True)
+        assert w3.welcome["epoch"] == MB.MEMBERSHIP.epoch
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert failures == [], failures
+        assert len(latencies) > n_before_kill, \
+            "no requests completed after the kill"
+        # bounded latency blip: worst request ≤ ack deadline (1s) plus
+        # dispatch slack — nowhere near a wedged-cloud timeout
+        assert max(latencies) < 8.0
+        # epoch bumped for the excision AND the join; cloud view agrees
+        c = _get_json(_rest(srv) + "/3/Cloud")
+        assert c["epoch"] >= 3
+        states = {w["pid"]: w["state"] for w in c["workers"]}
+        assert states[1] == "dead" and states[3] == "active"
+        # the replacement answers collects (it SERVES)
+        res = bc.collect("ping", timeout=2.0)
+        assert any(isinstance(r, dict) and r.get("host") == 3
+                   for r in res)
+        # scrapes still merge over the survivors without raising
+        with urllib.request.urlopen(_rest(srv) + "/metrics",
+                                    timeout=30) as r:
+            assert b"h2o3_cloud_excisions_total" in r.read()
+    finally:
+        DKV.remove(fr.key)
+        DKV.remove("memb_km")
+
+
+# ---------------------------------------------------------------------------
+# worker-side reconnect (the orphaned-worker satellite)
+class FakeCoordinator:
+    """Accepts worker connections and speaks the coordinator half of the
+    handshake; can drop the connection to exercise the reconnect path."""
+
+    def __init__(self):
+        self.secret = os.environ["H2O3_CLUSTER_SECRET"].encode()
+        self.srv = socket.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.srv.settimeout(10.0)
+        self.port = self.srv.getsockname()[1]
+        self.hellos: list = []
+
+    def accept_worker(self, welcome_extra=None):
+        conn, _ = self.srv.accept()
+        hello, key = MH._challenge_peer(conn, self.secret)
+        self.hellos.append(hello)
+        MH._send_frame(conn, key,
+                       dict({"welcome": hello["hello"]},
+                            **(welcome_extra or {})))
+        conn.settimeout(None)
+        return conn, key
+
+    def close(self):
+        self.srv.close()
+
+
+def test_worker_reconnects_after_coordinator_drop(cloud_env,
+                                                  monkeypatch):
+    monkeypatch.setenv("H2O3_REPLAY_RECONNECT_S", "10")
+    coord = FakeCoordinator()
+    done = {}
+
+    def run_worker():
+        try:
+            MH.worker_loop("127.0.0.1", coord.port, pid=7)
+            done["ok"] = True
+        except Exception as ex:   # noqa: BLE001 — recorded for the assert
+            done["err"] = repr(ex)
+
+    t = threading.Thread(target=run_worker, daemon=True)
+    t.start()
+    conn, key = coord.accept_worker()
+    conn.close()                          # transient coordinator restart
+    # the worker re-handshakes as a JOIN within the reconnect window
+    conn2, key2 = coord.accept_worker(
+        welcome_extra={"epoch": 5, "seq": 9, "snapshot": []})
+    assert coord.hellos[-1].get("join") == 1
+    # epoch adopted from the welcome; seq continuity honored
+    deadline = time.monotonic() + 5
+    while MB.MEMBERSHIP.epoch < 5 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert MB.MEMBERSHIP.epoch == 5
+    MH._send_frame(conn2, key2, {"seq": 9, "op": "leave"})
+    ack = MH._recv_frame(conn2, key2)
+    assert ack == {"ack": 9}
+    t.join(timeout=10)
+    assert done.get("ok") is True, done
+    coord.close()
+
+
+def test_worker_gives_up_after_reconnect_window(cloud_env, monkeypatch):
+    monkeypatch.setenv("H2O3_REPLAY_RECONNECT_S", "1.5")
+    coord = FakeCoordinator()
+    done = {}
+
+    def run_worker():
+        try:
+            MH.worker_loop("127.0.0.1", coord.port, pid=8)
+            done["ok"] = True
+        except RuntimeError as ex:
+            done["err"] = str(ex)
+
+    t = threading.Thread(target=run_worker, daemon=True)
+    t.start()
+    conn, _ = coord.accept_worker()
+    conn.close()
+    coord.close()                         # coordinator gone for good
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert "H2O3_REPLAY_RECONNECT_S" in done.get("err", ""), done
+
+
+# ---------------------------------------------------------------------------
+# client retry policy (clients/py/h2o3_client)
+class _FlakyHandler:
+    pass
+
+
+def _serve_script(script, port_holder):
+    """Tiny HTTP server answering scripted (status, headers, body)."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def _respond(self):
+            status, headers, body = script.pop(0) if script \
+                else (200, {}, b"{}")
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = do_POST = _respond
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    port_holder.append(httpd.server_address[1])
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_client_retries_503_with_retry_after():
+    import random as _random
+    script = [(503, {"Retry-After": "0"}, b"busy"),
+              (503, {"Retry-After": "0"}, b"busy"),
+              (200, {}, json.dumps({"ok": True}).encode())]
+    ports: list = []
+    httpd = _serve_script(script, ports)
+    try:
+        c = H2OClient(f"http://127.0.0.1:{ports[0]}",
+                      backoff_base=0.01, backoff_cap=0.05,
+                      rng=_random.Random(1))
+        out = c.get("/3/Cloud")
+        assert out == {"ok": True}
+        assert c.retries_performed == 2
+    finally:
+        httpd.shutdown()
+
+
+def test_client_does_not_retry_real_errors_and_caps_budget():
+    import random as _random
+    ports: list = []
+    httpd = _serve_script([(404, {}, b"nope")], ports)
+    try:
+        c = H2OClient(f"http://127.0.0.1:{ports[0]}")
+        with pytest.raises(urllib.error.HTTPError):
+            c.get("/3/Missing")
+    finally:
+        httpd.shutdown()
+    # budget exhaustion on endless 503s → H2ORetryError, not a hang
+    ports2: list = []
+    script = [(503, {"Retry-After": "0"}, b"busy")] * 10
+    httpd2 = _serve_script(script, ports2)
+    try:
+        c = H2OClient(f"http://127.0.0.1:{ports2[0]}", max_retries=2,
+                      backoff_base=0.01, backoff_cap=0.02,
+                      rng=_random.Random(2))
+        with pytest.raises(H2ORetryError):
+            c.get("/3/Cloud")
+        assert c.retries_performed == 2
+    finally:
+        httpd2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# review-round regressions: drain seq hole, wedged-worker cascade,
+# truncated-snapshot visibility
+def test_drain_leaves_no_seq_hole_for_survivors(cloud_env, monkeypatch):
+    """The leave frame goes to ONE worker; it must be out-of-band (no
+    shared seq consumed) or every SURVIVOR dies at its next continuity
+    check."""
+    monkeypatch.setenv("H2O3_DRAIN_TIMEOUT_S", "5")
+    port = _free_port()
+    bc, (w1, w2, w3) = _start_elastic(3, port)
+    try:
+        bc.broadcast("POST", "/x", {"i": "1"})
+        out = bc.drain(2)
+        assert out["left_cleanly"] is True
+        # replay RESUMES over the survivors with gapless sequences
+        bc.broadcast("POST", "/x", {"i": "2"})
+        res = bc.collect("ping", timeout=1.0)
+        assert w1.seq_errors == [] and w3.seq_errors == []
+        assert MB.MEMBERSHIP.state(1) == MB.ACTIVE
+        assert MB.MEMBERSHIP.state(3) == MB.ACTIVE
+        assert [m["params"]["i"] for m in w1.frames
+                if "params" in m] == ["1", "2"]
+        assert {r.get("host") for r in res if isinstance(r, dict)} \
+            == {1, 3}
+    finally:
+        bc.close()
+
+
+def test_wedged_worker_does_not_cascade_excisions(cloud_env):
+    """A worker owing an ack from a timed-out collect consumes the
+    shared broadcast deadline in the send phase; the healthy peer behind
+    it must ride the grace floor, not get excised unsent."""
+    port = _free_port()
+    bc, (w1, w2) = _start_elastic(2, port)
+    try:
+        w1.muted = True
+        res = bc.collect("ping", timeout=0.3)    # w1 now owes an ack
+        assert res[0] is None
+        bc.broadcast("POST", "/x", {"i": "1"})   # w1 excised, w2 SURVIVES
+        assert MB.MEMBERSHIP.state(1) == MB.DEAD
+        assert MB.MEMBERSHIP.state(2) == MB.ACTIVE
+        assert w2.seq_errors == []
+        assert [m["params"]["i"] for m in w2.frames
+                if "params" in m] == ["1"]
+    finally:
+        bc.close()
+
+
+def test_truncated_snapshot_marks_joiner_unsynced(cloud_env, monkeypatch):
+    monkeypatch.setenv("H2O3_REPLAY_LOG_MAX", "2")
+    port = _free_port()
+    bc, (w1,) = _start_elastic(1, port)
+    try:
+        for i in range(4):
+            bc.broadcast("POST", f"/x{i}", {})
+        w3 = FakeWorker(port, 3, join=True)
+        assert w3.welcome["snapshot_truncated"] is True
+        assert len(w3.welcome["snapshot"]) == 2
+        # the coordinator commits the join AFTER the welcome lands (a
+        # failed send must not create a ghost member) — poll briefly
+        deadline = time.monotonic() + 5
+        nodes = {}
+        while 3 not in nodes and time.monotonic() < deadline:
+            nodes = {n["pid"]: n for n in MB.MEMBERSHIP.nodes()}
+            time.sleep(0.02)
+        assert nodes[3].get("synced") is False
+        # a SYNCED joiner is not marked
+        w4 = FakeWorker(port, 4, join=True)
+        # the log only holds the latest 2, but w4 joined with the same
+        # truncation state — both carry the flag until the log bound is
+        # raised; assert the flag is exactly what the welcome said
+        deadline = time.monotonic() + 5
+        nodes = {}
+        while 4 not in nodes and time.monotonic() < deadline:
+            nodes = {n["pid"]: n for n in MB.MEMBERSHIP.nodes()}
+            time.sleep(0.02)
+        assert nodes[4].get("synced") == \
+            (not w4.welcome["snapshot_truncated"])
+    finally:
+        bc.close()

@@ -256,3 +256,154 @@ def test_two_process_cloud_matches_single(tmp_path):
     # the 2-process run shards rows and merges histograms with a psum;
     # float-sum reassociation allows tiny drift, not different trees
     np.testing.assert_allclose(pred_multi, pred_single, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_kill_and_replace_worker_mid_scoring_load(tmp_path):
+    """The ROADMAP win condition in the REAL 2-process cloud: kill the
+    worker process mid-scoring-load; the elastic membership layer excises
+    it within the ack deadline (epoch bump visible in /3/Cloud), every
+    client request succeeds (zero failures, bounded latency blip in
+    h2o3_rest_request_seconds), and a replacement process joins the
+    replay channel (epoch + snapshot sync) and serves.
+
+    Skip-guarded: 2-process jax CPU clouds are blocked in this container
+    by the known jax-CPU multiprocess limitation — the fake-worker
+    membership suite (tests/test_membership.py) is the always-on gate
+    for the same state machine."""
+    import threading
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "clients",
+                                    "py"))
+    from h2o3_client import H2OClient
+    csv = str(tmp_path / "mp.csv")
+    _write_csv(csv)
+    coord = _free_port()
+    rest = _free_port()
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env["H2O3_CLUSTER_SECRET"] = "multiproc-test-secret"
+    env["H2O3_TPU_ICE_ROOT"] = str(tmp_path / "ice")
+    env["XLA_FLAGS"] = ""
+    env["H2O3_REPLAY_ACK_TIMEOUT_S"] = "5"    # bounded detection window
+    env["H2O3_HEARTBEAT_S"] = "1"
+    env["H2O3_REPLAY_RECONNECT_S"] = "0"      # the kill must NOT re-join
+    procs = []
+    logs = []
+    try:
+        for pid in range(2):
+            lf = open(str(tmp_path / f"proc{pid}.log"), "w")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(HERE, "multiproc_runner.py"),
+                 str(pid), "2", str(coord), str(rest)],
+                stdout=lf, stderr=subprocess.STDOUT, env=env))
+        t0 = time.time()
+        up = False
+        while time.time() - t0 < 180:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                if _get(rest, "/3/Cloud").get("cloud_size", 0) >= 1:
+                    up = True
+                    break
+            except Exception:
+                time.sleep(0.5)
+        if not up:
+            pytest.skip("2-process jax CPU cloud failed to form — the "
+                        "container's known jax-CPU multiprocess "
+                        "limitation (fake-worker membership suite is "
+                        "the always-on gate)")
+
+        cloud = _get(rest, "/3/Cloud")
+        assert cloud["epoch"] == 1 and cloud["locked"] is False
+
+        # train the model the load will score; the known jax-CPU
+        # limitation surfaces HERE in this container (device collectives
+        # of the 2-proc mesh), not at formation — same skip guard
+        try:
+            r = _post(rest, "/3/Parse", source_frames=csv,
+                      destination_frame="mp_train")
+            _wait_job(rest, r["job"]["key"])
+            r = _post(rest, "/3/ModelBuilders/gbm",
+                      training_frame="mp_train", response_column="y",
+                      ntrees="3", max_depth="3", seed="1",
+                      model_id="mp_gbm")
+            _wait_job(rest, r["job"]["key"])
+        except AssertionError as ex:
+            pytest.skip("2-process pipeline blocked by the container's "
+                        f"known jax-CPU multiprocess limitation: {ex}")
+
+        client = H2OClient(f"http://127.0.0.1:{rest}", timeout=120,
+                           retry_connect=True)
+        rows = [[0.1, -0.2, 0.3], [1.0, 0.5, -0.5]]
+        failures, latencies = [], []
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    out = client.post("/3/Predictions/models/mp_gbm",
+                                      rows=rows,
+                                      columns=["x0", "x1", "x2"])
+                    assert out["row_count"] == 2
+                except Exception as ex:   # noqa: BLE001
+                    failures.append(repr(ex))
+                    return
+                latencies.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        procs[1].kill()                   # the lost pod
+        # excision within the detection deadline, visible in /3/Cloud
+        t0 = time.time()
+        epoch = 1
+        while time.time() - t0 < 30:
+            c = _get(rest, "/3/Cloud")
+            epoch = c["epoch"]
+            if epoch >= 2:
+                break
+            time.sleep(0.5)
+        assert epoch >= 2, "worker kill never excised"
+        time.sleep(1.0)                   # load continues on survivors
+
+        # replacement joins the replay channel and serves
+        lf = open(str(tmp_path / "proc_join.log"), "w")
+        logs.append(lf)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multiproc_runner.py"),
+             "3", "2", str(coord), str(rest), "join"],
+            stdout=lf, stderr=subprocess.STDOUT, env=env))
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            c = _get(rest, "/3/Cloud")
+            states = {w["pid"]: w["state"] for w in c.get("workers", [])}
+            if states.get(3) == "active":
+                break
+            time.sleep(0.5)
+        assert states.get(3) == "active", states
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        # ZERO failed requests end-to-end, bounded latency blip
+        assert failures == [], failures
+        assert latencies and max(latencies) < 15.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest}/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "h2o3_rest_request_seconds" in text
+        assert "h2o3_cloud_excisions_total" in text
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for lf in logs:
+            lf.close()

@@ -141,7 +141,9 @@ def test_span_context_manager_records_attrs():
         with span("t.inner") as sp:
             assert SPANS.current() is sp
     snap = SPANS.snapshot()
-    assert len(snap) == before + 2
+    # the ring is bounded: late in a long suite it may already be at
+    # capacity, where appends evict instead of growing
+    assert len(snap) == min(before + 2, SPANS.capacity)
     inner, outer = snap[-2], snap[-1]
     assert inner["name"] == "t.inner" and outer["name"] == "t.outer"
     assert inner["parent"] == outer["id"]

@@ -140,6 +140,11 @@ def test_multihost_request_replay(cloud8, monkeypatch):
 
     # the replay channel authenticates with the cluster secret now
     monkeypatch.setenv("H2O3_CLUSTER_SECRET", "test-secret")
+    # no reconnect window: when this test's coordinator goes away the
+    # daemon worker thread must exit, not spin re-joining for 60s of
+    # WARN noise across later tests (elastic reconnection has its own
+    # suite in test_membership.py)
+    monkeypatch.setenv("H2O3_REPLAY_RECONNECT_S", "0")
 
     hits = {"n": 0}
 
