@@ -15,6 +15,14 @@ classes this repo actually shipped:
   R005 metric-name drift h2o3_* metric declared twice / non-literal name /
                          inconsistent label sets (census: obs/METRICS.md)
   R006 route drift       REST route capture groups vs handler signatures
+  R011 span-name drift   timeline span names vs the obs/SPANS.md census
+  R012 logging drift     print()/bare logging in package code → the
+                         structured utils/log logger
+  R013 socket deadlines  timeout-less recv/connect/accept waits
+  R014 unguarded pjit    raw jax.jit/pjit dispatch in serving/ or
+                         parallel/ not routed through
+                         compat.guarded_jit/guard_collective (the
+                         XLA:CPU collective-rendezvous hang class)
 
 Interprocedural concurrency rules (callgraph.py: project-wide call graph
 + lock-acquisition graph):
@@ -44,4 +52,5 @@ from h2o3_tpu.analysis.sanitizers import (   # noqa: F401
     debug_nans, install_from_env, transfer_guard)
 
 ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006",
-             "R007", "R008", "R009", "R010")
+             "R007", "R008", "R009", "R010", "R011", "R012", "R013",
+             "R014")

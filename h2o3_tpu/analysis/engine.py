@@ -161,11 +161,11 @@ def analyze_modules(mods: list, rules=None) -> list:
     """Run every rule over the parsed modules; returns findings with
     inline suppressions already applied (but baseline NOT applied)."""
     from h2o3_tpu.analysis import callgraph, rules_jax, rules_locks, \
-        rules_logging, rules_metrics, rules_routes, rules_sockets, \
-        rules_spans
+        rules_logging, rules_metrics, rules_pjit, rules_routes, \
+        rules_sockets, rules_spans
     findings: list = []
     per_file = [rules_jax.check, rules_locks.check, rules_logging.check,
-                rules_sockets.check]
+                rules_sockets.check, rules_pjit.check]
     project = [rules_metrics.check, rules_routes.check, rules_spans.check,
                callgraph.check]
     if rules:

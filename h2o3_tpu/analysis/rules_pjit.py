@@ -1,0 +1,134 @@
+"""R014 — unguarded pjit/jit dispatch in the serving/parallel layers.
+
+The bug class (ISSUE 10, re-opened risk with the mesh-sharded scorer):
+XLA's CPU client shares ONE collective thread pool across concurrently
+launched programs — two in-flight multi-replica executions park subsets
+of their participants at the rendezvous and starve each other forever.
+`parallel/compat.py` owns the fix: every device dispatch on a host mesh
+must ride `guarded_jit` / `guard_collective` (or the `run_host_serialized`
+funnel), which serializes launch→ready windows. A raw `jax.jit` or
+`pjit` dispatch site in the serving or parallel layers silently re-opens
+the hang — the scorer-cache programs now contain collectives (sharded
+param args), so the stakes went up with this rebuild.
+
+R014 flags, in files under `h2o3_tpu/serving/` and `h2o3_tpu/parallel/`
+only (other layers route through these funnels or own their guards):
+  * `jax.jit(...)` / `jit(...)` / `pjit(...)` /
+    `jax.experimental.pjit.pjit(...)` calls that are NOT the direct
+    argument of `guard_collective(...)` (any attribute path);
+  * `@jax.jit`-style decorators without a `guard_collective` decorator
+    above them on the same function.
+
+`compat.py` itself is exempt — it is the module that DEFINES the guard
+(its inner `jax.jit` calls are the guarded implementation). Waive true
+host-side-only jits with `# h2o3-ok: R014 reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from h2o3_tpu.analysis.engine import Finding, Module
+
+RULES = {"R014"}
+
+_SCOPED_PREFIXES = ("h2o3_tpu/serving/", "h2o3_tpu/parallel/")
+_EXEMPT = ("h2o3_tpu/parallel/compat.py",)
+_GUARDS = {"guard_collective", "guarded_jit"}
+
+
+def _dotted(node) -> str:
+    """'jax.experimental.pjit.pjit' for an attribute chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_name(name: str) -> bool:
+    return name in ("jit", "pjit") or name.endswith(".jit") \
+        or name.endswith(".pjit")
+
+
+def _is_jit_maker(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    if _is_jit_name(name):
+        return True
+    # functools.partial(jax.jit, static_argnames=...) — the repo's
+    # dominant static-args spelling: the jit is an ARGUMENT, not the
+    # callee, but the partial IS the jit-maker being dispatched
+    if name.split(".")[-1] == "partial":
+        return any(_is_jit_name(_dotted(a)) for a in call.args)
+    return False
+
+
+def _is_guard(call_or_deco) -> bool:
+    name = _dotted(call_or_deco.func if isinstance(call_or_deco, ast.Call)
+                   else call_or_deco)
+    return name.split(".")[-1] in _GUARDS
+
+
+def check(mod: Module) -> list:
+    rel = mod.rel.replace("\\", "/")
+    if not rel.startswith(_SCOPED_PREFIXES) or rel in _EXEMPT:
+        return []
+    findings = []
+    layer = rel.split("/")[1]
+    # parent map: a jit call is fine when its direct consumer is a
+    # guard_collective(...) call
+    parents: dict = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    deco_nodes: set = set()       # decorators judged by the deco branch
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            decos = list(node.decorator_list)
+            deco_nodes.update(id(d) for d in decos)
+            guarded = any(_is_guard(g) for g in decos)
+            for d in decos:
+                if isinstance(d, ast.Call):
+                    is_jit = _is_jit_maker(d)
+                    name = _dotted(d.func)
+                else:
+                    name = _dotted(d)
+                    is_jit = _is_jit_name(name)
+                if is_jit and not guarded:
+                    findings.append(Finding(
+                        "R014", mod.rel, d.lineno,
+                        f"@{name} dispatch in {layer}/ not routed "
+                        "through compat.guard_collective — an unguarded "
+                        "collective launch on a host mesh re-opens the "
+                        "XLA:CPU rendezvous hang; stack "
+                        "@compat.guard_collective above it or use "
+                        "compat.guarded_jit"))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not _is_jit_maker(node) \
+                or id(node) in deco_nodes:
+            continue
+        site = node
+        parent = parents.get(site)
+        # partial(jax.jit, ...)(fn): the guard may wrap the INVOCATION
+        # of the partial — hop to it before the guard check
+        if isinstance(parent, ast.Call) and parent.func is site:
+            site = parent
+            parent = parents.get(site)
+        if isinstance(parent, ast.Call) and _is_guard(parent) \
+                and site in parent.args:
+            continue        # guard_collective(jax.jit(...)) — the funnel
+        name = _dotted(node.func)
+        findings.append(Finding(
+            "R014", mod.rel, node.lineno,
+            f"raw {name}(...) dispatch in {layer}/ not routed through "
+            "compat.guarded_jit/guard_collective — an unguarded "
+            "collective launch on a host mesh re-opens the XLA:CPU "
+            "rendezvous hang (ISSUE 10); wrap the jit in "
+            "compat.guard_collective or use compat.guarded_jit"))
+    return findings
+
+
+check.RULES = RULES

@@ -209,6 +209,9 @@ class Membership:
                     for p, w in sorted(self._workers.items())]
 
     def _notify(self, epoch: int, alive: list):
+        # built-in first: the mesh rebuild is part of the epoch contract
+        # (not a removable listener — reset() must not detach it)
+        _mesh_epoch_listener(epoch, alive)
         with self._lock:
             listeners = list(self._listeners)
         for fn in listeners:
@@ -220,6 +223,22 @@ class Membership:
 
 
 MEMBERSHIP = Membership()
+
+
+def _mesh_epoch_listener(epoch: int, alive: list):
+    """Every membership change rebuilds the host mesh for the new epoch
+    (parallel.mesh.note_epoch): the jax device runtime is fixed-size, so
+    the mesh keeps its shape, but the fresh Mesh object makes placement
+    caches (the serving param store) re-place instead of dispatching
+    against arrays laid out for a dead membership."""
+    del alive
+    try:
+        from h2o3_tpu.parallel import mesh as _pmesh
+        _pmesh.note_epoch(epoch)
+    except Exception:   # noqa: BLE001 — a mesh rebuild failure must not
+        from h2o3_tpu.utils import log as _ulog   # kill the channel
+        _ulog.err("mesh rebuild for epoch %s failed", epoch)
+
 
 # module-level gauges reading the module global (the microbatch pattern:
 # bound to whatever MEMBERSHIP currently is, resilient to reset())

@@ -738,6 +738,18 @@ def _replay_session(sock, key, welcome) -> str:
             from h2o3_tpu.utils import log as _ulog
             _ulog.warn("join-sync replay %s %s failed: %r",
                        req.get("method"), req.get("path"), ex)
+    if welcome.get("snapshot") is not None:
+        # replacement-worker warm start (H2O3_SCORER_PREWARM=1): the
+        # joiner just converged on the survivors' model state — place
+        # each model's shared sharded params and compile the smallest
+        # row bucket NOW, in the background, so its first live request
+        # warm-hits instead of paying placement + XLA compile
+        from h2o3_tpu import serving as _serving
+        if _serving.prewarm_enabled():
+            n = _serving.prewarm_all()
+            if n:
+                from h2o3_tpu.utils import log as _ulog
+                _ulog.info("join-sync: pre-warming %d model scorers", n)
     expect = int(welcome.get("seq", 1))
     while True:
         try:

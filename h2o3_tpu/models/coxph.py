@@ -33,6 +33,8 @@ from h2o3_tpu.parallel import compat as _compat
 
 class H2OCoxProportionalHazardsEstimator(ModelBase):
     algo = "coxph"
+    # mesh-sharded serving: hazard coefficients as one shared device copy
+    _serving_param_attrs = ("_beta",)
     _defaults = {
         "stop_column": None, "start_column": None, "ties": "efron",
         "stratify_by": None, "max_iterations": 20, "lre_min": 9.0,

@@ -42,6 +42,17 @@ def _activation(name: str):
 
 class H2ODeepLearningEstimator(ModelBase):
     algo = "deeplearning"
+    # mesh-sharded serving: the net's (W, b) list as shared device args.
+    # Weight matrices shard their OUT-FEATURE axis over the optional
+    # "model" mesh axis (tensor parallelism for wide layers; the
+    # contracting axis stays whole, so reduction order — and therefore
+    # every bit of the result — is unchanged); biases shard to match.
+    # On the default rows-only mesh both specs degenerate to replication.
+    _serving_param_attrs = ("_params_net",)
+    _partition_rules = (
+        (r"^_params_net/\d+/0$", jax.sharding.PartitionSpec(None, "model")),
+        (r"^_params_net/\d+/1$", jax.sharding.PartitionSpec("model")),
+    )
     _defaults = {
         "hidden": None, "epochs": 10.0, "activation": "Rectifier",
         "adaptive_rate": True, "rho": 0.99, "epsilon": 1e-8,

@@ -109,6 +109,14 @@ def _eif_walk(X, norms, points, dids, vals, D):
 class H2OExtendedIsolationForestEstimator(SharedTreeEstimator):
     algo = "extendedisolationforest"
     supervised = False
+    # mesh-sharded serving: the EIF hyperplane ensemble as shared device
+    # args (overrides the SharedTree `_trees` export — EIF scores through
+    # its own walk). Tree axis shards over the optional "model" mesh axis.
+    _serving_param_attrs = ("_norms", "_points", "_dids", "_vals")
+    _partition_rules = (
+        (r"^_(norms|points|dids|vals)$",
+         jax.sharding.PartitionSpec("model")),
+    )
     _defaults = dict(SharedTreeEstimator._tree_defaults)
     _defaults.update({"ntrees": 100, "sample_size": 256, "extension_level": 0})
 

@@ -309,8 +309,21 @@ class _GLMState:
     family: str
 
 
+# _GLMState is a pytree (beta is the leaf; link/family are static trace
+# structure) so the mesh-sharded serving fast path can pass a fitted
+# state as a shared device argument instead of a baked constant.
+jax.tree_util.register_pytree_node(
+    _GLMState,
+    lambda s: ((s.beta,), (s.link, s.family)),
+    lambda aux, ch: _GLMState(beta=ch[0], link=aux[0], family=aux[1]))
+
+
 class H2OGeneralizedLinearEstimator(ModelBase):
     algo = "glm"
+    # mesh-sharded serving: coefficients (and the ordinal thresholds)
+    # ride as one shared device copy; small enough to replicate (the
+    # default rule), shared across every row bucket.
+    _serving_param_attrs = ("_state", "_ord_beta", "_ord_thr")
     _defaults = {
         "family": "AUTO", "link": "family_default", "solver": "AUTO",
         "alpha": None, "lambda_": None, "lambda_search": False, "nlambdas": 30,

@@ -71,6 +71,8 @@ def _assign_only(X, C):
 class H2OKMeansEstimator(ModelBase):
     algo = "kmeans"
     supervised = False
+    # mesh-sharded serving: centroids as one shared device copy
+    _serving_param_attrs = ("_centroids",)
     _defaults = {
         "k": 1, "max_iterations": 10, "init": "Furthest", "estimate_k": False,
         "user_points": None, "standardize": True, "max_runtime_secs": 0.0,

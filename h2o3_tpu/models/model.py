@@ -492,6 +492,47 @@ class ModelBase:
         """Batch score0: return regression preds (n,) or class probs (n,K)."""
         raise NotImplementedError
 
+    # ---- mesh-sharded serving params -------------------------------------
+    # Families list the instance attributes whose (pytree-of-arrays)
+    # values should enter the serving scorer as SHARED DEVICE ARGUMENTS
+    # instead of baked closure constants: the serving param store places
+    # them once per model generation (NamedSharding over the cloud mesh,
+    # PartitionSpecs from the regex rules below) and every row-bucket
+    # program dispatches against that single HBM copy. Attributes that
+    # are missing or None are skipped (e.g. `_trees` vs `_trees_k`
+    # depending on the trained distribution). Anything the scorer
+    # CONCRETIZES at trace time (float(self._f0[c]), static index lists)
+    # must stay OUT of this tuple — it traces as a constant like before.
+    _serving_param_attrs: tuple = ()
+    # ((regex, PartitionSpec), ...) matched against '/'-joined leaf paths
+    # ("_trees/value", "_params_net/0/0", …) by mesh.match_partition_rules;
+    # first match wins, unmatched leaves and scalars replicate.
+    _partition_rules: tuple = ()
+
+    def _serving_params(self):
+        """Param pytree for the serving fast path, or None when this
+        family's scorer must close over its state (legacy baked build)."""
+        attrs = self._serving_param_attrs
+        if not attrs:
+            return None
+        p = {a: getattr(self, a, None) for a in attrs}
+        p = {a: v for a, v in p.items() if v is not None}
+        return p or None
+
+    def _score_with_params(self, params, X):
+        """_score_matrix with `params` (a `_serving_params()`-shaped
+        pytree, possibly of tracers) standing in for the exported
+        attributes. The default grafts the params onto a SHALLOW COPY of
+        the model and runs the family's own `_score_matrix` — the same
+        code path as legacy scoring, so fast-path and legacy predictions
+        are bit-identical by construction. The copy keeps concurrent
+        legacy scorers (reading concrete attrs off `self`) safe while a
+        build thread traces."""
+        clone = copy.copy(self)
+        for a, v in params.items():
+            setattr(clone, a, v)
+        return type(self)._score_matrix(clone, X)
+
     # ---- scoring / metrics ----------------------------------------------
     @property
     def _is_classifier(self) -> bool:

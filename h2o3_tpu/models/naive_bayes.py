@@ -21,10 +21,20 @@ from h2o3_tpu.parallel import compat as _compat
 
 class H2ONaiveBayesEstimator(ModelBase):
     algo = "naivebayes"
+    # mesh-sharded serving: the staged log-probability tables (not the
+    # raw counts — those are concretized into tables host-side) ride as
+    # shared device args. Staged lazily, so export forces the staging.
+    _serving_param_attrs = ("_score_tab",)
     _defaults = {
         "laplace": 0.0, "min_sdev": 0.001, "eps_sdev": 0.0,
         "min_prob": 0.001, "eps_prob": 0.0, "compute_metrics": True,
     }
+
+    def _serving_params(self):
+        if getattr(self, "_priors", None) is None:
+            return None
+        self._stage_score_tables()
+        return super()._serving_params()
 
     def _cat_mode(self):
         return "label"
@@ -35,6 +45,10 @@ class H2ONaiveBayesEstimator(ModelBase):
                         weights=self.params.get("weights_column"))
 
     def _fit(self, frame: Frame, job):
+        # a retrain on this instance must rebuild the staged scoring
+        # tables from the NEW fit — the cache would otherwise freeze the
+        # first fit's priors into every later prediction
+        self._score_tab = None
         di = self._dinfo
         X = di.matrix(frame)     # label-encoded cats, NaN NAs
         y = di.response(frame)
@@ -94,26 +108,60 @@ class H2ONaiveBayesEstimator(ModelBase):
         self._output.model_summary = {
             "nclasses": K, "priors": self._priors.tolist(), "laplace": lap}
 
-    def _score_matrix(self, X):
-        K = self.nclasses
-        logp = jnp.log(jnp.asarray(np.maximum(self._priors, 1e-300),
-                                   jnp.float32))[None, :]
-        parts = jnp.tile(logp, (X.shape[0], 1))
+    def _stage_score_tables(self):
+        """Host-staged scoring tables, cached on the instance: the same
+        numpy math the scorer used to run at trace time (f64 clip/log,
+        then f32 cast), hoisted OUT of the trace so the tables can ride
+        the mesh-sharded fast path as shared device arguments. The
+        serving clone swaps in a TRACED version of this dict; the `get`
+        below then returns tracers and the scorer stays pure jnp."""
+        tab = self.__dict__.get("_score_tab")
+        if tab is not None:
+            return tab
         min_prob = float(self.params.get("min_prob") or 1e-3)
+        sds = [np.asarray(s, np.float32) for s in self._num_sd]
+        # EVERY param-only transcendental (the log of priors, cat tables
+        # and the gaussian normalizer) is computed HERE, on the host:
+        # left in the trace, XLA would constant-fold it in the baked
+        # build but evaluate it with runtime kernels in the shared-param
+        # build — transcendentals are not correctly rounded, so the two
+        # programs could differ by an ULP. Staged tables make the baked,
+        # shared-param and eager paths read literally the same numbers.
+        tab = self._score_tab = {
+            "log_prior": np.log(np.maximum(self._priors, 1e-300)
+                                ).astype(np.float32),
+            "log_cat": [np.log(np.maximum(p, min_prob)).astype(np.float32)
+                        for p in self._cat_probs],
+            "mean": [np.asarray(m, np.float32) for m in self._num_mean],
+            "gauss_log": [np.float32(-0.5)
+                          * np.log(np.float32(2 * np.pi) * s * s)
+                          for s in sds],
+            # reciprocal staged too: a division by a CONSTANT variance
+            # invites XLA's multiply-by-reciprocal rewrite, which the
+            # shared-param build (runtime divisor) would not get — a
+            # pre-staged multiply keeps the two programs op-for-op equal
+            "inv_two_var": [np.float32(1.0) / (np.float32(2.0) * s * s)
+                            for s in sds],
+        }
+        return tab
+
+    def _score_matrix(self, X):
+        tab = self._stage_score_tables()
+        logp = jnp.asarray(tab["log_prior"])[None, :]
+        parts = jnp.tile(logp, (X.shape[0], 1))
         for t, j in enumerate(self._cat_idx):
-            tbl = jnp.asarray(np.log(np.maximum(self._cat_probs[t], min_prob)),
-                              jnp.float32)          # (K, card)
+            tbl = jnp.asarray(tab["log_cat"][t])     # (K, card)
             col = X[:, j]
             ok = ~jnp.isnan(col)
             code = jnp.where(ok, col, 0).astype(jnp.int32)
             contrib = tbl.T[code]                    # (n, K)
             parts = parts + jnp.where(ok[:, None], contrib, 0.0)
         for t, j in enumerate(self._num_idx):
-            m = jnp.asarray(self._num_mean[t], jnp.float32)[None, :]
-            sd = jnp.asarray(self._num_sd[t], jnp.float32)[None, :]
+            m = jnp.asarray(tab["mean"][t])[None, :]
+            inv2v = jnp.asarray(tab["inv_two_var"][t])[None, :]
+            glog = jnp.asarray(tab["gauss_log"][t])[None, :]
             col = X[:, j][:, None]
             ok = ~jnp.isnan(X[:, j])
-            ll = -0.5 * jnp.log(2 * jnp.pi * sd * sd) \
-                - (col - m) ** 2 / (2 * sd * sd)
+            ll = glog - (col - m) ** 2 * inv2v
             parts = parts + jnp.where(ok[:, None], ll, 0.0)
         return jax.nn.softmax(parts, axis=1)

@@ -47,6 +47,9 @@ def _project(Xz, R):
 class H2OPrincipalComponentAnalysisEstimator(ModelBase):
     algo = "pca"
     supervised = False
+    # mesh-sharded serving: rotation + normalization stats as shared
+    # device args (transform kind stays static trace structure)
+    _serving_param_attrs = ("_rotation", "_mean", "_sd")
     _defaults = {
         "k": 1, "transform": "NONE", "pca_method": "GramSVD",
         "use_all_factor_levels": False, "compute_metrics": True,

@@ -26,6 +26,9 @@ from h2o3_tpu.parallel import compat as _compat
 
 class H2OSupportVectorMachineEstimator(ModelBase):
     algo = "psvm"
+    # mesh-sharded serving: (beta, bias) as shared device args; the
+    # kernel feature map stays a closure (it may embed training points)
+    _serving_param_attrs = ("_params_svm",)
     _defaults = {
         "hyper_param": 1.0,            # C
         "kernel_type": "gaussian", "gamma": -1.0, "rank_ratio": -1.0,

@@ -40,6 +40,8 @@ def _right_multiply(X, M):
 class H2OSingularValueDecompositionEstimator(ModelBase):
     algo = "svd"
     supervised = False
+    # mesh-sharded serving: right singular vectors + stats as shared args
+    _serving_param_attrs = ("_v", "_mean", "_sd")
     _defaults = {
         "nv": 1, "transform": "NONE", "svd_method": "GramSVD",
         "max_iterations": 1000, "keep_u": True,

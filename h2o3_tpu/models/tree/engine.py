@@ -344,6 +344,33 @@ def stack_trees(tree_list, depth) -> TreeArrays:
         depth=depth, cover=cover)
 
 
+# TreeArrays is a pytree: the mesh-sharded serving fast path passes
+# whole ensembles as SHARED DEVICE ARGUMENTS into pjit'd scorer programs
+# (one HBM copy per model, every row-bucket program reuses it) instead
+# of baking them in as closure constants. Children are the per-node
+# arrays; `depth` is static trace structure, and `col_is_cat` stays HOST
+# data (aux) because predict_ensemble resolves the has-categoricals
+# branch with `np.any` at trace time.
+def _trees_flatten(t: TreeArrays):
+    aux = (t.depth,
+           None if t.col_is_cat is None
+           else tuple(bool(b) for b in np.asarray(t.col_is_cat)))
+    return (t.col, t.thr, t.na_left, t.value, t.cover, t.catbits), aux
+
+
+def _trees_unflatten(aux, children):
+    depth, cat = aux
+    col, thr, nal, val, cover, catbits = children
+    return TreeArrays(col=col, thr=thr, na_left=nal, value=val,
+                      depth=depth, cover=cover, catbits=catbits,
+                      col_is_cat=None if cat is None
+                      else np.asarray(cat, bool))
+
+
+jax.tree_util.register_pytree_node(TreeArrays, _trees_flatten,
+                                   _trees_unflatten)
+
+
 @_compat.guard_collective
 @functools.partial(jax.jit, static_argnames=("depth", "has_cat"))
 def _ensemble_walk(X, col, thr, nal, val, tw, catbits, iscat, *, depth,

@@ -33,6 +33,19 @@ from h2o3_tpu.obs.timeline import span as _span
 class SharedTreeEstimator(ModelBase):
     """Common driver for GBM / DRF (and the histogram machinery IF shares)."""
 
+    # mesh-sharded serving: ensembles (TreeArrays pytrees — `_trees` for
+    # the single-output distributions, `_trees_k` per class for
+    # multinomial) enter the scorer as shared device args. The per-node
+    # arrays shard their TREE axis over the optional "model" mesh axis
+    # (each model shard walks its tree slice; XLA inserts the cross-shard
+    # sum); on the default rows-only mesh that spec degenerates to one
+    # replicated copy. `_f0` stays a baked constant — the multinomial
+    # scorer concretizes it (float(self._f0[c])) at trace time.
+    _serving_param_attrs = ("_trees", "_trees_k")
+    _partition_rules = (
+        (r"^_trees", jax.sharding.PartitionSpec("model")),
+    )
+
     _tree_defaults = {
         "ntrees": 50, "max_depth": 5, "min_rows": 10.0, "nbins": 20,
         "nbins_cats": 1024, "learn_rate": 0.1, "sample_rate": 1.0,

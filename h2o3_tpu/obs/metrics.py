@@ -108,6 +108,13 @@ class Gauge(_Metric):
         with self._lock:
             self._series[k] = self._series.get(k, 0.0) + amount
 
+    def remove(self, **labels):
+        """Drop one label series — for per-entity gauges (per-model HBM
+        occupancy) whose entity was deleted: a freed model must leave
+        /metrics entirely, not linger as a forever-zero series."""
+        with self._lock:
+            self._series.pop(_label_key(labels), None)
+
     def value(self, **labels) -> float:
         for k, v in self._collect():
             if k == _label_key(labels):

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 import threading
 from dataclasses import dataclass, field
 
@@ -43,6 +44,12 @@ class Cloud:
 
     mesh: Mesh
     name: str = "h2o3-tpu"
+    # elastic membership (deploy/membership) epoch this mesh was built
+    # for. The jax device runtime is fixed-size (ROADMAP gap), so an
+    # epoch bump rebuilds the mesh over the SAME visible devices — but a
+    # fresh Mesh object per epoch gives downstream placement caches (the
+    # serving param store) an identity to invalidate against.
+    epoch: int = 1
 
     @property
     def n_devices(self) -> int:
@@ -143,3 +150,148 @@ def shutdown():
 def cluster_info() -> dict:
     """REST /3/Cloud analog."""
     return cloud().describe()
+
+
+def note_epoch(epoch: int) -> "Cloud":
+    """Adopt a cloud-membership epoch (deploy/membership listener hook):
+    when it moves past the formed mesh's epoch, rebuild the mesh — same
+    shape, same visible devices (the jax runtime is fixed-size), but a
+    NEW Mesh object stamped with the epoch, so placement caches keyed on
+    mesh identity (serving/params) re-place instead of serving arrays
+    laid out for a dead membership. Idempotent for old/equal epochs."""
+    global _CLOUD
+    with _lock:
+        c = cloud()
+        if epoch <= c.epoch:
+            return c
+        mesh = Mesh(c.mesh.devices, c.mesh.axis_names)
+        _CLOUD = Cloud(mesh=mesh, name=c.name, epoch=int(epoch))
+        return _CLOUD
+
+
+# ---------------------------------------------------------------------------
+# Regex-rule partitioner: param pytrees → PartitionSpec pytrees →
+# NamedSharding placements (the match_partition_rules / shard_params /
+# make_shard_and_gather_fns pattern, re-keyed for model serving).
+#
+# A rule set is ((regex, PartitionSpec), ...). Each leaf of a param
+# pytree is named by its '/'-joined tree path ("_trees/value",
+# "_params_net/1/0", …); the FIRST rule whose regex `re.search`-matches
+# the name wins. Scalars and unmatched leaves replicate (P()) — serving
+# must never refuse a model because a rule is missing; replication is
+# the always-correct default and still yields ONE shared copy per model
+# (the HBM win is vs. per-bucket baked constants, not vs. replication).
+
+
+def _leaf_name(path) -> str:
+    """'/'-joined jax KeyPath → rule-matchable leaf name."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def named_tree_map(fn, tree):
+    """tree_map with the '/'-joined path name as the first argument."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(_leaf_name(path), leaf), tree)
+
+
+def match_partition_rules(rules, params):
+    """Pytree of PartitionSpec, one per leaf of `params`, by first-match
+    regex over the leaf's path name. Scalar leaves and leaves no rule
+    matches get P() (replicated)."""
+    rules = tuple(rules or ())
+
+    def spec_for(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()            # never partition scalars
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        return P()
+    return named_tree_map(spec_for, params)
+
+
+def _canon_host_leaf(leaf) -> np.ndarray:
+    """Serving dtype canonicalization for HOST leaves: params reach the
+    scorer in the dtypes its traced math uses — f32 floats, i32 ints.
+    Matches the jnp.asarray(..., jnp.float32) casts inside every
+    _score_matrix, so passing params as device args instead of baked
+    constants cannot change a single bit of the result."""
+    a = np.asarray(leaf)
+    if a.dtype == np.float64:
+        a = a.astype(np.float32)
+    elif a.dtype == np.int64:
+        a = a.astype(np.int32)
+    return a
+
+
+def shard_params(params, specs=None, *, cld: "Cloud | None" = None,
+                 rules=None):
+    """device_put every leaf of a param pytree with its NamedSharding —
+    ONE resident copy per model, shared by every compiled row-bucket
+    program that takes it as an argument. `specs` is a PartitionSpec
+    pytree (from match_partition_rules); passing `rules` computes it.
+    Device-resident leaves (trained ensembles) reshard device-to-device
+    — no host round trip, transfer-guard clean. Multi-controller
+    runtimes build each process's addressable shards from its own
+    (replay-identical) host copy, exactly like mrtask.device_put_rows."""
+    c = cld or cloud()
+    if specs is None:
+        specs = match_partition_rules(rules, params)
+    multi = jax.process_count() > 1
+
+    def place(leaf, spec):
+        sh = NamedSharding(c.mesh, spec)
+        if multi:
+            from h2o3_tpu.parallel import mrtask as _mrt
+            arr = _canon_host_leaf(
+                _mrt.host_fetch(leaf) if isinstance(leaf, jax.Array)
+                else leaf)
+            return jax.make_array_from_callback(arr.shape, sh,
+                                                lambda idx: arr[idx])
+        if isinstance(leaf, jax.Array):
+            return jax.device_put(leaf, sh)
+        return jax.device_put(_canon_host_leaf(leaf), sh)
+    return jax.tree_util.tree_map(place, params, specs)
+
+
+def make_shard_and_gather_fns(specs, cld: "Cloud | None" = None):
+    """(shard_fn, gather_fn) pytrees for a PartitionSpec pytree:
+    shard_fn(leaf) places one leaf with its NamedSharding; gather_fn
+    fetches it back to a host numpy array (the checkpoint/export hop)."""
+    c = cld or cloud()
+
+    def mk_shard(spec):
+        return lambda leaf: shard_params(leaf, specs=spec, cld=c)
+
+    def mk_gather(spec):
+        del spec
+        from h2o3_tpu.parallel import mrtask as _mrt
+        return lambda leaf: _mrt.host_fetch(leaf)
+    return (jax.tree_util.tree_map(mk_shard, specs,
+                                   is_leaf=lambda s: isinstance(s, P)),
+            jax.tree_util.tree_map(mk_gather, specs,
+                                   is_leaf=lambda s: isinstance(s, P)))
+
+
+def params_nbytes(params) -> int:
+    """Logical bytes of ONE copy of a (placed or host) param pytree —
+    the h2o3_scorer_params_bytes gauge's unit: per-model HBM occupancy
+    that must stay CONSTANT in the number of compiled row-buckets."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            n = np.asarray(leaf).nbytes
+        total += int(n)
+    return total

@@ -15,9 +15,10 @@ from __future__ import annotations
 import numpy as np
 
 from h2o3_tpu.serving.scorer_cache import (     # noqa: F401
-    CACHE, FALLBACKS, Ineligible, model_token, prewarm, prewarm_enabled,
-    row_bucket, score_frame, score_frame_with_response, score_rows,
-    stage_frame, stage_response, _fastpath_reason)
+    CACHE, FALLBACKS, Ineligible, model_token, prewarm, prewarm_all,
+    prewarm_enabled, row_bucket, score_frame, score_frame_with_response,
+    score_rows, stage_frame, stage_response, _fastpath_reason)
+from h2o3_tpu.serving.params import PARAMS      # noqa: F401
 from h2o3_tpu.serving.microbatch import (   # noqa: F401
     BATCHER, MicroBatcher, QueueFull)
 
@@ -25,8 +26,10 @@ from h2o3_tpu.serving.microbatch import (   # noqa: F401
 def _microbatch_eligible(model, nrows: int) -> bool:
     """Shared predicate for the two micro-batch entry points: models with
     a custom predict (isofor score frames, GLRM archetypes, …) own their
-    output schema and must answer through model.predict; huge inputs,
-    strike-parked models and multihost clouds fall back too. Keep the
+    output schema and must answer through model.predict; huge inputs and
+    strike-parked models fall back too, as do multihost clouds for the
+    few families WITHOUT a serving-param export (param-exporting
+    families dispatch one SPMD program over the global mesh). Keep the
     frame route and the row-payload route agreeing on this."""
     from h2o3_tpu.serving import scorer_cache as _sc
     from h2o3_tpu.models.model import ModelBase
@@ -37,8 +40,8 @@ def _microbatch_eligible(model, nrows: int) -> bool:
 
 def predict_via_rest(model, frame):
     """Micro-batched frame prediction for the REST layer. Ineligible
-    inputs (huge frames, untraceable models, multihost) fall back to
-    model.predict, which itself prefers the scorer cache."""
+    inputs (huge frames, untraceable models) fall back to model.predict,
+    which itself prefers the scorer cache."""
     from h2o3_tpu.serving import scorer_cache as _sc
     if not _microbatch_eligible(model, frame.nrows):
         return model.predict(frame)

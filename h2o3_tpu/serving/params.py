@@ -1,0 +1,207 @@
+"""Shared serving-param placements — ONE HBM copy of a model's params.
+
+The pre-mesh scorer cache traced a model's parameters (tree arrays, GLM
+coefficients, net weights, centroids, …) into each per-bucket XLA
+program as closure constants: N row-buckets × M models duplicated every
+ensemble in HBM, and any model bigger than one host's HBM simply could
+not ride the fast path. This store is the other half of the rebuild:
+
+  * A model family exports a param PYTREE (`ModelBase._serving_params`)
+    plus regex partition rules; `parallel.mesh.match_partition_rules`
+    maps each leaf to a `PartitionSpec` and `mesh.shard_params` places
+    it once as `NamedSharding`-committed device arrays.
+  * Every compiled row-bucket program takes the placed pytree as its
+    FIRST argument (not a baked constant), so all buckets — and on a
+    multi-controller cloud, all hosts — share the same single copy.
+  * Placements are REFCOUNTED by the cache entries that dispatch them:
+    each resident (model, bucket) program holds one reference; the last
+    eviction (LRU, stale-generation purge, model DELETE) frees the
+    placement exactly once. `h2o3_scorer_params_bytes{model}` tracks the
+    per-model occupancy, which is constant in the number of buckets.
+  * A cloud-epoch bump (deploy/membership) rebuilds the mesh
+    (`mesh.note_epoch`); placements record the epoch they were placed
+    for and transparently re-place on the next dispatch.
+"""
+
+from __future__ import annotations
+
+from h2o3_tpu.analysis.lockdep import make_lock
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.parallel import mesh as _mesh
+
+PARAM_BYTES = _om.gauge(
+    "h2o3_scorer_params_bytes",
+    "resident HBM bytes of ONE shared serving-param copy per model "
+    "(constant in the number of compiled row-buckets)")
+PLACEMENTS = _om.counter(
+    "h2o3_scorer_param_placements_total",
+    "serving param pytrees placed on the mesh (one per model generation "
+    "per cloud epoch; re-places after an epoch bump are counted too)")
+
+
+class Placement:
+    """One model generation's placed params: the device pytree, its
+    PartitionSpec pytree, logical bytes, and the cloud epoch it was
+    placed for (jax interns Mesh objects — same devices and axis names
+    give the SAME Mesh back — so the epoch, not mesh identity, is the
+    staleness signal)."""
+
+    __slots__ = ("placed", "specs", "nbytes", "epoch", "refs")
+
+    def __init__(self, placed, specs, nbytes, epoch):
+        self.placed = placed
+        self.specs = specs
+        self.nbytes = nbytes
+        self.epoch = epoch
+        self.refs = 0
+
+
+class ParamStore:
+    """(model key, generation token) → refcounted Placement."""
+
+    def __init__(self):
+        self._lock = make_lock("serving.params")
+        self._placements: dict = {}
+
+    # -- placement ---------------------------------------------------------
+    @staticmethod
+    def _build_placement(model):
+        """Compute a Placement WITHOUT the store lock held — the
+        device_put of a large ensemble must not stall every other
+        model's warm dispatches (which read the store per call). Returns
+        None for families without a param export."""
+        params = model._serving_params()
+        if params is None:
+            return None
+        cld = _mesh.cloud()
+        specs = _mesh.match_partition_rules(
+            getattr(model, "_partition_rules", ()), params)
+        placed = _mesh.shard_params(params, specs=specs, cld=cld)
+        return Placement(placed, specs, _mesh.params_nbytes(placed),
+                         cld.epoch)
+
+    def _publish(self, key, p: "Placement") -> "Placement":
+        """Install a freshly built Placement under the lock; a racing
+        builder's copy wins first-publish (the loser's device arrays are
+        GC'd). Returns the placement now in the store."""
+        with self._lock:
+            cur = self._placements.get(key)
+            if cur is not None and cur.epoch == p.epoch:
+                return cur
+            if cur is not None:
+                p.refs = cur.refs         # epoch re-place keeps the refs
+            self._placements[key] = p
+            PLACEMENTS.inc()
+            PARAM_BYTES.set(p.nbytes, model=key[0])
+            return p
+
+    def acquire(self, model, token: int):
+        """Place (or re-reference) the model's params; bumps the
+        refcount. Called once per cache-entry build; each resident
+        compiled bucket program holds exactly one reference. Returns the
+        Placement, or None for families without a param export."""
+        key = (model.key, token)
+        with self._lock:
+            p = self._placements.get(key)
+            if p is not None:
+                p.refs += 1
+                return p
+        built = self._build_placement(model)        # outside the lock
+        if built is None:
+            return None
+        p = self._publish(key, built)
+        with self._lock:
+            p.refs += 1
+        return p
+
+    def reattach(self, model_key: str, token: int, p: "Placement"):
+        """Re-install a placement an in-flight build acquired but a
+        concurrent invalidate_key swept before the entry published —
+        the entry's reference is live, so the store must know the
+        placement again (or every dispatch would re-place one-shot)."""
+        with self._lock:
+            if (model_key, token) not in self._placements:
+                self._placements[(model_key, token)] = p
+                PARAM_BYTES.set(p.nbytes, model=model_key)
+
+    def placed(self, model, token: int):
+        """The CURRENT placed pytree for a dispatch — re-placing first
+        when the mesh was rebuilt for a new cloud epoch (the old
+        placement's arrays are laid out for a dead membership). Does not
+        change the refcount; the calling cache entry already holds one."""
+        key = (model.key, token)
+        epoch = _mesh.cloud().epoch
+        with self._lock:
+            p = self._placements.get(key)
+            if p is not None and p.epoch == epoch:
+                return p.placed
+        if p is not None:
+            # stale epoch: rebuild outside the lock, publish (refs carry)
+            built = self._build_placement(model)
+            if built is not None:
+                return self._publish(key, built).placed
+            return None
+        # Placement gone while a dispatch was in flight: the entry was
+        # evicted/invalidated (retrain purge, model DELETE) between the
+        # cache lookup and this call. Serve THIS request with a one-shot
+        # placement that is never stored — storing it would re-register
+        # the freed model with refs nothing will ever release (a
+        # permanent HBM leak and a ghost gauge series for a deleted
+        # model). One-shot placement is GC'd with the dispatch.
+        params = model._serving_params()
+        if params is None:
+            return None
+        return _mesh.shard_params(
+            params,
+            rules=getattr(model, "_partition_rules", ()))
+
+    # -- release -----------------------------------------------------------
+    def release(self, model_key: str, token: int):
+        """One cache entry dropped its reference; the LAST release frees
+        the placement (and its gauge series) exactly once."""
+        with self._lock:
+            p = self._placements.get((model_key, token))
+            if p is None:
+                return
+            p.refs -= 1
+            if p.refs <= 0:
+                del self._placements[(model_key, token)]
+                if not any(k[0] == model_key for k in self._placements):
+                    PARAM_BYTES.remove(model=model_key)
+
+    def invalidate_key(self, model_key: str):
+        """Model DELETE: drop every generation's placement for the DKV
+        key regardless of refcount (the cache drops its entries in the
+        same breath — see ScorerCache.invalidate_key)."""
+        with self._lock:
+            for k in [k for k in self._placements if k[0] == model_key]:
+                del self._placements[k]
+            PARAM_BYTES.remove(model=model_key)
+
+    def clear(self):
+        with self._lock:
+            keys = {k[0] for k in self._placements}
+            self._placements.clear()
+            for mk in keys:
+                PARAM_BYTES.remove(model=mk)
+
+    # -- introspection -----------------------------------------------------
+    def bytes_for(self, model_key: str) -> int:
+        with self._lock:
+            return sum(p.nbytes for k, p in self._placements.items()
+                       if k[0] == model_key)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(p.nbytes for p in self._placements.values())
+
+    def resident(self) -> int:
+        with self._lock:
+            return len(self._placements)
+
+
+PARAMS = ParamStore()
+
+_om.gauge("h2o3_scorer_param_models",
+          "model generations with a live shared serving-param placement",
+          fn=lambda: float(PARAMS.resident()))

@@ -1,4 +1,5 @@
-"""Compiled-scorer cache — the recompile-free serving fast path.
+"""Compiled-scorer cache — the recompile-free, mesh-sharded serving fast
+path.
 
 Problem: every `Model.predict` used to trace + XLA-compile a fresh program
 per unique row count (DataInfo.matrix jits a closure per call; several
@@ -15,19 +16,35 @@ Design (hex/Model.java:1764 BigScore, re-keyed for XLA):
     never poison predictions or aggregates.
   * ONE jitted program per cache key compiles the whole pipeline:
     raw staged columns → DataInfo.assemble_design (one-hot/standardize/
-    impute/interactions) → the algo's `_score_matrix` (tree gather loop,
-    GLM link, DL forward, KMeans assign, NB posterior, …).
+    impute/interactions) → the algo's scorer (tree gather loop, GLM
+    link, DL forward, KMeans assign, NB posterior, …).
+  * Model params ride as SHARED DEVICE ARGUMENTS, not baked constants:
+    a family exporting `_serving_params()` has its param pytree mapped
+    through regex partition rules (`parallel.mesh.match_partition_rules`)
+    to `PartitionSpec`s and placed ONCE per model generation as
+    `NamedSharding` device arrays (`serving.params.PARAMS`). Every
+    row-bucket program of the model — and, on a multi-controller cloud,
+    every host — dispatches against that single copy, so per-model HBM
+    is constant in the number of buckets and multihost models ride the
+    fast path instead of falling back. Families without a param export
+    keep the legacy baked-constant build (single-host only).
   * Cache key = (model key, model-object generation token, raw column
     signature, dtype, bucket). The token is minted per model OBJECT
     (weakref map), so overwriting a DKV key with a retrained model — a
     different object — can never hit the old program, even when the
-    overwrite races an in-flight request holding the old object.
+    overwrite races an in-flight request holding the old object. The
+    param store is keyed by the same token: program invalidation and
+    placement invalidation move together.
   * Staging is HOST-side (numpy decode of the packed Vec codecs) into a
     bucket-sized buffer + one `device_put` — neither ever compiles, which
     is what makes "3 row counts in one bucket == 1 compile" hold.
   * The staged device buffer is DONATED to the program (non-CPU backends),
     so steady-state scoring reuses the same HBM for staging instead of
-    allocating fresh buffers per request.
+    allocating fresh buffers per request. Placed params are never donated.
+  * Every dispatch rides `parallel.compat.guarded_jit` — on host (CPU)
+    meshes a scorer program over sharded args contains collectives, and
+    an unguarded concurrent launch re-opens the ISSUE-10 XLA:CPU
+    rendezvous hang (analyzer rule R014 rejects raw jit/pjit here).
 
 Env knobs:
   H2O3_SCORER_CACHE_SIZE      max resident programs (LRU; default 64)
@@ -35,6 +52,9 @@ Env knobs:
   H2O3_SCORE_FASTPATH_MAX_ROWS  row-count ceiling for the fast path
                               (default 1<<20); larger batches take the
                               legacy sharded path whose compile amortizes
+  H2O3_SCORER_PREWARM         1 → compile the smallest bucket (and place
+                              params) on model publish AND on replacement
+                              -worker join, so first requests warm-hit
 """
 
 from __future__ import annotations
@@ -52,8 +72,10 @@ from h2o3_tpu.analysis.lockdep import make_lock, make_rlock
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs.timeline import span as _span
+from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.parallel import mesh as _mesh
 from h2o3_tpu.parallel import mrtask as _mrt
+from h2o3_tpu.serving.params import PARAMS
 
 HITS = _om.counter("h2o3_scorer_cache_hits_total",
                    "compiled-scorer cache hits (no trace, no compile)")
@@ -184,6 +206,36 @@ def model_token(model) -> int:
         return t
 
 
+class _Program:
+    """One resident compiled scorer: a callable taking the staged device
+    rows. Param-sharing programs look up the CURRENT shared placement on
+    every dispatch (a cloud-epoch mesh rebuild re-places transparently)
+    and hold one param-store reference, released exactly once when the
+    entry leaves the cache — however it leaves (LRU, stale-generation
+    purge, model DELETE, clear)."""
+
+    __slots__ = ("_jfn", "model_key", "token", "shares_params", "_model",
+                 "placement")
+
+    def __init__(self, jfn, model, token, shares_params, placement=None):
+        self._jfn = jfn
+        self._model = model
+        self.model_key = model.key
+        self.token = token
+        self.shares_params = shares_params
+        self.placement = placement
+
+    def __call__(self, raw_dev):
+        if self.shares_params:
+            return self._jfn(PARAMS.placed(self._model, self.token),
+                             raw_dev)
+        return self._jfn(raw_dev)
+
+    def release(self):
+        if self.shares_params:
+            PARAMS.release(self.model_key, self.token)
+
+
 class ScorerCache:
     """LRU of compiled scorer programs, keyed by
     (model key, model-object token, raw column signature, dtype, bucket).
@@ -245,54 +297,80 @@ class ScorerCache:
                 stale = [k for k in self._entries
                          if k[0] == key[0] and k[1] != key[1]]
                 for k in stale:
-                    del self._entries[k]
+                    self._entries.pop(k).release()
                     EVICTIONS.inc()
                 with _BROKEN_LOCK:
                     for k in [b for b in _BROKEN
                               if b[0] == key[0] and b[1] != key[1]]:
                         _BROKEN.pop(k, None)
                 self._entries[key] = fn
+                if fn.shares_params and fn.placement is not None:
+                    # an invalidate_key that raced this build swept the
+                    # placement the entry references — re-install it so
+                    # dispatches don't degrade to one-shot re-placement
+                    PARAMS.reattach(key[0], key[1], fn.placement)
                 while len(self._entries) > _cache_size():
-                    self._entries.popitem(last=False)
+                    _, old = self._entries.popitem(last=False)
+                    old.release()
                     EVICTIONS.inc()
         return fn, False
 
     @staticmethod
-    def _build(model):
+    def _build(model) -> "_Program":
         di = model._dinfo
-
-        def _score(raw):
-            return model._score_matrix(di.assemble_design(raw))
-
-        # Known tradeoff: the model's parameters (tree arrays, net
-        # weights) are traced in as closure constants, so each bucket's
-        # executable embeds its own copy. Serving row counts cluster into
-        # a handful of buckets and the LRU bounds the total, but a
-        # huge-ensemble model served across many buckets pays the
-        # duplication; passing the arrays as shared device arguments is
-        # the follow-up if that bites (ROADMAP open item).
-        #
+        token = model_token(model)
         # donate the staged buffer: the program may alias its HBM for the
         # design matrix / outputs, so steady-state scoring does no fresh
         # allocation. CPU has no donation — gate it to avoid warnings.
-        donate = (0,) if jax.default_backend() != "cpu" else ()
-        return jax.jit(_score, donate_argnums=donate)
+        # The shared param pytree is NEVER donated.
+        cpu = jax.default_backend() == "cpu"
+        placement = PARAMS.acquire(model, token)
+        if placement is not None:
+            # mesh-sharded fast path: params enter as NamedSharding-placed
+            # device args shared by every bucket of this model (and every
+            # host); jit reads the committed shardings off the arrays, so
+            # this is the pjit spelling without re-stating in_shardings
+            def _score_p(params, raw):
+                return model._score_with_params(params,
+                                                di.assemble_design(raw))
+
+            jfn = _compat.guarded_jit(
+                _score_p, donate_argnums=() if cpu else (1,))
+            return _Program(jfn, model, token, shares_params=True,
+                            placement=placement)
+
+        # legacy baked-constant build for families without a param
+        # export: params trace in as closure constants, one copy PER
+        # BUCKET — single-host only (see _fastpath_reason "multihost")
+        def _score(raw):
+            return model._score_matrix(di.assemble_design(raw))
+
+        jfn = _compat.guarded_jit(
+            _score, donate_argnums=() if cpu else (0,))
+        return _Program(jfn, model, token, shares_params=False)
 
     def invalidate_key(self, model_key: str):
         """Drop every resident program (and failure strikes) for a DKV
         model key — called on model deletion so the cache's closures stop
-        pinning the dead model. Other deletions are bounded by the LRU."""
+        pinning the dead model. Releases each entry's param-store
+        reference, then sweeps any placement left (a prewarm that placed
+        params but lost its entry to LRU pressure mid-build). Other
+        deletions are bounded by the LRU."""
         with self._lock:
             for k in [k for k in self._entries if k[0] == model_key]:
-                del self._entries[k]
+                self._entries.pop(k).release()
                 EVICTIONS.inc()
             with _BROKEN_LOCK:
                 for b in [b for b in _BROKEN if b[0] == model_key]:
                     _BROKEN.pop(b, None)
+            PARAMS.invalidate_key(model_key)
 
     def clear(self):
         with self._lock:
+            for entry in self._entries.values():
+                entry.release()
             self._entries.clear()
+            PARAMS.clear()
 
 
 CACHE = ScorerCache()
@@ -333,13 +411,26 @@ def _is_broken(key: tuple) -> bool:
     return _time.monotonic() - last < _BROKEN_COOLDOWN_S
 
 
+def _shares_params(model) -> bool:
+    """True when the family exports a serving-param pytree — the
+    mesh-sharded build with one shared HBM copy and multihost support."""
+    try:
+        return model._serving_params() is not None
+    except Exception:   # noqa: BLE001 — an export bug falls back, not 500s
+        return False
+
+
 def _fastpath_reason(model, nrows: int):
     """None when the fast path applies, else a fallback-counter label."""
-    if jax.process_count() > 1:
-        return "multihost"
     di = getattr(model, "_dinfo", None)
     if di is None or not getattr(model, "key", None):
         return "no-dinfo"
+    if jax.process_count() > 1 and not _shares_params(model):
+        # only the legacy baked-constant build is host-local; families
+        # exporting param pytrees dispatch one SPMD program over the
+        # global mesh (params placed identically on every host by the
+        # replay contract), so they stay on the fast path
+        return "multihost"
     if nrows <= 0:
         return "empty"
     if nrows > _max_rows():
@@ -372,7 +463,11 @@ def score_rows(model, raw: np.ndarray, n: int, links=()) -> np.ndarray:
     ROWS_SCORED.inc(n)
     # device_get, not np.asarray: the result fetch is the one intended
     # device→host transfer on this path — keep it explicit so the
-    # transfer-guard sanitizer admits it
+    # transfer-guard sanitizer admits it. A multi-controller result whose
+    # shards live on other processes' devices gathers first (the MRTask
+    # result-collection hop) — host_fetch owns that allgather.
+    if isinstance(out, jax.Array) and not out.is_fully_addressable:
+        return np.asarray(_mrt.host_fetch(out))
     return np.asarray(jax.device_get(out))
 
 
@@ -431,10 +526,23 @@ def prewarm_enabled() -> bool:
 
 
 def prewarm(model, wait: bool = False):
-    """Compile `model`'s minimum-bucket scorer in a background thread.
-    Returns the Thread, or None when the model is fast-path ineligible.
-    Failures are logged, counted as ordinary fallbacks by the first real
-    request, and never break the publish."""
+    """Compile `model`'s minimum-bucket scorer in a background thread —
+    placing the shared sharded params first for param-exporting families
+    (the build acquires the placement), so a first request pays neither
+    the placement device_put nor the XLA compile. Returns the Thread, or
+    None when the model is fast-path ineligible. Failures are logged,
+    counted as ordinary fallbacks by the first real request, and never
+    break the publish."""
+    if jax.process_count() > 1:
+        # real multi-controller runtime: every process must dispatch
+        # identical programs in identical (replay) order — a background
+        # prewarm thread firing at its own time on one host would leave
+        # an SPMD collective waiting for peers that never launch it.
+        # First-request compiles ARE replay-ordered, so multihost clouds
+        # warm on first use. Replacement workers joining the replay
+        # channel run single-process jax (the dead slot is gone from the
+        # fixed device runtime), so the join-path prewarm stays active.
+        return None
     if _fastpath_reason(model, 1) is not None:
         return None
     bucket = row_bucket(1)
@@ -459,6 +567,32 @@ def prewarm(model, wait: bool = False):
     if wait:
         t.join(timeout=120.0)
     return t
+
+
+def prewarm_all(wait: bool = False) -> int:
+    """Prewarm every DKV-resident model's smallest-bucket scorer — the
+    replacement-worker warm start (ISSUE-10 join path): a joiner that
+    just replayed the coordinator's state snapshot places each model's
+    shared params and compiles the smallest row bucket BEFORE its first
+    live request, so the request records a warm hit instead of a
+    multi-second compile. Returns how many prewarms were started."""
+    from h2o3_tpu.core.kvstore import DKV
+    threads = []
+    for key in DKV.keys():
+        # raw_get: this is a whole-registry SCAN — DKV.get would run the
+        # tier-promotion hook and fault every disk-spilled frame's codec
+        # bytes back into host RAM just to learn it is not a model
+        m = DKV.raw_get(key)
+        if getattr(m, "_dinfo", None) is None \
+                or getattr(m, "key", None) != key:
+            continue        # frames, vecs, misc DKV values — not models
+        t = prewarm(m)
+        if t is not None:
+            threads.append(t)
+    if wait:
+        for t in threads:
+            t.join(timeout=120.0)
+    return len(threads)
 
 
 def score_frame_with_response(model, frame):

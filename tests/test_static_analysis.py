@@ -704,3 +704,115 @@ def test_r013_package_is_clean():
     found = [f for f in engine.run(rules=["R013"])
              if not f.suppressed and not f.baselined]
     assert found == [], [str(f) for f in found]
+
+
+# ---------------------------------------------------------------------------
+# R014: unguarded pjit/jit dispatch in serving/ and parallel/ (ISSUE 11)
+def test_r014_detects_raw_jit_in_serving_layers():
+    src = (
+        "import jax\n"
+        "from jax.experimental.pjit import pjit\n"
+        "def build(fn):\n"
+        "    return jax.jit(fn)\n"
+        "def build2(fn):\n"
+        "    return pjit(fn)\n")
+    for path in ("h2o3_tpu/serving/fixture_cache.py",
+                 "h2o3_tpu/parallel/fixture_disp.py"):
+        found = [f for f in engine.analyze_source(src, filename=path)
+                 if f.rule == "R014"]
+        assert len(found) == 2, (path, found)
+        assert "rendezvous" in found[0].message
+
+
+def test_r014_detects_unguarded_jit_decorator():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def scorer(x):\n"
+        "    return x * 2\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/serving/fixture_deco.py")
+        if f.rule == "R014"]
+    assert len(found) == 1
+    # a guard_collective decorator above it makes the same site clean
+    guarded = ("import jax\n"
+               "from h2o3_tpu.parallel import compat as _compat\n"
+               "@_compat.guard_collective\n"
+               "@jax.jit\n"
+               "def scorer(x):\n"
+               "    return x * 2\n")
+    assert "R014" not in _rules_of(engine.analyze_source(
+        guarded, filename="h2o3_tpu/serving/fixture_deco.py"))
+
+
+def test_r014_detects_partial_jit_spelling():
+    """@functools.partial(jax.jit, static_argnames=...) — the repo's
+    dominant static-args idiom — is a jit-maker too; the jit rides as an
+    ARGUMENT of the partial, not the callee."""
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=('depth',))\n"
+        "def scorer(x, *, depth):\n"
+        "    return x * depth\n"
+        "def build(fn):\n"
+        "    return functools.partial(jax.jit, donate_argnums=(0,))(fn)\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/serving/fixture_partial.py")
+        if f.rule == "R014"]
+    assert len(found) == 2, found
+    # guard-stacked decorator and guard-wrapped call are clean
+    clean = (
+        "import functools\n"
+        "import jax\n"
+        "from h2o3_tpu.parallel import compat as _compat\n"
+        "@_compat.guard_collective\n"
+        "@functools.partial(jax.jit, static_argnames=('depth',))\n"
+        "def scorer(x, *, depth):\n"
+        "    return x * depth\n"
+        "def build(fn):\n"
+        "    return _compat.guard_collective(\n"
+        "        functools.partial(jax.jit, donate_argnums=(0,))(fn))\n")
+    assert "R014" not in _rules_of(engine.analyze_source(
+        clean, filename="h2o3_tpu/serving/fixture_partial.py"))
+
+
+def test_r014_clean_when_routed_through_the_guard():
+    src = (
+        "import jax\n"
+        "from h2o3_tpu.parallel import compat as _compat\n"
+        "def build(fn):\n"
+        "    return _compat.guard_collective(jax.jit(fn))\n"
+        "def build2(fn):\n"
+        "    return _compat.guarded_jit(fn, donate_argnums=(0,))\n")
+    assert "R014" not in _rules_of(engine.analyze_source(
+        src, filename="h2o3_tpu/serving/fixture_cache.py"))
+
+
+def test_r014_scope_is_serving_and_parallel_only():
+    """Model modules own their guards via guard_collective wrapping at
+    module level (ISSUE 10); R014's path scope keeps it surgical."""
+    src = "import jax\ndef b(fn):\n    return jax.jit(fn)\n"
+    assert "R014" not in _rules_of(engine.analyze_source(
+        src, filename="h2o3_tpu/models/fixture_algo.py"))
+    # compat.py defines the guard — its inner jits ARE the guarded impl
+    assert "R014" not in _rules_of(engine.analyze_source(
+        src, filename="h2o3_tpu/parallel/compat.py"))
+
+
+def test_r014_suppression():
+    src = ("import jax\n"
+           "def host_only(fn):\n"
+           "    return jax.jit(fn)   # h2o3-ok: R014 host-side scalar probe, no collectives\n")
+    found = [f for f in engine.analyze_source(
+        src, filename="h2o3_tpu/serving/fixture_cache.py")
+        if f.rule == "R014"]
+    assert len(found) == 1 and found[0].suppressed
+
+
+def test_r014_package_is_clean():
+    """The mesh-sharded scorer rebuild routed every serving/parallel
+    dispatch through the guard funnel — R014 runs at zero findings."""
+    found = [f for f in engine.run(rules=["R014"])
+             if not f.suppressed and not f.baselined]
+    assert found == [], [str(f) for f in found]
