@@ -23,6 +23,11 @@ classes this repo actually shipped:
                          parallel/ not routed through
                          compat.guarded_jit/guard_collective (the
                          XLA:CPU collective-rendezvous hang class)
+  R017 env-config census direct os.environ reads of H2O3_*; accessor
+                         calls with non-literal names/defaults;
+                         duplicate declaration sites; README rows naming
+                         phantom variables (census: analysis/ENV.md;
+                         typed accessors: utils/env.py)
 
 Interprocedural concurrency rules (callgraph.py: project-wide call graph
 + lock-acquisition graph):
@@ -36,6 +41,19 @@ Interprocedural concurrency rules (callgraph.py: project-wide call graph
                          call that consumed it
   R010 thread/exec leak  Thread without daemon/join; executor futures
                          discarded; un-shutdown ThreadPoolExecutor
+  R015 host-sync taint   a call inside a timeline.span block (or on the
+                         serving dispatch path) whose callee
+                         TRANSITIVELY performs a device→host sync
+  R016 replay-determinism nondeterminism (time/random/uuid/urandom/id/
+                         unordered-set iteration) feeding state mutation
+                         in broadcast-replayed code — divergent per-host
+                         values fork the SPMD-replicated state
+
+The call graph models DYNAMIC DISPATCH (class-hierarchy analysis):
+cross-module base classes, self.m()/receiver-typed calls widened to
+every subclass override, and duck-typed seams resolved by distinctive
+method name under a one-hierarchy guard — so all six interprocedural
+rules see through polymorphism.
 
 Run `python -m h2o3_tpu.analysis --baseline analysis_baseline.json`; the
 tier-1 suite enforces zero unsuppressed findings over BOTH the package
@@ -53,4 +71,4 @@ from h2o3_tpu.analysis.sanitizers import (   # noqa: F401
 
 ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006",
              "R007", "R008", "R009", "R010", "R011", "R012", "R013",
-             "R014")
+             "R014", "R015", "R016", "R017")

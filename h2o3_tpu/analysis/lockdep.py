@@ -66,9 +66,17 @@ class LockOrderInversion(RuntimeError):
     never interleaves into it."""
 
 
+def env_mode() -> str:
+    """The H2O3_LOCKDEP mode from the environment ("" disabled / "log" /
+    "raise") — the variable's one declaration site; sanitizers
+    install_from_env() reads it through this helper too."""
+    from h2o3_tpu.utils.env import env_str
+    return _mode_from_env(env_str("H2O3_LOCKDEP", ""))
+
+
 class _State:
     def __init__(self):
-        self.mode = _mode_from_env(os.environ.get("H2O3_LOCKDEP", ""))
+        self.mode = env_mode()
 
     @property
     def enabled(self) -> bool:

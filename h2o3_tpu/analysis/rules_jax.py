@@ -72,14 +72,6 @@ def _is_jit_call(node: ast.Call) -> bool:
     return name in _JIT_MAKERS and name not in _CACHED_JIT
 
 
-def _parent_map(tree: ast.AST) -> dict:
-    parents = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    return parents
-
-
 def _enclosing_function(node: ast.AST, parents: dict):
     cur = parents.get(node)
     while cur is not None:
@@ -103,20 +95,24 @@ def _decorator_is_traced(dec: ast.AST) -> bool:
     return False
 
 
-def _traced_functions(tree: ast.AST, parents: dict) -> set:
+def _traced_functions(nodes: list, parents: dict) -> set:
     """Every FunctionDef/Lambda whose body runs under jax tracing:
     jit-decorated defs, and function-valued args to jit/shard_map/vmap/
-    grad/lax-control-flow calls (resolved to same-scope nested defs)."""
+    grad/lax-control-flow calls (resolved to same-scope nested defs).
+    `nodes` is the module's cached flat node list (Module.walk())."""
     traced: set = set()
+    fnlike = [n for n in nodes
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda))]
     # name -> def node, per enclosing scope, for resolving jit(fn_name)
     defs_by_scope: dict = {}
-    for node in ast.walk(tree):
+    for node in fnlike:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             scope = _enclosing_function(node, parents)
             defs_by_scope.setdefault(scope, {})[node.name] = node
             if any(_decorator_is_traced(d) for d in node.decorator_list):
                 traced.add(node)
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
         callee = _terminal_name(node.func)
@@ -141,9 +137,8 @@ def _traced_functions(tree: ast.AST, parents: dict) -> set:
     changed = True
     while changed:
         changed = False
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)) and node not in traced:
+        for node in fnlike:
+            if node not in traced:
                 enc = _enclosing_function(node, parents)
                 if enc in traced:
                     traced.add(node)
@@ -156,10 +151,10 @@ def _in_traced(node: ast.AST, parents: dict, traced: set) -> bool:
     return enc in traced
 
 
-def _span_blocks(tree: ast.AST) -> list:
+def _span_blocks(nodes: list) -> list:
     """With-statements whose context manager is a timeline span() call."""
     out = []
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.With):
             for item in node.items:
                 ctx = item.context_expr
@@ -184,11 +179,11 @@ def _contains_jnp_call(node: ast.AST) -> bool:
 
 def check(mod: Module) -> list:
     findings: list = []
-    tree = mod.tree
-    parents = _parent_map(tree)
-    traced = _traced_functions(tree, parents)
+    nodes = mod.walk()
+    parents = mod.parents()
+    traced = _traced_functions(nodes, parents)
 
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, ast.Call):
             continue
 
@@ -273,7 +268,7 @@ def check(mod: Module) -> list:
                     "call) — use jax.random with an explicit key"))
 
     # R004: global-mutation capture
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Global) and _in_traced(node, parents,
                                                        traced):
             findings.append(Finding(
@@ -284,7 +279,7 @@ def check(mod: Module) -> list:
 
     # R002: device syncs inside span-instrumented hot paths
     traced_lines = {f.line for f in findings}
-    for block in _span_blocks(tree):
+    for block in _span_blocks(nodes):
         for node in ast.walk(block):
             if not isinstance(node, ast.Call) \
                     or node.lineno in traced_lines:

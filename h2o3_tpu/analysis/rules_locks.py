@@ -120,7 +120,7 @@ def _mutations(method: ast.AST, lock_attrs: set):
 
 def check(mod: Module) -> list:
     findings: list = []
-    for cls in ast.walk(mod.tree):
+    for cls in mod.walk():
         if not isinstance(cls, ast.ClassDef):
             continue
         locks = _lock_attrs(cls)

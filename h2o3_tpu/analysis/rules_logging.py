@@ -40,7 +40,7 @@ def check(mod: Module) -> list:
     if _is_cli_module(mod.rel):
         return []
     findings = []
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         fn = node.func

@@ -58,10 +58,6 @@ def _enclosing_params(node: ast.AST, parents: dict) -> set:
     return out
 
 
-def _parent_map(tree):
-    return {c: p for p in ast.walk(tree) for c in ast.iter_child_nodes(p)}
-
-
 def _enclosing_class(node: ast.AST, parents: dict) -> str:
     cur = parents.get(node)
     while cur is not None:
@@ -75,7 +71,7 @@ def _registry_names(mod: Module) -> set:
     """Declaration helpers this module imported from the obs registry
     (`from h2o3_tpu.obs.metrics import counter, histogram`)."""
     out = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ImportFrom) and node.module \
                 and "obs" in node.module:
             out.update(a.asname or a.name for a in node.names
@@ -98,12 +94,12 @@ def collect(mods: list):
     decls: dict = {}
     findings: list = []
     for mod in mods:
-        parents = _parent_map(mod.tree)
+        parents = mod.parents()
         var_to_name: dict = {}    # module-level VAR -> metric name
         local_decl = _registry_names(mod)
         if mod.rel.replace("\\", "/").endswith("obs/metrics.py"):
             local_decl = set(_DECL_FNS)   # the registry's own module
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call):
                 continue
             kind = _terminal(node.func)
@@ -151,7 +147,7 @@ def collect(mods: list):
                     "censused and risks unbounded series cardinality — "
                     "declare the name as a string literal"))
         # emission label sets for module-level metric vars
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call) or \
                     not isinstance(node.func, ast.Attribute):
                 continue

@@ -81,11 +81,11 @@ def check(mod: Module) -> list:
     # parent map: a jit call is fine when its direct consumer is a
     # guard_collective(...) call
     parents: dict = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         for child in ast.iter_child_nodes(node):
             parents[child] = node
     deco_nodes: set = set()       # decorators judged by the deco branch
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             decos = list(node.decorator_list)
             deco_nodes.update(id(d) for d in decos)
@@ -106,7 +106,7 @@ def check(mod: Module) -> list:
                         "XLA:CPU rendezvous hang; stack "
                         "@compat.guard_collective above it or use "
                         "compat.guarded_jit"))
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call) or not _is_jit_maker(node) \
                 or id(node) in deco_nodes:
             continue

@@ -53,7 +53,7 @@ def _pattern_literal(node: ast.AST):
 def _route_rows(mod: Module):
     """Yield (pattern_str, method, handler_node, lineno) for every tuple
     literal shaped like a route row anywhere in the module."""
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Tuple) or len(node.elts) != 3:
             continue
         pat = _pattern_literal(node.elts[0])
@@ -79,7 +79,7 @@ def _import_aliases(mod: Module) -> dict:
     """{alias: module_basename} from `from h2o3_tpu.api import flow as
     _flow` style imports — enough to resolve `_flow.h_flow`."""
     out = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ImportFrom) and node.module:
             for alias in node.names:
                 out[alias.asname or alias.name] = alias.name

@@ -56,11 +56,12 @@ def _has_timeout_kwarg(call: ast.Call) -> bool:
     return len(call.args) >= 2
 
 
-def _scopes(tree: ast.AST):
+def _scopes(tree: ast.AST, nodes=None):
     """Yield (scope_node, body_statements) for the module and every
-    function — nested functions analyze as their own scope."""
+    function — nested functions analyze as their own scope. `nodes` is
+    the module's cached flat node list (Module.walk())."""
     yield tree, list(ast.iter_child_nodes(tree))
-    for node in ast.walk(tree):
+    for node in (nodes if nodes is not None else ast.walk(tree)):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield node, node.body
 
@@ -79,7 +80,7 @@ def _walk_scope(body):
 
 def check(mod: Module) -> list:
     findings = []
-    for _scope, body in _scopes(mod.tree):
+    for _scope, body in _scopes(mod.tree, mod.walk()):
         local_socks: set = set()       # names bound to sockets made here
         timed: set = set()             # names that got .settimeout(x)
         waits: list = []               # (name, attr, lineno)

@@ -31,7 +31,7 @@ from __future__ import annotations
 import ast
 
 from h2o3_tpu.analysis.engine import Finding, Module
-from h2o3_tpu.analysis.rules_metrics import _enclosing_params, _parent_map
+from h2o3_tpu.analysis.rules_metrics import _enclosing_params
 
 RULES = {"R011"}
 
@@ -44,7 +44,7 @@ _RECEIVER_ALIASES = {"timeline", "_timeline", "_tl", "_obs_tl"}
 def _span_aliases(mod: Module) -> set:
     """Local names bound to obs.timeline's span() by import."""
     out = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ImportFrom) and node.module \
                 and node.module.endswith("obs.timeline"):
             out.update(a.asname or a.name for a in node.names
@@ -82,7 +82,7 @@ def _wrapper_names(mod: Module, aliases: set) -> set:
     (mrtask._traced_dispatch): the literal names live at THEIR call
     sites, so those calls are censused like direct span() calls."""
     out = set()
-    for fn in ast.walk(mod.tree):
+    for fn in mod.walk():
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         a = fn.args
@@ -108,7 +108,7 @@ def collect(mods: list):
         aliases = _span_aliases(mod)
         wrappers = _wrapper_names(mod, aliases)
         parents = None
-        for node in ast.walk(mod.tree):
+        for node in mod.walk():
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             if not (_is_span_call(node, aliases)
@@ -122,7 +122,7 @@ def collect(mods: list):
                                                        node.lineno))
                 continue
             if parents is None:
-                parents = _parent_map(mod.tree)
+                parents = mod.parents()
             first = node.args[0]
             if isinstance(first, ast.Name) and \
                     first.id in _enclosing_params(node, parents):

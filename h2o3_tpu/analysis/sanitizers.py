@@ -28,7 +28,8 @@ Env gates (read by install_from_env, called at server start):
 from __future__ import annotations
 
 import contextlib
-import os
+
+from h2o3_tpu.utils.env import env_bool, env_str
 
 
 @contextlib.contextmanager
@@ -61,8 +62,7 @@ def install_from_env() -> dict:
     code change; a no-op when the env vars are unset."""
     enabled = {}
     from h2o3_tpu.analysis import lockdep
-    lockdep_mode = lockdep._mode_from_env(
-        os.environ.get("H2O3_LOCKDEP", ""))
+    lockdep_mode = lockdep.env_mode()
     if lockdep_mode:
         lockdep.enable(lockdep_mode)
         enabled["lockdep"] = lockdep_mode
@@ -70,10 +70,10 @@ def install_from_env() -> dict:
         import jax
     except Exception:   # noqa: BLE001 — no jax, nothing else to sanitize
         return enabled
-    if os.environ.get("H2O3_DEBUG_NANS", "") in ("1", "true", "yes"):
+    if env_bool("H2O3_DEBUG_NANS", False):
         jax.config.update("jax_debug_nans", True)
         enabled["debug_nans"] = True
-    guard = os.environ.get("H2O3_TRANSFER_GUARD", "").strip()
+    guard = env_str("H2O3_TRANSFER_GUARD", "").strip()
     if guard:
         jax.config.update("jax_transfer_guard", guard)
         enabled["transfer_guard"] = guard

@@ -159,8 +159,13 @@ _SID_COUNTER = [0]
 def _h_sessions_post(h):
     from h2o3_tpu.rapids import Session
     from h2o3_tpu.api import server as _srv
-    _SID_COUNTER[0] += 1          # monotonic: a deleted session's id is
-    sid = f"_sid{_SID_COUNTER[0]}_{int(time.time())}"   # never reissued
+    # monotonic counter only — a deleted session's id is never reissued
+    # within a cloud lifetime, and the id must be DETERMINISTIC: this
+    # POST is broadcast-replayed, so a wall-clock suffix minted a
+    # different sid on every host and forked the session table (the
+    # coordinator's reply named a key the workers never registered)
+    _SID_COUNTER[0] += 1
+    sid = f"_sid{_SID_COUNTER[0]}"
     _srv._sessions[sid] = Session(sid)
     h._send({"__meta": {"schema_type": "SessionIdV4"}, "session_key": sid})
 

@@ -34,6 +34,7 @@ from h2o3_tpu.obs import metrics as _obs_metrics
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.rapids import rapids_exec, Session
+from h2o3_tpu.utils import env as _env
 
 # per-request REST latency, labeled by ROUTE PATTERN (bounded cardinality),
 # method and status — the ROADMAP observability gap this closes
@@ -853,8 +854,7 @@ def _collect_timeout() -> float:
     (timeline/trace/metrics). The ISSUE-4 discipline: every wait the
     coordinator performs while holding the broadcast lock is bounded —
     a stalled worker costs one deadline, never a frozen scrape."""
-    return float(_os_mod.environ.get("H2O3_OBS_COLLECT_TIMEOUT_S", "2")
-                 or 2)
+    return _env.env_float("H2O3_OBS_COLLECT_TIMEOUT_S", 2.0)
 
 
 def _h_timeline(h: _Handler):
@@ -1263,7 +1263,7 @@ class H2OServer:
                         or str(_cfg.get_property("api.auth_method", "")
                                or "").lower() in ("ldap", "custom"))
             if not has_auth and \
-                    _os.environ.get("H2O3_INSECURE_BIND_ALL") != "1":
+                    not _env.env_bool("H2O3_INSECURE_BIND_ALL", False):
                 raise RuntimeError(
                     f"refusing to bind {host} without authentication: "
                     "configure -basic_auth/ai.h2o.api.auth_file, "

@@ -62,6 +62,7 @@ class Job:
     def start(self, work: Callable[["Job"], object], background: bool = True) -> "Job":
         """Run `work(job)`; its return value is DKV-put under self.dest."""
         self.status = RUNNING
+        # h2o3-ok: R016 wall-clock progress stamp for /3/Jobs display; no control flow or DKV key derivation reads it, so per-host divergence is cosmetic
         self.start_time = time.time()
         # jobs inherit the starting thread's trace (the REST request that
         # launched the build), so job.run/job.<phase> spans stitch into
@@ -98,6 +99,7 @@ class Job:
                 self.traceback = traceback.format_exc()
                 self.status = FAILED
             finally:
+                # h2o3-ok: R016 wall-clock progress stamp (see start_time): display-only, never replicated into decisions
                 self.end_time = time.time()
                 self._done.set()
 

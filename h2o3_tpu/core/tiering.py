@@ -45,7 +45,6 @@ exactly which chunks paged inside it.
 from __future__ import annotations
 
 import itertools
-import os
 import queue
 import threading
 import weakref
@@ -55,6 +54,7 @@ import numpy as np
 
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.utils.env import env_bool, env_int
 from h2o3_tpu.obs import timeline as _tl
 
 TIER_HBM = "hbm"
@@ -62,11 +62,12 @@ TIER_HOST = "host"
 TIER_DISK = "disk"
 
 
-def _mb_env(name: str) -> int:
-    try:
-        return int(os.environ.get(name, "0") or 0) * 2**20
-    except ValueError:
-        return 0
+def _hbm_budget_bytes() -> int:
+    return env_int("H2O3_TPU_HBM_BUDGET_MB", 0) * 2**20
+
+
+def _host_budget_bytes() -> int:
+    return env_int("H2O3_TPU_HOST_BUDGET_MB", 0) * 2**20
 
 
 def _fetch_dev_planes(dev):
@@ -185,8 +186,8 @@ class ChunkPager:
         self._bytes = {TIER_HBM: 0, TIER_HOST: 0, TIER_DISK: 0}
         self._ids = itertools.count(1)
         self._ticks = itertools.count(1)
-        self.hbm_budget = _mb_env("H2O3_TPU_HBM_BUDGET_MB")
-        self.host_budget = _mb_env("H2O3_TPU_HOST_BUDGET_MB")
+        self.hbm_budget = _hbm_budget_bytes()
+        self.host_budget = _host_budget_bytes()
         self._reserved = 0       # bytes admitted but not yet landed: makes
         #                          budget admission atomic across
         #                          concurrent faults (consumer + prefetch)
@@ -203,8 +204,7 @@ class ChunkPager:
         """Tiering active: a budget is set, or forced via H2O3_TPU_TIERING
         (retains host codec mirrors at ingest so demotion is free)."""
         return bool(self.hbm_budget or self.host_budget
-                    or os.environ.get("H2O3_TPU_TIERING", "") not in
-                    ("", "0"))
+                    or env_bool("H2O3_TPU_TIERING", False))
 
     def tick(self) -> int:
         return next(self._ticks)

@@ -45,6 +45,7 @@ import threading
 import time
 
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.utils.env import env_str
 
 ACTIONS = ("drop", "delay", "sever", "kill", "fail")
 
@@ -120,12 +121,17 @@ def parse(spec: str) -> list:
     return rules
 
 
+def _chaos_spec() -> str:
+    """The H2O3_CHAOS rule spec ("" = chaos disabled) — declaration
+    site for the variable; install()/install_from_env() both read it."""
+    return env_str("H2O3_CHAOS", "")
+
+
 def install(spec: str | None = None):
     """(Re)install rules from `spec` (or H2O3_CHAOS when None). The test
     API: install at setup, reset() at teardown."""
     global _RULES
-    rules = parse(spec if spec is not None
-                  else os.environ.get("H2O3_CHAOS", ""))
+    rules = parse(spec if spec is not None else _chaos_spec())
     with _LOCK:
         _RULES = rules
     return rules
@@ -189,5 +195,5 @@ def maybe_raise(point: str, worker=None, exc=None):
 
 def install_from_env():
     """Called at server/worker start: arms H2O3_CHAOS when present."""
-    if os.environ.get("H2O3_CHAOS"):
+    if _chaos_spec():
         install()

@@ -37,7 +37,6 @@ new epoch instead of surfacing a 5xx.
 
 from __future__ import annotations
 
-import os
 import random
 import socket as _socket_mod
 import threading
@@ -49,6 +48,7 @@ from h2o3_tpu.deploy import multihost as _mh
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import watchdog as _wd
 from h2o3_tpu.obs.timeline import span as _span
+from h2o3_tpu.utils.env import env_float, env_int
 
 ACTIVE = "active"
 DRAINING = "draining"
@@ -260,11 +260,7 @@ def _retry_backoff_s() -> float:
     H2O3_EPOCH_RETRY_BACKOFF_S (default 50ms), uniform jitter in
     [0.5x, 1.5x] so a thundering herd of straddled requests doesn't
     re-dispatch in lockstep."""
-    try:
-        base = float(os.environ.get("H2O3_EPOCH_RETRY_BACKOFF_S", "0.05")
-                     or 0.05)
-    except ValueError:
-        base = 0.05
+    base = env_float("H2O3_EPOCH_RETRY_BACKOFF_S", 0.05)
     return base * (0.5 + random.random())
 
 
@@ -288,31 +284,19 @@ def retry_once(fn, op: str = "op"):
 
 
 def _heartbeat_s() -> float:
-    try:
-        return float(os.environ.get("H2O3_HEARTBEAT_S", "10") or 0)
-    except ValueError:
-        return 10.0
+    return env_float("H2O3_HEARTBEAT_S", 10.0)
 
 
 def _heartbeat_misses() -> int:
-    try:
-        return int(os.environ.get("H2O3_HEARTBEAT_MISSES", "3") or 3)
-    except ValueError:
-        return 3
+    return env_int("H2O3_HEARTBEAT_MISSES", 3)
 
 
 def _drain_timeout_s() -> float:
-    try:
-        return float(os.environ.get("H2O3_DRAIN_TIMEOUT_S", "30") or 30)
-    except ValueError:
-        return 30.0
+    return env_float("H2O3_DRAIN_TIMEOUT_S", 30.0)
 
 
 def _replay_log_max() -> int:
-    try:
-        return int(os.environ.get("H2O3_REPLAY_LOG_MAX", "256") or 256)
-    except ValueError:
-        return 256
+    return env_int("H2O3_REPLAY_LOG_MAX", 256)
 
 
 class ElasticBroadcaster(_mh.Broadcaster):

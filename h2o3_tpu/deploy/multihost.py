@@ -18,12 +18,29 @@ from __future__ import annotations
 import io
 import os
 
+from h2o3_tpu.utils import env as _uenv
+from h2o3_tpu.utils.env import (env_bool, env_float, env_int, env_str,
+                                process_id)
+
+
+def _coordinator_address() -> str:
+    """host:port of process 0 ("" when unset — single-host / TPU-env
+    autodetection). The one H2O3_COORDINATOR_ADDRESS declaration site."""
+    return env_str("H2O3_COORDINATOR_ADDRESS", "")
+
+
+def _num_processes() -> int:
+    """World size for explicit (non-autodetected) multi-host wiring.
+    0 = unset: bootstrap() raises rather than silently forming a
+    1-process cloud with a coordinator address configured."""
+    return env_int("H2O3_NUM_PROCESSES", 0)
+
 
 def is_multihost() -> bool:
     """True when a multi-host launch environment is detected (TPU pod
     env vars or explicit coordinator address)."""
     return bool(
-        os.environ.get("H2O3_COORDINATOR_ADDRESS")
+        _coordinator_address()
         or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
         or (os.environ.get("TPU_WORKER_HOSTNAMES")
             and int(os.environ.get("TPU_WORKER_COUNT", "1") or 1) > 1))
@@ -39,8 +56,8 @@ def assisted_clustering_env() -> dict:
     H2O3_K8S_SERVICE (headless service name) and H2O3_K8S_REPLICAS:
     coordinator = <set>-0.<service>:8476, process_id = <ordinal>.
     Returns {} when not running under that convention."""
-    svc = os.environ.get("H2O3_K8S_SERVICE")
-    replicas = (os.environ.get("H2O3_K8S_REPLICAS") or "").strip()
+    svc = env_str("H2O3_K8S_SERVICE", "")
+    replicas = env_str("H2O3_K8S_REPLICAS", "").strip()
     host = os.environ.get("HOSTNAME", "")
     if not (svc and replicas.isdigit() and "-" in host):
         return {}
@@ -48,8 +65,8 @@ def assisted_clustering_env() -> dict:
     if not ordinal.isdigit():
         return {}
     # 8476 matches the StatefulSet/Service declared coordinator port
-    port = os.environ.get("H2O3_COORDINATOR_PORT", "8476")
-    ns = os.environ.get("H2O3_K8S_NAMESPACE")
+    port = env_str("H2O3_COORDINATOR_PORT", "8476")
+    ns = env_str("H2O3_K8S_NAMESPACE", "")
     fqdn = f"{base}-0.{svc}" + (f".{ns}.svc.cluster.local" if ns else "")
     return {"H2O3_COORDINATOR_ADDRESS": f"{fqdn}:{port}",
             "H2O3_NUM_PROCESSES": replicas,
@@ -71,19 +88,32 @@ def bootstrap(n_rows_shards=None, n_model_shards: int = 1):
 
     # assisted clustering: fill the H2O3_* wiring from StatefulSet DNS
     # when the manifest didn't set it explicitly
-    if not os.environ.get("H2O3_COORDINATOR_ADDRESS"):
+    if not _coordinator_address():
         # plain assignment: a present-but-EMPTY manual override means
         # "use assisted mode", and setdefault would leave it empty
         for k, v in assisted_clustering_env().items():
             os.environ[k] = v
 
     if is_multihost():
-        addr = os.environ.get("H2O3_COORDINATOR_ADDRESS")
+        addr = _coordinator_address()
         if addr:
+            nproc = _num_processes()
+            if nproc <= 0:
+                raise RuntimeError(
+                    "H2O3_COORDINATOR_ADDRESS is set but "
+                    "H2O3_NUM_PROCESSES is not — explicit multi-host "
+                    "wiring needs the world size")
+            if not _uenv.is_set("H2O3_PROCESS_ID"):
+                # keep the old KeyError's loudness: four pods all
+                # defaulting to rank 0 fail far from the root cause
+                raise RuntimeError(
+                    "H2O3_COORDINATOR_ADDRESS is set but "
+                    "H2O3_PROCESS_ID is not — every pod of an explicit "
+                    "multi-host wiring must declare its rank")
             jax.distributed.initialize(
                 coordinator_address=addr,
-                num_processes=int(os.environ["H2O3_NUM_PROCESSES"]),
-                process_id=int(os.environ["H2O3_PROCESS_ID"]))
+                num_processes=nproc,
+                process_id=process_id())
         else:
             jax.distributed.initialize()   # TPU-env autodetection
     import h2o3_tpu
@@ -123,7 +153,7 @@ def _ack_timeout() -> float:
     REST thread behind the broadcast lock forever (the R008 class the
     static analyzer flags). Bounded, the failure is a loud RuntimeError
     after this deadline instead of a silent server freeze."""
-    return float(os.environ.get("H2O3_REPLAY_ACK_TIMEOUT_S", "120") or 120)
+    return env_float("H2O3_REPLAY_ACK_TIMEOUT_S", 120.0)
 
 
 def _ack_timeouts_counter():
@@ -135,7 +165,7 @@ def _ack_timeouts_counter():
 
 
 def _cluster_secret() -> bytes:
-    s = os.environ.get("H2O3_CLUSTER_SECRET", "")
+    s = env_str("H2O3_CLUSTER_SECRET", "")
     if not s:
         raise RuntimeError(
             "H2O3_CLUSTER_SECRET is required for the multi-host replay "
@@ -210,14 +240,16 @@ def _form_timeout_s() -> float:
     """Bound on the coordinator's initial cloud-formation accept loop —
     a missing worker pod must surface as a loud error, not an accept()
     parked forever (the R013 unbounded-network-wait class)."""
-    return float(os.environ.get("H2O3_CLOUD_FORM_TIMEOUT_S", "600") or 600)
+    return env_float("H2O3_CLOUD_FORM_TIMEOUT_S", 600.0)
 
 
 def _reconnect_window_s() -> float:
     """How long a worker whose coordinator socket dropped keeps retrying
     the handshake before exiting nonzero. 0 disables reconnection (the
-    pre-elastic behavior: an orphaned worker exits its loop cleanly)."""
-    return float(os.environ.get("H2O3_REPLAY_RECONNECT_S", "60") or 0)
+    pre-elastic behavior: an orphaned worker exits its loop cleanly).
+    The old read had two defaults (unset → 60, empty → 0); the typed
+    accessor collapses both to the documented 60."""
+    return env_float("H2O3_REPLAY_RECONNECT_S", 60.0)
 
 
 def _challenge_peer(conn, secret: bytes):
@@ -855,7 +887,7 @@ def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
         # H2OServer enforces the bind-all-requires-auth posture itself
         srv = H2OServer(port)
         if nproc > 1:
-            if os.environ.get("H2O3_ELASTIC", "1") != "0":
+            if env_bool("H2O3_ELASTIC", True):
                 from h2o3_tpu.deploy.membership import ElasticBroadcaster
                 srv.httpd.broadcaster = ElasticBroadcaster(nproc - 1, bport)
             else:
@@ -865,8 +897,7 @@ def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):
                    cloud.n_devices, nproc, port)
         srv.start(background=False)
     else:
-        host = os.environ.get("H2O3_COORDINATOR_ADDRESS",
-                              "127.0.0.1:0").split(":")[0]
+        host = (_coordinator_address() or "127.0.0.1:0").split(":")[0]
         worker_loop(host, bport)
 
 

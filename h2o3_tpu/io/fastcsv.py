@@ -15,6 +15,7 @@ import os
 import numpy as np
 
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.utils.env import env_str
 from h2o3_tpu.obs.timeline import span as _span
 
 # bytes handed to the native tokenizer (per byte-range call — the sum over
@@ -25,14 +26,19 @@ FASTCSV_BYTES = _om.counter("h2o3_fastcsv_bytes_total",
 _LIB = None
 
 
+def native_dir() -> str:
+    """Directory holding the native .so builds (H2O3_NATIVE_DIR override;
+    default <repo>/native). Declaration site for the variable — the
+    TreeSHAP loader (models/tree/contrib) imports this helper."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return env_str("H2O3_NATIVE_DIR", "") or os.path.join(here, "native")
+
+
 def _lib():
     global _LIB
     if _LIB is None:
-        here = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        ndir = os.environ.get("H2O3_NATIVE_DIR",
-                              os.path.join(here, "native"))
-        path = os.path.join(ndir, "libfastcsv.so")
+        path = os.path.join(native_dir(), "libfastcsv.so")
         lib = ctypes.CDLL(path)
         lib.fastcsv_parse.restype = ctypes.c_void_p
         lib.fastcsv_parse.argtypes = [ctypes.c_char_p, ctypes.c_char,

@@ -20,8 +20,10 @@ import re
 
 import numpy as np
 
+from h2o3_tpu.utils.env import env_str
+
 _DEFAULT_ICE = os.path.join(os.path.expanduser("~"), ".h2o3_tpu_ice")
-_ICE_ROOT = os.environ.get("H2O3_TPU_ICE_ROOT", _DEFAULT_ICE)
+_ICE_ROOT = env_str("H2O3_TPU_ICE_ROOT", "") or _DEFAULT_ICE
 
 _SAFE = re.compile(r"[^A-Za-z0-9_.-]")
 

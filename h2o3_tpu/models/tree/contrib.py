@@ -24,11 +24,8 @@ _LIB = None
 def _lib():
     global _LIB
     if _LIB is None:
-        here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))))
-        ndir = os.environ.get("H2O3_NATIVE_DIR",
-                              os.path.join(here, "native"))
-        path = os.path.join(ndir, "libtreeshap.so")
+        from h2o3_tpu.io.fastcsv import native_dir
+        path = os.path.join(native_dir(), "libtreeshap.so")
         try:
             lib = ctypes.CDLL(path)
         except OSError as e:

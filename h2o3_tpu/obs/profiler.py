@@ -26,6 +26,7 @@ import time
 
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.utils.env import env_str
 
 SESSIONS = _om.counter(
     "h2o3_profiler_sessions_total",
@@ -99,7 +100,7 @@ class ProfilerManager:
         self._active: dict | None = None
 
     def _artifact_dir(self, trace_dir) -> str:
-        d = trace_dir or os.environ.get("H2O3_PROFILE_DIR")
+        d = trace_dir or env_str("H2O3_PROFILE_DIR", "")
         if not d:
             import tempfile
             d = tempfile.mkdtemp(prefix="h2o3-profile-")

@@ -63,6 +63,7 @@ import time
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import segments as _segs
+from h2o3_tpu.utils.env import env_bool, env_float, env_int
 
 SPANS_SEEN = _om.counter(
     "h2o3_recorder_spans_total",
@@ -73,39 +74,32 @@ SPANS_SEEN = _om.counter(
     "retained — healed spans were also counted downsampled)")
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def enabled() -> bool:
-    return os.environ.get("H2O3_OBS_RECORDER", "1") != "0"
+    return env_bool("H2O3_OBS_RECORDER", True)
 
 
 def _slow_ms() -> float:
-    return _env_f("H2O3_OBS_SLOW_MS", 1000.0)
+    return env_float("H2O3_OBS_SLOW_MS", 1000.0)
 
 
 def _sample_rate() -> float:
-    return min(1.0, max(0.0, _env_f("H2O3_OBS_SAMPLE", 0.01)))
+    return min(1.0, max(0.0, env_float("H2O3_OBS_SAMPLE", 0.01)))
 
 
 def _retain_bytes() -> int:
-    return int(_env_f("H2O3_OBS_RETAIN_MB", 64.0) * 1e6)
+    return int(env_float("H2O3_OBS_RETAIN_MB", 64.0) * 1e6)
 
 
 def _segment_bytes() -> int:
-    return int(_env_f("H2O3_OBS_SEGMENT_MB", 4.0) * 1e6)
+    return int(env_float("H2O3_OBS_SEGMENT_MB", 4.0) * 1e6)
 
 
 def _linger_s() -> float:
-    return _env_f("H2O3_OBS_TRACE_LINGER_S", 30.0)
+    return env_float("H2O3_OBS_TRACE_LINGER_S", 30.0)
 
 
 def _max_trace_spans() -> int:
-    return int(_env_f("H2O3_OBS_TRACE_MAX_SPANS", 512))
+    return env_int("H2O3_OBS_TRACE_MAX_SPANS", 512)
 
 
 def default_root() -> str:

@@ -62,6 +62,7 @@ from collections import deque
 
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.utils.env import env_float, env_str
 
 DEFAULT_WINDOWS = ((300.0, 3600.0, 14.4), (1800.0, 21600.0, 6.0))
 
@@ -207,10 +208,7 @@ class SLOEngine:
 
     @staticmethod
     def _persist_min_s() -> float:
-        try:
-            return float(os.environ.get("H2O3_SLO_PERSIST_S", "30") or 30)
-        except ValueError:
-            return 30.0
+        return env_float("H2O3_SLO_PERSIST_S", 30.0)
 
     def persist(self):
         """Write the sample rings (and alert states) atomically. The
@@ -425,7 +423,7 @@ class SLOEngine:
     def start(self):
         """Start the periodic evaluator (idempotent; daemon thread). No
         specs or H2O3_SLO_EVAL_S=0 → nothing to do."""
-        period = float(os.environ.get("H2O3_SLO_EVAL_S", "30") or 30)
+        period = env_float("H2O3_SLO_EVAL_S", 30.0)
         if not self._specs or period <= 0:
             return None
         with self._lock:
@@ -460,7 +458,7 @@ def install_from_env():
     list. A file that EXISTS but fails to parse raises: a deployment
     that ships broken SLOs should fail loudly at start, not alert on
     nothing."""
-    path = os.environ.get("H2O3_SLO_FILE")
+    path = env_str("H2O3_SLO_FILE", "")
     # isfile, not exists: with an absent optional ConfigMap the mount
     # materializes as an empty directory (or the pointed-at file simply
     # never appears), and a directory path must idle, not raise

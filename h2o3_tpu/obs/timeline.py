@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import contextlib
 import itertools
-import os
 import random
 import threading
 import time
@@ -29,6 +28,7 @@ from dataclasses import dataclass, field
 from collections import deque
 
 from h2o3_tpu.analysis.lockdep import make_lock
+from h2o3_tpu.utils import env as _env
 from h2o3_tpu.obs import tracing as _tracing
 
 
@@ -46,12 +46,9 @@ def _dropped_counter():
 
 def host_id() -> int:
     """This process' rank in the cloud. Env-derived (the multihost
-    bootstrap wires H2O3_PROCESS_ID) so reading it never initializes the
-    JAX backend."""
-    try:
-        return int(os.environ.get("H2O3_PROCESS_ID", "0") or 0)
-    except ValueError:
-        return 0
+    bootstrap wires H2O3_PROCESS_ID via utils.env.process_id) so reading
+    it never initializes the JAX backend."""
+    return _env.process_id()
 
 
 @dataclass
@@ -94,8 +91,7 @@ class SpanTimeline:
 
     def __init__(self, capacity: int | None = None):
         if capacity is None:
-            capacity = int(os.environ.get("H2O3_OBS_TIMELINE_CAPACITY",
-                                          "4096") or 4096)
+            capacity = _env.env_int("H2O3_OBS_TIMELINE_CAPACITY", 4096)
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
         self._lock = make_lock("timeline.ring")
@@ -192,9 +188,14 @@ _TRACE_LOCK = make_lock("timeline.trace")
 _TRACE_ACTIVE = False
 
 
+def _xprof_trace_dir() -> str:
+    """H2O3_OBS_TRACE_DIR declaration site ("" = xprof bridge off)."""
+    return _env.env_str("H2O3_OBS_TRACE_DIR", "")
+
+
 def _maybe_start_trace(name: str) -> bool:
-    trace_dir = os.environ.get("H2O3_OBS_TRACE_DIR")
-    want = os.environ.get("H2O3_OBS_TRACE_SPAN")
+    trace_dir = _xprof_trace_dir()
+    want = _env.env_str("H2O3_OBS_TRACE_SPAN", "")
     if not trace_dir or not want or not name.startswith(want):
         return False
     global _TRACE_ACTIVE
@@ -230,7 +231,7 @@ def span(name: str, **attrs):
     sp = SPANS.begin(name, **attrs)
     traced = _maybe_start_trace(name)
     if traced:
-        sp.attrs["xprof"] = os.environ.get("H2O3_OBS_TRACE_DIR")
+        sp.attrs["xprof"] = _xprof_trace_dir()
     try:
         yield sp
     finally:

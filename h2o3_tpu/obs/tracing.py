@@ -20,10 +20,11 @@ Env surface:
 from __future__ import annotations
 
 import contextlib
-import os
 import re
 import secrets
 import threading
+
+from h2o3_tpu.utils.env import env_bool
 
 _TLS = threading.local()
 
@@ -35,7 +36,7 @@ _SAFE_ID = re.compile(r"[0-9a-zA-Z_.\-]{1,64}")
 
 def enabled() -> bool:
     """Trace-id minting at the REST layer (H2O3_TRACING, default on)."""
-    return os.environ.get("H2O3_TRACING", "1") != "0"
+    return env_bool("H2O3_TRACING", True)
 
 
 def new_trace_id() -> str:

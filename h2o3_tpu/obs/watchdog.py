@@ -48,6 +48,7 @@ import traceback
 from h2o3_tpu.analysis.lockdep import make_lock
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.utils.env import env_bool, env_float
 
 TRIPS = _om.counter(
     "h2o3_watchdog_trips_total",
@@ -71,22 +72,16 @@ _NULL = contextlib.nullcontext()
 def enabled() -> bool:
     global _ENABLED
     if _ENABLED is None:
-        _ENABLED = os.environ.get("H2O3_WATCHDOG", "1") != "0"
+        _ENABLED = env_bool("H2O3_WATCHDOG", True)
     return _ENABLED
 
 
 def _stall_s() -> float:
-    try:
-        return float(os.environ.get("H2O3_WATCHDOG_STALL_S", "") or 300.0)
-    except ValueError:
-        return 300.0
+    return env_float("H2O3_WATCHDOG_STALL_S", 300.0)
 
 
 def _poll_s() -> float:
-    try:
-        v = float(os.environ.get("H2O3_WATCHDOG_POLL_S", "") or 0.0)
-    except ValueError:
-        v = 0.0
+    v = env_float("H2O3_WATCHDOG_POLL_S", 0.0)
     return v if v > 0 else min(max(_stall_s() / 4.0, 0.05), 5.0)
 
 

@@ -26,10 +26,11 @@ the guard is a no-op there (and on single-device CPU).
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 
 import jax
+
+from h2o3_tpu.utils import env as _uenv
 
 
 def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
@@ -56,7 +57,7 @@ def needs_host_serialization() -> bool:
     deadlock-prone. Memoized after the first backend probe;
     H2O3_HOST_SERIALIZE=0|1 overrides."""
     global _NEEDS_SERIALIZATION
-    env = os.environ.get("H2O3_HOST_SERIALIZE", "")
+    env = _uenv.env_str("H2O3_HOST_SERIALIZE", "")
     if env in ("0", "1"):
         return env == "1"
     if _NEEDS_SERIALIZATION is None:

@@ -16,7 +16,6 @@ large padded batches instead of a stream of tiny ones.
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 import time
 
@@ -29,6 +28,7 @@ from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.serving import scorer_cache as _sc
+from h2o3_tpu.utils.env import env_float, env_int
 
 REQUESTS = _om.counter("h2o3_score_microbatch_requests_total",
                        "scoring requests entering the micro-batch queue")
@@ -56,7 +56,7 @@ def _wait_s() -> float:
     registration and dispatch must strand followers for a bounded time,
     not forever. Dispatch failures set per-request errors well before
     this fires; it is the backstop, not the control path."""
-    return max(1.0, float(os.environ.get("H2O3_SCORE_WAIT_S", "120")))
+    return max(1.0, env_float("H2O3_SCORE_WAIT_S", 120.0))
 
 
 class QueueFull(Exception):
@@ -73,7 +73,7 @@ class QueueFull(Exception):
 
 
 def _linger_s() -> float:
-    return max(0.0, float(os.environ.get("H2O3_SCORE_LINGER_MS", "2"))) / 1e3
+    return max(0.0, env_float("H2O3_SCORE_LINGER_MS", 2.0)) / 1e3
 
 
 def _queue_depth_limit() -> int:
@@ -81,7 +81,7 @@ def _queue_depth_limit() -> int:
     Default 512: at the default 2ms linger a healthy queue drains in a
     couple of dispatches, so hundreds of waiters means the device is
     stalled — shed rather than queue."""
-    return int(os.environ.get("H2O3_SCORE_QUEUE_DEPTH", "512"))
+    return env_int("H2O3_SCORE_QUEUE_DEPTH", 512)
 
 
 class _Request:

@@ -60,7 +60,6 @@ Env knobs:
 from __future__ import annotations
 
 import itertools
-import os
 import threading
 import weakref
 from collections import OrderedDict
@@ -76,6 +75,7 @@ from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.parallel import mesh as _mesh
 from h2o3_tpu.parallel import mrtask as _mrt
 from h2o3_tpu.serving.params import PARAMS
+from h2o3_tpu.utils.env import env_bool, env_int
 
 HITS = _om.counter("h2o3_scorer_cache_hits_total",
                    "compiled-scorer cache hits (no trace, no compile)")
@@ -91,15 +91,15 @@ ROWS_SCORED = _om.counter("h2o3_score_rows_total",
 
 
 def _cache_size() -> int:
-    return int(os.environ.get("H2O3_SCORER_CACHE_SIZE", "64"))
+    return env_int("H2O3_SCORER_CACHE_SIZE", 64)
 
 
 def _min_bucket() -> int:
-    return int(os.environ.get("H2O3_SCORE_MIN_BUCKET", "128"))
+    return env_int("H2O3_SCORE_MIN_BUCKET", 128)
 
 
 def _max_rows() -> int:
-    return int(os.environ.get("H2O3_SCORE_FASTPATH_MAX_ROWS", str(1 << 20)))
+    return env_int("H2O3_SCORE_FASTPATH_MAX_ROWS", 1 << 20)
 
 
 def row_bucket(n: int) -> int:
@@ -522,7 +522,7 @@ PREWARMS = _om.counter(
 
 
 def prewarm_enabled() -> bool:
-    return os.environ.get("H2O3_SCORER_PREWARM", "0") == "1"
+    return env_bool("H2O3_SCORER_PREWARM", False)
 
 
 def prewarm(model, wait: bool = False):

@@ -1,0 +1,116 @@
+"""Typed environment accessors — the one sanctioned way to read H2O3_*
+configuration.
+
+The config surface grew to 60+ `H2O3_*` variables read through scattered
+`os.environ.get(...)` + ad-hoc `int()`/`float()` parses, with three
+recurring defects this module retires:
+
+  * crash-at-read: ``int(os.environ.get("H2O3_SCORER_CACHE_SIZE", "64"))``
+    raises ValueError on a typo'd value — at import time or mid-request;
+  * inconsistent defaults: ``float(os.environ.get(NAME, "60") or 0)``
+    means unset → 60 but empty → 0, two defaults for one variable;
+  * no census: nothing enumerated the config surface, so renames and
+    drift were invisible (the failure mode METRICS.md/SPANS.md already
+    gate for metric and span names).
+
+Contract, enforced package-wide by analyzer rule R017:
+
+  * every H2O3_* read goes through ``env_str``/``env_int``/``env_float``/
+    ``env_bool`` with a LITERAL variable name and a LITERAL default;
+  * each variable has exactly ONE accessor call site package-wide (its
+    declaration site) — modules that share a variable import the owning
+    module's helper instead of re-reading;
+  * the generated census ``h2o3_tpu/analysis/ENV.md`` (``python -m
+    h2o3_tpu.analysis --write-census``) is therefore the complete,
+    committed config surface, freshness-gated in pre-commit/tier-1.
+
+Parse semantics: unset and empty-string both yield the default (an empty
+export is "not configured", not "zero"); an unparseable value warns once
+per (name, value) and yields the default instead of crashing.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off"}
+_warned: set = set()
+
+
+def _raw(name: str):
+    """The package's single os.environ touchpoint for H2O3_* reads."""
+    return os.environ.get(name)
+
+
+def _bad(name: str, raw: str, kind: str, default):
+    key = (name, raw)
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(
+        f"{name}={raw!r} is not a valid {kind}; using default {default!r}",
+        RuntimeWarning, stacklevel=3)
+
+
+def env_str(name: str, default: str = "") -> str:
+    """String config var; unset/empty → default."""
+    v = _raw(name)
+    if v is None or v == "":
+        return default
+    return v
+
+
+def env_int(name: str, default: int) -> int:
+    v = _raw(name)
+    if v is None or v.strip() == "":
+        return default
+    try:
+        return int(v.strip())
+    except ValueError:
+        _bad(name, v, "int", default)
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    v = _raw(name)
+    if v is None or v.strip() == "":
+        return default
+    try:
+        return float(v.strip())
+    except ValueError:
+        _bad(name, v, "float", default)
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """Boolean config var: 1/true/yes/on and 0/false/no/off (any case);
+    unset/empty → default; anything else warns and yields the default
+    (the old ``!= "0"`` idiom silently read "flase" as enabled)."""
+    v = _raw(name)
+    if v is None or v.strip() == "":
+        return default
+    s = v.strip().lower()
+    if s in _TRUE:
+        return True
+    if s in _FALSE:
+        return False
+    _bad(name, v, "bool", default)
+    return default
+
+
+def is_set(name: str) -> bool:
+    """Presence check (set to anything, even empty) — for call sites
+    whose failure mode must stay LOUD when a variable is missing (the
+    explicit multi-host bootstrap). Value reads still go through the
+    typed accessors; this never parses."""
+    return _raw(name) is not None
+
+
+def process_id() -> int:
+    """This process' rank in the cloud — H2O3_PROCESS_ID, wired by the
+    multihost bootstrap. Declared here (not per-reader) because the
+    timeline, the structured logger and jax.distributed init all need
+    it and R017 allows one declaration site per variable."""
+    return env_int("H2O3_PROCESS_ID", 0)

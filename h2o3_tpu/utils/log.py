@@ -62,6 +62,7 @@ from collections import deque
 # listing, GC, torn-line-tolerant reads) — one implementation for the
 # flight recorder and this module (json/os only: no import cycle)
 from h2o3_tpu.obs import segments as _segments_mod
+from h2o3_tpu.utils import env as _uenv
 
 _LOGGER = None
 _INIT_LOCK = threading.Lock()
@@ -74,19 +75,12 @@ _LEVEL = 20
 _STDERR_LEVEL = 20
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def _retain_bytes() -> int:
-    return int(_env_f("H2O3_LOG_RETAIN_MB", 32.0) * 1e6)
+    return int(_uenv.env_float("H2O3_LOG_RETAIN_MB", 32.0) * 1e6)
 
 
 def _segment_bytes() -> int:
-    return int(_env_f("H2O3_LOG_SEGMENT_MB", 4.0) * 1e6)
+    return int(_uenv.env_float("H2O3_LOG_SEGMENT_MB", 4.0) * 1e6)
 
 
 _HOST = None
@@ -95,10 +89,7 @@ _HOST = None
 def _host_id() -> int:
     global _HOST
     if _HOST is None:
-        try:
-            _HOST = int(os.environ.get("H2O3_PROCESS_ID", "0") or 0)
-        except ValueError:
-            _HOST = 0
+        _HOST = _uenv.process_id()
     return _HOST
 
 
@@ -112,7 +103,7 @@ def log_root() -> str:
 
 # ---------------------------------------------------------------------------
 # in-memory ring of structured records (the GET /3/Logs working set)
-_RING: deque = deque(maxlen=int(_env_f("H2O3_LOG_RING", 2000)))
+_RING: deque = deque(maxlen=_uenv.env_int("H2O3_LOG_RING", 2000))
 
 # per-record ids start at a random per-process base (the obs/timeline
 # span-id discipline): ring records are usually ALSO on disk, and the
@@ -472,18 +463,19 @@ class _StructuredHandler(logging.Handler):
 def _build_logger() -> logging.Logger:
     global _LEVEL, _STDERR_LEVEL
     lg = logging.getLogger("h2o3_tpu")   # h2o3-ok: R012 the structured logger's own root — every other module goes through get_logger()
-    level = os.environ.get("H2O3_LOG_LEVEL", "INFO").upper()
+    level = _uenv.env_str("H2O3_LOG_LEVEL", "INFO").upper()
     lg.setLevel(level)
     _LEVEL = _LEVELS.get(level, 20)
     _STDERR_LEVEL = _LEVELS.get(
-        os.environ.get("H2O3_LOG_STDERR_LEVEL", level).upper(), _LEVEL)
+        (_uenv.env_str("H2O3_LOG_STDERR_LEVEL", "") or level).upper(),
+        _LEVEL)
     for h in list(lg.handlers):          # reinit(): drop stale handlers
         lg.removeHandler(h)
     lg.addHandler(_StructuredHandler())
     # classic rotating text log (-log_dir analog), rendered by the sink
     # drain so shim-path records land in it too
     rotating = None
-    log_dir = os.environ.get("H2O3_LOG_DIR")
+    log_dir = _uenv.env_str("H2O3_LOG_DIR", "")
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
         rotating = logging.handlers.RotatingFileHandler(

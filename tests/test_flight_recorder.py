@@ -387,8 +387,19 @@ def test_failed_request_trace_retained(server, rest_recorder):
     except urllib.error.HTTPError as ex:
         assert ex.code == 500
     SPANS.clear()
-    _, body = _req(server, "/3/Traces?status=error")
-    assert tid in {t["trace"] for t in json.loads(body)["traces"]}
+    # bounded poll: the root rest.request span (and its error-keep
+    # decision) lands a hair AFTER the 500 reaches the client — the
+    # same pre-existing race the stitched-trace/exemplar asserts poll
+    # through (the long suite surfaces it round-robin on this box)
+    found = set()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        _, body = _req(server, "/3/Traces?status=error")
+        found = {t["trace"] for t in json.loads(body)["traces"]}
+        if tid in found:
+            break
+        time.sleep(0.05)
+    assert tid in found
     _, body = _req(server, f"/3/Trace/{tid}")
     assert json.loads(body)["n_spans"] >= 1
     # malformed numeric query params are the CLIENT's error: a 400, never
