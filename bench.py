@@ -115,37 +115,170 @@ def probe_backend() -> dict | None:
     return blocked_record(*fail)
 
 
-def ingest_bench(mb: int = 50) -> dict:
-    """Distributed-parse throughput (VERDICT r4: fold an ingest number
-    into the chip bench): synthesize a ~`mb` MB CSV, time the byte-range
-    parallel parse (io/dparse + native tokenizer)."""
-    import tempfile
+def _ingest_csv(path: str, mb: int, seed: int = 0) -> int:
+    """Synthesize the r06-shaped ingest fixture (5 numeric cols,
+    ~56 B/row); returns the row count."""
     import numpy as np
-    from h2o3_tpu.io import dparse
-    rng = np.random.default_rng(0)
-    rows_per_mb = 18000          # ~56 B/row at 5 numeric cols
-    n = mb * rows_per_mb
+    rng = np.random.default_rng(seed)
+    n = mb * 18000
+    with open(path, "w") as fh:
+        fh.write("a,b,c,d,e\n")
+        for i in range(0, n, 10000):
+            blk = rng.normal(size=(min(10000, n - i), 5))
+            fh.write("\n".join(
+                ",".join(f"{v:.6f}" for v in row) for row in blk))
+            fh.write("\n")
+    return n
+
+
+def ingest_bench(mb: int = 50) -> dict:
+    """Single-host ingest throughput, now a HEADLINE metric (ISSUE 13):
+    synthesize the same ~50MB CSV shape BENCH_r06 measured at 54.8 MB/s,
+    time the byte-range pipelined parse (io/dparse + the rebuilt native
+    tokenizer), best of 3 (first run pays page-cache + pool warmup)."""
+    import tempfile
+    from h2o3_tpu.io import dparse, fastcsv
+    from h2o3_tpu.core.kvstore import DKV
     fd, path = tempfile.mkstemp(suffix=".csv")
+    os.close(fd)
     try:
-        with os.fdopen(fd, "w") as fh:
-            fh.write("a,b,c,d,e\n")
-            for i in range(0, n, 10000):
-                blk = rng.normal(size=(min(10000, n - i), 5))
-                fh.write("\n".join(
-                    ",".join(f"{v:.6f}" for v in row) for row in blk))
-                fh.write("\n")
+        n = _ingest_csv(path, mb)
         size_mb = os.path.getsize(path) / 1e6
-        t0 = time.time()
-        fr = dparse.parse_files([path], chunk_bytes=8 << 20)
-        dt = time.time() - t0
-        assert fr.nrows == n
-        from h2o3_tpu.core.kvstore import DKV
-        DKV.remove(fr.key)
-        return {"mb": round(size_mb, 1), "seconds": round(dt, 2),
-                "mb_per_sec": round(size_mb / dt, 1),
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            fr = dparse.parse_files([path], chunk_bytes=8 << 20)
+            dt = time.time() - t0
+            best = min(best, dt)
+            assert fr.nrows == n
+            DKV.remove(fr.key)
+        return {"mb": round(size_mb, 1), "seconds": round(best, 2),
+                "mb_per_sec": round(size_mb / best, 1),
+                "native_parser": fastcsv.available(),
                 "cores": os.cpu_count()}
     finally:
         os.unlink(path)
+
+
+def distributed_ingest_bench(single_host: dict | None,
+                             timeout_s: int = 240) -> dict:
+    """2-process distributed-ingest sample (ISSUE 13): form the real
+    jax.distributed CPU cloud (tests/multiproc_runner.py), then drive
+    POST /3/ParseDistributed — the coordinator fans byte-range shares to
+    the worker over the replay channel (pure HOST work: tokenize +
+    codec-pack, no device collectives) and merges the codec planes.
+    Records cloud_size and MB/s; a container that cannot form the cloud
+    yields a structured blocked record, and a box without ≥2 physical
+    cores records the scaling claim as blocked with the root cause
+    in-record (two processes time-slicing one core cannot scale)."""
+    import socket
+    import tempfile
+    import urllib.parse
+    import urllib.request
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    deadline = time.time() + timeout_s
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def _req(port, path, data=None):
+        url = f"http://127.0.0.1:{port}{path}"
+        req = urllib.request.Request(
+            url,
+            data=urllib.parse.urlencode(data).encode() if data else None,
+            method="POST" if data else "GET")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    tmp = tempfile.mkdtemp(prefix="h2o3_bench_ingest_")
+    csv = os.path.join(tmp, "dist_ingest.csv")
+    mb = int(os.environ.get("BENCH_INGEST_MB", "50"))
+    n = _ingest_csv(csv, mb, seed=2)
+    size_mb = os.path.getsize(csv) / 1e6
+    coord, rest = _free_port(), _free_port()
+    env = dict(os.environ)
+    env["H2O3_CLUSTER_SECRET"] = "bench-ingest-secret"
+    env["H2O3_TPU_ICE_ROOT"] = os.path.join(tmp, "ice")
+    # born-cold ingest: the coordinator of a multi-controller cloud must
+    # not device_put globally sharded planes from one process
+    env["H2O3_TPU_INGEST_COLD"] = "1"
+    env["XLA_FLAGS"] = ""
+    procs = []
+    record = {"hosts": 2, "mb": round(size_mb, 1)}
+    try:
+        for pid in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable,
+                 os.path.join(here, "tests", "multiproc_runner.py"),
+                 str(pid), "2", str(coord), str(rest)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env))
+        cloud_size = 0
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                cloud_size = int(_req(rest, "/3/Cloud").get("cloud_size",
+                                                            0))
+                if cloud_size >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        record["cloud_size"] = cloud_size
+        if cloud_size < 2:
+            return {**record, "blocked": True,
+                    "blocked_stage": "2proc-cloud-formation",
+                    "blocked_detail": "2-process jax.distributed cloud "
+                    "did not form in this container"}
+
+        def _one_parse(dest):
+            t0 = time.perf_counter()
+            r = _req(rest, "/3/ParseDistributed",
+                     {"source_frames": csv, "destination_frame": dest})
+            jk = r["job"]["key"]
+            while time.time() < deadline:
+                j = _req(rest, f"/3/Jobs/{jk}")["jobs"][0]
+                if j["status"] in ("DONE", "FAILED", "CANCELLED"):
+                    assert j["status"] == "DONE", j
+                    return time.perf_counter() - t0
+                time.sleep(0.1)
+            raise TimeoutError("distributed parse did not finish")
+
+        _one_parse("bench_dist_warm")       # warm: pools + page cache
+        dt = min(_one_parse("bench_dist_1"), _one_parse("bench_dist_2"))
+        record.update({"seconds": round(dt, 2),
+                       "mb_per_sec": round(size_mb / dt, 1),
+                       "rows": n})
+        if single_host and single_host.get("mb_per_sec"):
+            record["scaling_vs_single_host"] = round(
+                record["mb_per_sec"] / single_host["mb_per_sec"], 2)
+        cores = os.cpu_count() or 1
+        if cores < 2:
+            # the fan-out worked end-to-end, but a near-linear SCALING
+            # claim is unmeasurable here: both processes time-slice one
+            # physical core, so distributed MB/s ~= single-host MB/s by
+            # construction — root cause, not a code limitation
+            record["scaling_blocked"] = True
+            record["scaling_blocked_detail"] = (
+                f"container has {cores} CPU core(s); 2-process scaling "
+                "needs >=2 cores to show >1x")
+        return record
+    except Exception:
+        return {**record, "blocked": True,
+                "blocked_stage": "2proc-distributed-ingest",
+                "blocked_detail": traceback.format_exc()[-800:]}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)   # 50MB CSV + ice root
 
 
 def scoring_bench() -> dict:
@@ -552,7 +685,25 @@ def main():
     try:
         ingest = ingest_bench()
         print(f"ingest: {ingest['mb_per_sec']:.1f} MB/s "
-              f"({ingest['cores']} cores)", file=sys.stderr)
+              f"({ingest['cores']} cores, "
+              f"native={ingest['native_parser']})", file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+
+    distributed_ingest = None
+    try:
+        distributed_ingest = distributed_ingest_bench(ingest)
+        if distributed_ingest.get("blocked"):
+            print("2-proc ingest sample blocked: "
+                  f"{distributed_ingest['blocked_stage']}",
+                  file=sys.stderr)
+        else:
+            print(f"2-proc ingest: "
+                  f"{distributed_ingest['mb_per_sec']:.1f} MB/s over "
+                  f"REST (cloud_size {distributed_ingest['cloud_size']}"
+                  f", scaling "
+                  f"{distributed_ingest.get('scaling_vs_single_host')})",
+                  file=sys.stderr)
     except Exception:
         traceback.print_exc()
 
@@ -599,6 +750,9 @@ def main():
               ).set(0, stage="none")
     if ingest:
         g.set(ingest["mb_per_sec"], stat="ingest_mb_per_sec")
+    if distributed_ingest and distributed_ingest.get("mb_per_sec"):
+        g.set(distributed_ingest["mb_per_sec"],
+              stat="distributed_ingest_mb_per_sec")
     if scoring:
         g.set(scoring["rows_per_sec"], stat="scoring_rows_per_sec")
     print(json.dumps({
@@ -620,7 +774,9 @@ def main():
         "logging_overhead_pct": (scoring or {}).get("logging_overhead_pct"),
         "trace_id": bench_trace,
         "paths": paths,
+        "ingest_mb_per_sec": (ingest or {}).get("mb_per_sec"),
         "ingest": ingest,
+        "distributed_ingest": distributed_ingest,
         "scoring": scoring,
         "multihost_scoring": multihost_scoring,
     }))

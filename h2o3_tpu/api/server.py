@@ -240,12 +240,18 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if bc is not None and not _is_static_path(path) \
                     and not _is_obs_path(path) \
-                    and not path.startswith("/3/PostFile"):
+                    and not path.startswith("/3/PostFile") \
+                    and not path.startswith("/3/ParseDistributed"):
                 # PostFile is excluded: its body is raw (often binary)
                 # bytes that neither parse as params nor replay through
-                # the channel. Inside the try: a wedged replay channel
-                # (broadcast RuntimeError after the ack deadline) must
-                # answer a 500 H2OError, not drop the connection.
+                # the channel. ParseDistributed is excluded because the
+                # workers participate through the parse fan-out collect
+                # ops instead — replaying the request would have every
+                # host ALSO parse the whole file (and deadlock the
+                # fan-out behind their replays). Inside the try: a
+                # wedged replay channel (broadcast RuntimeError after
+                # the ack deadline) must answer a 500 H2OError, not
+                # drop the connection.
                 params = self._params()
                 self._cached_params = params
                 # the trace id rides the replay channel so every worker
@@ -424,6 +430,45 @@ def _h_parse(h: _Handler):
         finally:
             if upload_key is not None:
                 _up.consume_upload(upload_key)
+        job.dest = f.key
+        return f
+
+    job.start(work)
+    h._send({"__meta": {"schema_type": "ParseV3"},
+             "job": job.to_dict(), "destination_frame": {"name": dest}})
+
+
+def _h_parse_distributed(h: _Handler):
+    """POST /3/ParseDistributed — the cloud-wide chunked parse: the
+    coordinator plans byte ranges and fans shares out over the replay
+    channel (io/dparse `parse:` collect op); each host tokenizes its
+    consistent-hash share and ships codec-byte planes back. NOT
+    broadcast-replayed (see _route_inner): the workers participate
+    through the fan-out, so replaying the request would have every host
+    also parse the whole file. On a single-host cloud this is simply
+    the local pipelined parse.
+
+    Topology contract: the merged frame lives in the COORDINATOR's DKV
+    (host codec planes, born cold) — the elastic/serving topology,
+    where DKV re-home ships codec bytes and replacement workers run
+    single-process jax. On a fixed multi-controller SPMD device
+    runtime, frames destined for collective training must go through
+    the broadcast-replayed /3/Parse instead (every host parses, every
+    host holds its device shards)."""
+    p = h._params()
+    src = p.get("source_frames")
+    if isinstance(src, str):
+        src = json.loads(src) if src.startswith("[") else [src]
+    paths = [s.strip('"') for s in src]
+    dest = p.get("destination_frame") or None
+    bc = getattr(h.server, "broadcaster", None)
+    job = Job(description=f"ParseDistributed {paths[0]}",
+              dest=dest or "parsed")
+
+    def work(job):
+        from h2o3_tpu.io import dparse
+        f = dparse.parse_files(paths, destination_frame=dest,
+                               broadcaster=bc)
         job.dest = f.key
         return f
 
@@ -1160,6 +1205,7 @@ ROUTES = [
     (re.compile(r"/3/ImportFiles"), "GET", _h_import),
     (re.compile(r"/3/ParseSetup"), "POST", _h_parse_setup),
     (re.compile(r"/3/Parse"), "POST", _h_parse),
+    (re.compile(r"/3/ParseDistributed"), "POST", _h_parse_distributed),
     (re.compile(r"/3/Frames"), "GET", _h_frames),
     (re.compile(r"/3/Frames/([^/]+)"), "GET", _h_frame),
     (re.compile(r"/3/Frames/([^/]+)"), "DELETE", _h_frame_delete),

@@ -72,16 +72,22 @@ def _choose_codec(col: np.ndarray, mask: np.ndarray):
     """Pick the narrowest storage for a float64 host column (NewChunk.close).
 
     Returns (packed ndarray, Codec). NAs are stored as 0 in packed form; the
-    mask side-plane is authoritative.
+    mask side-plane is authoritative. Pass-frugal (this is the ingest
+    pack hot path): the masked-value copy is skipped when there are no
+    NAs, and scalar min/max pre-checks short-circuit the all-integral
+    scan for ordinary float columns — results are identical.
     """
-    valid = col[~mask]
+    has_na = bool(mask.any())
+    valid = col[mask == 0] if has_na else col
     if valid.size == 0:
         return np.zeros(col.shape, np.int8), Codec("const", const_val=float("nan"))
     vmin, vmax = float(valid.min()), float(valid.max())
     if vmin == vmax:  # constant col; NAs (incl. padding) live in the mask
         return np.zeros(col.shape, np.int8), Codec("const", const_val=vmin)
-    filled = np.where(mask, 0.0, col)
-    is_int = np.all(np.floor(valid) == valid) and np.isfinite(valid).all()
+    filled = np.where(mask, 0.0, col) if has_na else col
+    is_int = math.isfinite(vmin) and math.isfinite(vmax) \
+        and math.floor(vmin) == vmin and math.floor(vmax) == vmax \
+        and bool(np.all(np.floor(valid) == valid))
     if is_int:
         span = vmax - vmin
         for kind, lim, dt in (("i8", 254, np.int8), ("i16", 65534, np.int16)):
@@ -92,7 +98,7 @@ def _choose_codec(col: np.ndarray, mask: np.ndarray):
         if -2**31 < vmin and vmax < 2**31 - 1:
             packed = np.where(mask, 0, filled).astype(np.int32)
             return packed, Codec("i32")
-    packed = np.where(mask, 0.0, filled).astype(np.float32)
+    packed = filled.astype(np.float32)  # NAs already zeroed in filled
     return packed, Codec("f32")
 
 
@@ -195,7 +201,7 @@ class Vec:
         n = len(col)
         pad = c.padded_rows(n)
         colp = np.zeros(pad, np.float64)
-        colp[:n] = np.where(mask, 0.0, col)
+        colp[:n] = np.where(mask, 0.0, col) if mask.any() else col
         maskp = np.ones(pad, bool)       # padding rows are NA
         maskp[:n] = mask
         packed, codec = _choose_codec(colp, maskp)
@@ -204,10 +210,12 @@ class Vec:
             mask_np = np.zeros(pad, np.uint8)
             mask_np[n:] = 1
         dom = np.asarray(domain, dtype=object) if domain is not None else None
-        if _tiering.PAGER.hbm_budget:
-            # budgeted ingest: park the codec bytes in the HOST tier and
-            # let first access fault them — an eager device_put here
-            # would spike HBM past the budget before the pager could act
+        if _tiering.PAGER.ingest_cold:
+            # budgeted/cold ingest: park the codec bytes in the HOST
+            # tier and let first access fault them — an eager device_put
+            # here would spike HBM past the budget before the pager
+            # could act (H2O3_TPU_INGEST_COLD forces this without a
+            # budget for spike-free bulk ingest)
             return Vec(None, codec, None, n, vtype, dom,
                        packed_host=packed, packed_mask=mask_np)
         data = _mr.device_put_rows(packed)

@@ -206,6 +206,18 @@ class ChunkPager:
         return bool(self.hbm_budget or self.host_budget
                     or env_bool("H2O3_TPU_TIERING", False))
 
+    @property
+    def ingest_cold(self) -> bool:
+        """Park newly-ingested packed planes in the HOST tier (born
+        cold, no device_put at parse): always under an HBM budget — an
+        eager put would spike past it before the pager could act — and
+        opt-in via H2O3_TPU_INGEST_COLD for budget-less runs that still
+        want spike-free bulk ingest (the distributed-parse coordinator
+        of a multi-controller cloud, where a device_put of globally
+        sharded planes from one process would wedge a collective)."""
+        return bool(self.hbm_budget
+                    or env_bool("H2O3_TPU_INGEST_COLD", False))
+
     def tick(self) -> int:
         return next(self._ticks)
 

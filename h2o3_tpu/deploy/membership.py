@@ -202,6 +202,15 @@ class Membership:
         with self._lock:
             return self._alive_locked()
 
+    def active(self) -> list:
+        """Workers eligible for NEW work (distributed-parse fan-out
+        shares): ACTIVE only — a DRAINING worker finishes its in-flight
+        replays and leaves, so handing it a fresh chunk share would
+        race the drain's quiesce wait."""
+        with self._lock:
+            return sorted(p for p, w in self._workers.items()
+                          if w["state"] == ACTIVE)
+
     def nodes(self) -> list:
         """Per-worker view for GET /3/Cloud."""
         with self._lock:
@@ -486,6 +495,12 @@ class ElasticBroadcaster(_mh.Broadcaster):
         out = super().collect(op, timeout=timeout)
         self._reconcile_dead()
         return out
+
+    def live_pids(self) -> list:
+        """Fan-out share-holders: the base live set minus DRAINING
+        workers (a drain must not be handed fresh parse chunks)."""
+        active = set(self.membership.active())
+        return [p for p in super().live_pids() if p in active]
 
     # ---- joins -----------------------------------------------------------
     def _accept_loop(self):

@@ -388,6 +388,13 @@ class Broadcaster:
             srv.close()
             self._srv = None
 
+    def live_pids(self) -> list:
+        """Process ids of workers still in the broadcast set — the
+        candidate share-holders for a distributed-parse fan-out."""
+        with self._lock:
+            return [p for i, p in enumerate(self._pids)
+                    if not self._dead[i]]
+
     def _recv_frame_at(self, i: int, timeout=None):
         """Like _recv_frame but RESUMABLE: bytes consumed before a timeout
         stay in the per-conn buffer, so abandoning a slow ack mid-frame
@@ -633,6 +640,21 @@ def _collect_local(op: str):
                 return {"host": me}
             return {"host": me, "name": name,
                     "log": _ulog.read_file(name)}
+        if op.startswith("parse:"):
+            # distributed-ingest fan-out (io/dparse): tokenize THIS
+            # host's chunk share and ack with compact codec-byte planes
+            # (the re-home wire format) — phase B of the cloud-wide
+            # parse runs as pure host work on every member
+            import json as _json
+            from h2o3_tpu.io import dparse as _dp
+            from h2o3_tpu.obs import timeline as _tl
+            spec = _json.loads(op[len("parse:"):])
+            share = (spec.get("shares") or {}).get(str(_tl.host_id()))
+            return {"host": _tl.host_id(),
+                    "parse": _dp.worker_parse_chunks(
+                        {"sep": spec.get("sep", ","),
+                         "header": spec.get("header", True),
+                         "chunks": share})}
         if op.startswith("profiler:"):
             # cluster-wide capture fan-out (POST /3/Profiler?cluster=1):
             # start/stop this host's profiler session; a sampling stop

@@ -7,33 +7,53 @@ categorical levels discovered per-chunk are merged cluster-wide and every
 chunk's codes renumbered against the global domain
 (ParseDataset.java:356-440 `MultiFileParseTask` + `EnumUpdateTask`).
 
-TPU-native shape of the same idea: tokenization is HOST work done by the
-native C++ range parser (native/fastcsv.cpp `fastcsv_parse_range`) under a
-thread pool — the ctypes call releases the GIL so ranges parse in true
-parallel on however many cores the host (or each host of a multi-host
-cloud) has. The two phases survive intact:
+TPU-native shape of the same idea — a cloud-wide, stage-overlapped
+pipeline:
 
-  phase A  chunk plan: every file split into ~`chunk_bytes` byte ranges
+  phase A  chunk plan: every source split into ~`chunk_bytes` byte ranges
            aligned to line boundaries by the chunk contract (a range
            starts after its first newline, ends through the line
-           straddling its end — each line parsed exactly once).
+           straddling its end — each line parsed exactly once). Local
+           files, HTTP/object-store URLs (io/uri range readers) and
+           gzip/zip members (streaming decompress into line-aligned
+           windows) all ride the same plan.
   phase B  parallel tokenize: each range → column-major doubles + string
-           side table (no global state, no locks).
+           side table via the native tokenizer (GIL-released
+           `fastcsv_parse_range`/`fastcsv_parse_bytes`), pooled with a
+           bounded read-ahead so read/decompress overlaps tokenize.
+           With a live cloud, chunks are deterministically fanned out
+           over the replay channel (consistent hash over (path, start)):
+           each host tokenizes its share and ships compact codec-byte
+           planes back (the DKV re-home wire format — never decoded
+           f32), while the coordinator parses its own share in parallel.
   phase C  merge: numeric columns concatenate; categorical columns do the
-           EnumUpdateTask dance — per-chunk local level sets union into a
-           sorted global domain, then each chunk's tokens renumber against
-           it — and the packed codes `device_put` with the mesh row
-           sharding (Vec._from_floats), so a multi-chip cloud receives the
-           frame already row-sharded.
+           EnumUpdateTask dance fully VECTORIZED — np.unique per-chunk
+           levels → sorted global domain → searchsorted renumber (no
+           per-row Python loops); time-column string fix-ups parse each
+           unique token once and scatter. Packed columns land in the
+           tier pager (born cold under a budget / H2O3_TPU_INGEST_COLD —
+           no device_put spike), else `device_put` with the mesh row
+           sharding.
 
 The single-file `parse()` path in io/parser.py remains the fallback for
-compressed inputs and hosts without the native library.
+non-CSV formats (ARFF/SVMLight) and anything else the chunk plan cannot
+express.
+
+Env knobs (utils/env typed accessors, declared here):
+  H2O3_PARSE_CHUNK_MB          chunk-plan granularity (default 64)
+  H2O3_PARSE_WORKERS           tokenizer pool size (0 = one per core)
+  H2O3_PARSE_READAHEAD         extra in-flight chunks beyond the pool
+  H2O3_PARSE_FANOUT_TIMEOUT_S  per-wave deadline for remote parse shares
 """
 
 from __future__ import annotations
 
+import base64
 import glob as _glob
+import itertools
 import os
+import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
@@ -42,21 +62,77 @@ import numpy as np
 from h2o3_tpu.core.frame import (Frame, T_CAT, T_NUM, T_STR, T_TIME,
                                  T_UUID, Vec)
 from h2o3_tpu.io.parser import (NA_TOKENS, ParseSetup, _num_token,
-                                _parse_time_ms, parse_setup)
+                                _parse_time_ms, pack_span, parse_setup)
+from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs.timeline import span as _span
+from h2o3_tpu.utils.env import env_float, env_int
 
 DEFAULT_CHUNK_BYTES = 64 << 20
+
+# per-stage ingest volume: read (remote range bytes fetched), decompress
+# (bytes inflated out of gzip/zip members), tokenize (bytes handed to a
+# tokenizer), pack (packed codec bytes landing in Vec planes), wire
+# (codec-byte planes shipped back by fan-out workers)
+INGEST_BYTES = _om.counter(
+    "h2o3_ingest_bytes_total",
+    "distributed-ingest pipeline volume by stage "
+    "(read/decompress/tokenize/pack/wire)")
+INGEST_ROWS = _om.counter(
+    "h2o3_ingest_rows_total",
+    "rows materialized into Frames by the distributed ingest pipeline")
+
+
+def _chunk_bytes_default() -> int:
+    """Chunk-plan granularity (H2O3_PARSE_CHUNK_MB, default 64MB — the
+    FileVec chunk-size analog)."""
+    return env_int("H2O3_PARSE_CHUNK_MB", 64) << 20
+
+
+def _pool_workers(n_units: int) -> int:
+    """Tokenizer pool size: H2O3_PARSE_WORKERS, 0 = one per core."""
+    w = env_int("H2O3_PARSE_WORKERS", 0) or (os.cpu_count() or 1)
+    return max(1, min(32, w, n_units))
+
+
+def _readahead() -> int:
+    """Extra chunks in flight beyond the pool — bounds raw-buffer memory
+    while keeping read/decompress ahead of tokenize."""
+    return max(1, env_int("H2O3_PARSE_READAHEAD", 4))
+
+
+def _fanout_timeout_s() -> float:
+    """WHOLE-WAVE deadline for the worker parse shares; a host that
+    blows its slice forfeits the wave AND its remaining shares (the
+    coordinator re-parses locally). The collect grants each worker its
+    slice SEQUENTIALLY while holding the broadcast lock, so the per-
+    worker slice is this value divided by the wave's host count —
+    replayed REST traffic stalls at most ~this long per wave even when
+    every worker is wedged."""
+    return env_float("H2O3_PARSE_FANOUT_TIMEOUT_S", 30.0)
+
+
+# wave budget: source bytes per worker per collect round, bounded so the
+# base64 codec-plane ack stays well under the replay channel's 64MB
+# frame cap. Worst case wire ≈ 2× source (incompressible f64 planes ≈
+# 8B per ~9B token, plus the string planes of a text-heavy share ≈ its
+# source bytes), ×4/3 base64 → 16MB source ≤ ~43MB ack
+_WAVE_BUDGET = 16 << 20
 
 
 # ---------------------------------------------------------------------------
 def expand_paths(paths) -> list:
-    """Accept a path, directory, glob pattern, or list thereof (the
-    h2o.import_file folder-import semantics: ImportFilesHandler)."""
+    """Accept a path, directory, glob pattern, remote URL, or list
+    thereof (the h2o.import_file folder-import semantics:
+    ImportFilesHandler)."""
+    from h2o3_tpu.io import uri as _uri
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
     out = []
     for p in paths:
         p = os.fspath(p)
-        if os.path.isdir(p):
+        if _uri.is_remote(p):
+            out.append(p)
+        elif os.path.isdir(p):
             out.extend(sorted(
                 os.path.join(p, f) for f in os.listdir(p)
                 if not f.startswith(".")
@@ -71,11 +147,14 @@ def expand_paths(paths) -> list:
 
 
 def plan_chunks(paths: Sequence[str],
-                chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> list:
-    """Phase A: [(path, start, end, is_file_head)] byte-range plan."""
+                chunk_bytes: Optional[int] = None) -> list:
+    """Phase A: [(path, start, end, is_file_head)] byte-range plan over
+    local files and remote URLs alike."""
+    from h2o3_tpu.io import uri as _uri
+    chunk_bytes = chunk_bytes or _chunk_bytes_default()
     plan = []
     for p in paths:
-        size = os.path.getsize(p)
+        size = _uri.path_size(p)
         n_chunks = max(1, -(-size // chunk_bytes))
         step = -(-size // n_chunks)
         for i in range(n_chunks):
@@ -84,34 +163,10 @@ def plan_chunks(paths: Sequence[str],
 
 
 # ---------------------------------------------------------------------------
-def _tokenize_range_py(path: str, sep: str, skip_header: bool,
-                       start: int, end: int):
-    """Python fallback for one byte range (same chunk contract as the
-    native parser); returns list of (numeric ndarray, {row: str})."""
-    import csv
-    import io as _io
-    size = os.path.getsize(path)
-    end = size if end < 0 else min(end, size)
-    with open(path, "rb") as f:
-        f.seek(end)
-        ext = end
-        while ext < size:
-            b = f.read(1 << 16)
-            if not b:
-                break
-            nl = b.find(b"\n")
-            if nl >= 0:
-                ext += nl + 1
-                break
-            ext += len(b)
-        f.seek(start)
-        buf = f.read(ext - start)
-    if start > 0:
-        nl = buf.find(b"\n")
-        buf = buf[nl + 1:] if nl >= 0 else b""
-    text = buf.decode("utf-8", "replace")
-    rows = [r for r in csv.reader(_io.StringIO(text), delimiter=sep) if r]
-    if skip_header and start == 0 and rows:
+# phase B: tokenizers (native fast path + pure-python fallback)
+def _rows_to_cols(rows, skip_header):
+    """csv-module rows → [(numeric ndarray, {row: str})] per column."""
+    if skip_header and rows:
         rows = rows[1:]
     ncol = max((len(r) for r in rows), default=0)
     cols = []
@@ -132,6 +187,45 @@ def _tokenize_range_py(path: str, sep: str, skip_header: bool,
     return cols
 
 
+def _tokenize_bytes_py(buf: bytes, sep: str, skip_header: bool,
+                       skip_partial_first: bool = False):
+    """Pure-python tokenizer over staged bytes (same chunk contract as
+    the native `fastcsv_parse_bytes`)."""
+    import csv
+    import io as _io
+    if skip_partial_first:
+        nl = buf.find(b"\n")
+        buf = buf[nl + 1:] if nl >= 0 else b""
+        skip_header = False
+    text = buf.decode("utf-8", "replace")
+    rows = [r for r in csv.reader(_io.StringIO(text), delimiter=sep) if r]
+    return _rows_to_cols(rows, skip_header)
+
+
+def _tokenize_range_py(path: str, sep: str, skip_header: bool,
+                       start: int, end: int):
+    """Python fallback for one byte range (same chunk contract as the
+    native parser); returns list of (numeric ndarray, {row: str})."""
+    size = os.path.getsize(path)
+    end = size if end < 0 else min(end, size)
+    with open(path, "rb") as f:
+        f.seek(end)
+        ext = end
+        while ext < size:
+            b = f.read(1 << 16)
+            if not b:
+                break
+            nl = b.find(b"\n")
+            if nl >= 0:
+                ext += nl + 1
+                break
+            ext += len(b)
+        f.seek(start)
+        buf = f.read(ext - start)
+    return _tokenize_bytes_py(buf, sep, skip_header and start == 0,
+                              skip_partial_first=start > 0)
+
+
 def _tokenize_range(path, sep, skip_header, start, end):
     from h2o3_tpu.io import fastcsv
     if fastcsv.available():
@@ -140,48 +234,457 @@ def _tokenize_range(path, sep, skip_header, start, end):
     return _tokenize_range_py(path, sep, skip_header, start, end)
 
 
+def _tokenize_bytes(buf, sep, skip_header, skip_partial_first=False):
+    from h2o3_tpu.io import fastcsv
+    if fastcsv.available():
+        return fastcsv.parse_bytes_columns(
+            buf, sep, skip_header, skip_partial_first=skip_partial_first)
+    return _tokenize_bytes_py(buf, sep, skip_header,
+                              skip_partial_first=skip_partial_first)
+
+
+def _read_remote_chunk(path: str, start: int, end: int) -> bytes:
+    """Range-read one remote chunk plus enough slack to cover the line
+    straddling `end` (the native extend-through-the-line step, done with
+    HTTP/object-store range requests). EOF is detected from a SHORT
+    read — no per-chunk size probe (a 10GB source at 64MB chunks would
+    otherwise issue ~160 redundant HEADs across the fan-out)."""
+    from h2o3_tpu.io import uri as _uri
+    slack = 1 << 16
+    buf = b""
+    while True:
+        lo = start + len(buf)          # fetch only the missing tail —
+        hi = end + slack               # never re-download fetched bytes
+        with _span("parse.read", start=lo, end=hi):
+            part = _uri.read_range(path, lo, hi)
+        INGEST_BYTES.inc(len(part), stage="read")
+        eof = len(part) < hi - lo
+        buf += part
+        if len(buf) > end - start:
+            nl = buf.find(b"\n", end - start)
+            if nl >= 0:
+                return buf[:nl + 1]    # cut through the straddling line
+        if eof:
+            return buf                 # no newline after end before EOF
+        slack *= 4
+
+
+def _tokenize_chunk(chunk, setup: ParseSetup):
+    """One plan entry → [(num, smap)] per column (local or remote)."""
+    from h2o3_tpu.io import uri as _uri
+    path, start, end, head = chunk
+    header = bool(setup.header and head)
+    if _uri.is_remote(path):
+        buf = _read_remote_chunk(path, start, end)
+        return _tokenize_bytes(buf, setup.separator, header,
+                               skip_partial_first=start > 0)
+    return _tokenize_range(path, setup.separator, header, start, end)
+
+
+def _pipelined(units, fn, workers: int):
+    """Run `fn` over `units` with a bounded in-flight window, yielding
+    results IN ORDER: the read/decompress producer stays `readahead`
+    chunks ahead of the tokenizer pool, never further (bounds buffer
+    memory for a 100GB source at a few chunks, not the whole file)."""
+    if workers <= 1:
+        for u in units:
+            yield fn(u)
+        return
+    window = workers + _readahead()
+    with ThreadPoolExecutor(workers) as ex:
+        it = iter(units)
+        pending = deque(ex.submit(fn, u)
+                        for u in itertools.islice(it, window))
+        while pending:
+            res = pending.popleft().result()
+            nxt = next(it, None)
+            if nxt is not None:
+                pending.append(ex.submit(fn, nxt))
+            yield res
+
+
+def _compressed_units(path: str, chunk_bytes: int):
+    """Streaming-decompress a .gz/.zip member into line-aligned byte
+    windows of ~chunk_bytes — compressed sources join the chunked
+    pipeline via one sequential inflate pass instead of falling back to
+    a whole-file sequential parse."""
+    import gzip
+    import zipfile
+    if path.endswith(".gz"):
+        stream = gzip.open(path, "rb")
+    else:
+        zf = zipfile.ZipFile(path)
+        stream = zf.open(zf.namelist()[0])
+    carry = b""
+    first = True
+    with stream:
+        while True:
+            with _span("parse.decompress", file=os.path.basename(path)):
+                blk = stream.read(chunk_bytes)
+            if not blk:
+                break
+            INGEST_BYTES.inc(len(blk), stage="decompress")
+            buf = carry + blk
+            nl = buf.rfind(b"\n")
+            if nl < 0:
+                carry = buf
+                continue
+            yield buf[:nl + 1], first
+            first = False
+            carry = buf[nl + 1:]
+    if carry:
+        yield carry, first
+
+
 # ---------------------------------------------------------------------------
-def _chunk_tokens(num: np.ndarray, smap: dict) -> np.ndarray:
-    """Reconstruct the token strings of a categorical/string chunk column
-    (numeric-looking tokens came through as doubles)."""
-    toks = np.empty(len(num), object)
-    nn = ~np.isnan(num)
-    # shortest round-trip reconstruction — '%g' truncated long numeric IDs
-    toks[nn] = [_num_token(v) for v in num[nn]]
-    for i, s in smap.items():
-        toks[i] = s
-    return toks
+# fan-out: ship chunk shares over the replay channel (collect op
+# "parse:<json>"), workers answer with compact codec-byte planes — the
+# DKV re-home wire format (core/kvstore._plane_payload), never decoded
+# f32, bit-exact by construction.
+def _wire_pack_col(num: np.ndarray, smap: dict) -> dict:
+    """Pack one chunk column for the wire — `_choose_codec` (the one
+    narrowing-logic owner) with a LOSSLESS float policy layered on top:
+    its f32 downgrade ships only when every value round-trips, raw f64
+    otherwise, so the coordinator's merge sees bit-identical doubles to
+    a local tokenize."""
+    from h2o3_tpu.core.frame import _choose_codec
+    from h2o3_tpu.core.kvstore import _plane_payload
+    mask = np.isnan(num)
+    has_na = bool(mask.any())
+    packed, codec = _choose_codec(num, mask)
+    kind, bias, cval = codec.kind, float(codec.bias), 0.0
+    if kind in ("const", "i8", "i16", "i32") and bool(
+            np.any((num == 0.0) & np.signbit(num) & ~mask)):
+        # negative zero doesn't survive the integer/const round trip
+        # (-0.0 - bias + bias = +0.0), and the merge keeps "-0" a
+        # DISTINCT categorical level — ship raw f64 for these rare
+        # columns so fanned-out parses stay bit-identical to local
+        packed = num
+        kind = "f64"
+    if kind == "const":
+        cval = float(codec.const_val)
+        packed = np.zeros(0, np.int8)        # value rides in `c`
+    elif kind == "f32" and not np.array_equal(
+            packed.astype(np.float64), np.where(mask, 0.0, num),
+            equal_nan=True):
+        packed = num                         # f32 would lose bits
+        kind = "f64"
+    payload = _plane_payload(packed,
+                             mask.astype(np.uint8) if has_na else None)
+    out = {"p": base64.b64encode(payload).decode("ascii"),
+           "k": kind, "b": bias, "c": cval, "n": int(len(num))}
+    wire_len = len(payload)
+    if smap:
+        # string cells ship as npz planes too (rows/lens/utf-8 bytes) —
+        # a JSON dict per cell would inflate text-heavy shares several×
+        # past the replay channel's frame cap and get the worker
+        # wrongly excised for answering with an oversized ack
+        import io as _io
+        rows = np.fromiter(smap.keys(), np.int64, len(smap))
+        vals = [s.encode("utf-8") for s in smap.values()]
+        lens = np.asarray([len(v) for v in vals], np.int32)
+        blob = np.frombuffer(b"".join(vals), np.uint8)
+        buf = _io.BytesIO()
+        np.savez(buf, rows=rows, lens=lens, blob=blob)
+        spay = buf.getvalue()
+        out["s"] = base64.b64encode(spay).decode("ascii")
+        wire_len += len(spay)
+    INGEST_BYTES.inc(wire_len, stage="wire")
+    return out
+
+
+def _wire_restore_col(w: dict):
+    """Inverse of _wire_pack_col → (float64 ndarray, {row: str})."""
+    import io as _io
+    from h2o3_tpu.core.kvstore import _plane_restore
+    data, mask = _plane_restore(base64.b64decode(w["p"]))
+    n = int(w["n"])
+    kind = w["k"]
+    if kind == "const":
+        num = np.full(n, float(w.get("c", float("nan"))))
+    else:
+        num = data.astype(np.float64)
+        if w.get("b"):
+            num += float(w["b"])
+    if mask is not None:
+        num[mask.astype(bool)] = np.nan
+    smap = {}
+    if w.get("s"):
+        with np.load(_io.BytesIO(base64.b64decode(w["s"])),
+                     allow_pickle=False) as z:
+            rows, lens = z["rows"], z["lens"]
+            blob = z["blob"].tobytes()
+        offs = np.concatenate([[0], np.cumsum(lens)])
+        for i in range(len(rows)):
+            smap[int(rows[i])] = blob[offs[i]:offs[i + 1]].decode(
+                "utf-8", "replace")
+    return num, smap
+
+
+# hard bound on one parse ack's wire payload: whatever the wave-budget
+# heuristic predicted, the ENCODED ack must stay under the replay
+# channel's 64MB frame cap with headroom for JSON/HMAC framing — chunks
+# that don't fit are simply left out of the answer and the coordinator
+# re-parses them locally (the protocol already tolerates partial acks)
+_ACK_WIRE_CAP = 44 << 20
+
+
+def worker_parse_chunks(spec: dict) -> dict:
+    """Worker side of the parse fan-out (multihost._collect_local
+    `parse:` op): tokenize this host's chunk share — entries are
+    [path, start, end, is_head, plan_index] — through the local pipeline
+    and return wire-packed codec planes per plan index, truncated at
+    _ACK_WIRE_CAP so a worst-case column mix (short f64 tokens) can
+    never produce an oversized frame that gets this worker excised."""
+    setup = ParseSetup(separator=spec.get("sep", ","),
+                       header=bool(spec.get("header", True)))
+    chunks = [tuple(c) for c in spec.get("chunks") or []]
+    if not chunks:
+        return {"chunks": {}}
+    out = {}
+    wire = 0
+    for idx, cols in zip(
+            [c[4] for c in chunks],
+            _pipelined([c[:4] for c in chunks],
+                       lambda c: _tokenize_chunk(c, setup),
+                       _pool_workers(len(chunks)))):
+        if wire >= _ACK_WIRE_CAP:
+            continue            # drained, not returned: local fallback
+        packed = [_wire_pack_col(num, smap) for num, smap in cols]
+        wire += sum(len(w["p"]) + len(w.get("s") or "") for w in packed)
+        out[str(idx)] = packed
+    return {"chunks": out}
+
+
+def _assign_chunks(plan, nodes):
+    """Deterministic chunk → node map: consistent hash over
+    (path, start) against the sorted live node set (the Key.java home
+    hash reused for parse work) — replay-safe (R016): same plan + same
+    membership ⇒ same assignment on every host, no RNG, no wall clock."""
+    from h2o3_tpu.core.kvstore import HashRing
+    ring = HashRing(nodes)
+    return [ring.node_for(f"{c[0]}:{c[1]}") for c in plan]
+
+
+def _fan_out_parse(bc, plan, assign, setup, results, done_flags):
+    """Coordinator side: wave the worker shares over the replay channel
+    (bounded per-wave payload so the base64 codec-plane acks stay under
+    the frame cap), restoring codec planes into `results`. A worker that
+    times out, errors or was excised mid-wave simply leaves its chunks
+    unparsed — the caller re-runs them locally."""
+    import json as _json
+    pids = sorted(set(a for a in assign if a != 0))
+    shares = {p: [i for i, a in enumerate(assign) if a == p]
+              for p in pids}
+    waves = []
+    while any(shares.values()):
+        wave = {}
+        for p, idxs in shares.items():
+            take, budget = [], 0
+            while idxs:
+                size = plan[idxs[0]][2] - plan[idxs[0]][1]
+                if take and budget + size > _WAVE_BUDGET:
+                    break      # bound holds: never overshoot by a chunk
+                take.append(idxs.pop(0))
+                budget += size
+            if take:
+                wave[p] = take
+        waves.append(wave)
+    forfeited: set = set()
+    for wave in waves:
+        wave = {p: idxs for p, idxs in wave.items()
+                if p not in forfeited}
+        if not wave:
+            continue
+        spec_shares = {str(p): [list(plan[i][:4]) + [i] for i in idxs]
+                       for p, idxs in wave.items()}
+        op = "parse:" + _json.dumps(
+            {"sep": setup.separator, "header": bool(setup.header),
+             "shares": spec_shares})
+        with _span("parse.fanout", chunks=sum(map(len, wave.values())),
+                   hosts=len(wave)):
+            acks = bc.collect(
+                op, timeout=_fanout_timeout_s() / max(1, len(wave)))
+        answered = set()
+        for ack in acks:
+            if not ack or not isinstance(ack.get("parse"), dict):
+                continue
+            answered.add(ack.get("host"))
+            for sidx, cols in (ack["parse"].get("chunks") or {}).items():
+                i = int(sidx)
+                results[i] = [_wire_restore_col(w) for w in cols]
+                done_flags[i] = True
+        # a worker that blew the wave deadline (or died) would stall
+        # every later wave for the full timeout again while holding the
+        # broadcast lock — drop its remaining shares to the local
+        # fallback instead
+        forfeited.update(p for p in wave if p not in answered)
 
 
 def parse_files(paths, setup: Optional[ParseSetup] = None,
                 destination_frame: Optional[str] = None,
                 col_types: Optional[dict] = None,
-                chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                workers: Optional[int] = None) -> Frame:
-    """Phase B+C: byte-range-parallel multi-file parse to one Frame."""
+                chunk_bytes: Optional[int] = None,
+                workers: Optional[int] = None,
+                broadcaster=None) -> Frame:
+    """Phase B+C: byte-range-parallel multi-file parse to one Frame.
+
+    With `broadcaster` (a live replay-channel coordinator), the chunk
+    plan fans out cloud-wide: each worker tokenizes its consistent-hash
+    share and ships codec-byte planes back while the coordinator parses
+    its own share — the MultiFileParseTask shape. Without one, the full
+    plan runs through the local bounded pipeline."""
+    from h2o3_tpu.io import uri as _uri
     paths = expand_paths(paths)
-    setup = setup or parse_setup(paths[0])
-    if setup.parse_type != "CSV" or any(
-            p.endswith((".gz", ".zip")) for p in paths):
-        # non-CSV / compressed: fall back to sequential per-file parse + rbind
+    # remote compressed sources stage to local ONCE, up front: gzip/zip
+    # need seekable local bytes for both setup sniffing and the
+    # streaming inflate (range-reading raw gzip bytes and sniffing them
+    # as CSV text would crash on the magic bytes)
+    staged: list = []
+    try:
+        for i, p in enumerate(paths):
+            if p.endswith((".gz", ".zip")) and _uri.is_remote(p):
+                lp = _uri.fetch_to_local(p)
+                staged.append(lp)
+                paths[i] = lp
+        return _parse_files_inner(paths, setup, destination_frame,
+                                  col_types, chunk_bytes, workers,
+                                  broadcaster)
+    finally:
+        for lp in staged:
+            try:
+                os.unlink(lp)
+            except OSError:
+                pass
+
+
+def _parse_files_inner(paths, setup, destination_frame, col_types,
+                       chunk_bytes, workers, broadcaster) -> Frame:
+    setup = setup or _setup_for(paths[0])
+    chunk_bytes = chunk_bytes or _chunk_bytes_default()
+    if setup.parse_type != "CSV":
+        # non-CSV (ARFF/SVMLight): sequential per-file parse + rbind
         from h2o3_tpu.io.parser import parse as _parse1
         frames = [_parse1(p, None if i else setup, None, col_types)
                   for i, p in enumerate(paths)]
         return _rbind_frames(frames, destination_frame)
 
-    plan = plan_chunks(paths, chunk_bytes)
-    workers = workers or min(32, (os.cpu_count() or 1), len(plan))
-    if workers > 1:
-        with ThreadPoolExecutor(workers) as ex:
-            chunks = list(ex.map(
-                lambda c: _tokenize_range(c[0], setup.separator,
-                                          setup.header and c[3],
-                                          c[1], c[2]), plan))
-    else:
-        chunks = [_tokenize_range(c[0], setup.separator,
-                                  setup.header and c[3], c[1], c[2])
-                  for c in plan]
+    # live-worker set read ONCE: both the chunk-size cap and the
+    # assignment must see the same membership (a worker joining between
+    # two reads could be handed uncapped chunks whose ack blows the
+    # frame cap)
+    pids = broadcaster.live_pids() if broadcaster is not None else []
+    if pids:
+        # fan-out chunks must fit one wave (a chunk's codec-plane ack
+        # has to stay under the replay channel's frame cap — shipping a
+        # 64MB chunk would get the answering worker wrongly excised for
+        # an oversized frame)
+        chunk_bytes = min(chunk_bytes, _WAVE_BUDGET)
 
+    plain = [p for p in paths if not p.endswith((".gz", ".zip"))]
+
+    plan = plan_chunks(plain, chunk_bytes) if plain else []
+    results: dict = {}
+    if plan:
+        done = [False] * len(plan)
+        assign = [0] * len(plan)
+        fan_thread = None
+        if broadcaster is not None:
+            if pids:
+                assign = _assign_chunks(plan, [0] + pids)
+                fan_thread = threading.Thread(
+                    target=_fan_out_parse,
+                    args=(broadcaster, plan, assign, setup, results,
+                          done),
+                    daemon=True, name="h2o3-parse-fanout")
+                fan_thread.start()
+        mine = [i for i, a in enumerate(assign) if a == 0]
+        for i, cols in zip(
+                mine,
+                _pipelined([plan[i] for i in mine],
+                           lambda c: _tokenize_chunk(c, setup),
+                           workers or _pool_workers(len(mine) or 1))):
+            results[i] = cols
+            done[i] = True
+        if fan_thread is not None:
+            fan_thread.join()
+            # any share a worker forfeited (timeout/excision) re-parses
+            # locally so the frame always completes
+            missing = [i for i in range(len(plan)) if not done[i]]
+            for i, cols in zip(
+                    missing,
+                    _pipelined([plan[i] for i in missing],
+                               lambda c: _tokenize_chunk(c, setup),
+                               workers or _pool_workers(
+                                   len(missing) or 1))):
+                results[i] = cols
+        for p, start, end, _h in plan:
+            INGEST_BYTES.inc(end - start, stage="tokenize")
+
+    # assemble in PATH order (plan indices are contiguous per plain
+    # path; compressed members expand in place) — mixing .gz and plain
+    # inputs must not reorder rows vs the paths the caller gave. Each
+    # occurrence of a path is its own group (a new occurrence starts at
+    # an is_file_head entry), so duplicated paths keep their positions.
+    occ: dict = {}
+    for i, entry in enumerate(plan):
+        if entry[3]:
+            occ.setdefault(entry[0], deque()).append([])
+        occ[entry[0]][-1].append(i)
+    chunks: list = []          # tokenized results, source order
+    for p in paths:
+        if not p.endswith((".gz", ".zip")):
+            grp = occ[p].popleft() if occ.get(p) else []
+            chunks.extend(results[i] for i in grp)
+            continue
+        chunks.extend(_parse_compressed(p, setup, chunk_bytes, workers))
+
+    return _merge_chunks(chunks, setup, destination_frame, col_types)
+
+
+def _parse_compressed(path: str, setup: ParseSetup, chunk_bytes: int,
+                      workers) -> list:
+    """Tokenize one LOCAL .gz/.zip member through the streaming
+    pipeline (parse_files staged any remote compressed source before
+    this runs — staging has exactly one owner)."""
+    units = _compressed_units(path, chunk_bytes)
+    return list(_pipelined(
+        units,
+        lambda u, _s=setup: _tokenize_bytes(
+            u[0], _s.separator, bool(_s.header and u[1])),
+        workers or _pool_workers(8)))
+
+
+def _setup_for(path: str) -> ParseSetup:
+    """parse_setup, staging a head sample locally for remote URLs
+    (remote COMPRESSED paths never reach here — parse_files stages them
+    whole first, and parse_setup handles local .gz/.zip itself)."""
+    from h2o3_tpu.io import uri as _uri
+    if not _uri.is_remote(path):
+        return parse_setup(path)
+    import tempfile
+    want = 1 << 18
+    head = _uri.read_range(path, 0, want)
+    if len(head) >= want:
+        # the sample cuts mid-line: a truncated final token must not
+        # participate in type/column guessing (a half time-stamp would
+        # flip the whole column to enum). A short read means EOF — the
+        # whole file is the sample (and no size probe was needed).
+        nl = head.rfind(b"\n")
+        if nl >= 0:
+            head = head[:nl + 1]
+    fd, tmp = tempfile.mkstemp(suffix=".csv")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(head)
+        return parse_setup(tmp)
+    finally:
+        os.unlink(tmp)
+
+
+# ---------------------------------------------------------------------------
+# phase C: vectorized merge
+def _merge_chunks(chunks, setup, destination_frame, col_types) -> Frame:
     ncol = max((len(c) for c in chunks), default=0)
     names = list(setup.column_names)
     types = list(setup.column_types)
@@ -197,60 +700,156 @@ def parse_files(paths, setup: Optional[ParseSetup] = None,
     n = int(sum(rows_per))
     offs = np.concatenate([[0], np.cumsum(rows_per)]).astype(np.int64)
 
-    vecs = []
-    for j in range(ncol):
+    # merge phase: vectorized host-array assembly, one column per pool
+    # thread (the big numpy ops — concatenate, unique, searchsorted —
+    # release the GIL, so columns merge in true parallel)
+    def _merge_col(j):
         parts = [c[j] if j < len(c) else
-                 (np.full(r, np.nan), {}) for c, r in zip(chunks, rows_per)]
+                 (np.full(r, np.nan), {})
+                 for c, r in zip(chunks, rows_per)]
         t = types[j]
         if t == T_NUM:
-            vecs.append(Vec.from_numpy(
-                np.concatenate([p[0] for p in parts]) if parts
-                else np.empty(0), type=T_NUM))
-        elif t == T_TIME:
-            num = np.concatenate([p[0] for p in parts])
-            for k, (pnum, smap) in enumerate(parts):
-                for i, s in smap.items():
-                    try:
-                        num[offs[k] + i] = _parse_time_ms(s)
-                    except ValueError:
-                        num[offs[k] + i] = np.nan
-            vecs.append(Vec.from_numpy(num, type=T_TIME))
-        elif t == T_STR:
+            return ("num", np.concatenate(
+                [p[0] for p in parts]) if parts else np.empty(0))
+        if t == T_TIME:
+            return ("time", _merge_time(parts, offs))
+        if t in (T_STR, T_UUID):
             toks = np.concatenate(
                 [_chunk_tokens(*p) for p in parts]) if parts else \
                 np.empty(0, object)
-            vecs.append(Vec.from_numpy(toks, type=T_STR))
-        elif t == T_UUID:
-            from h2o3_tpu.core.frame import UuidVec
-            toks = np.concatenate(
-                [_chunk_tokens(*p) for p in parts]) if parts else \
-                np.empty(0, object)
-            vecs.append(UuidVec.encode(toks))
+            return ("str" if t == T_STR else "uuid", toks)
+        return ("cat", _merge_categorical(parts, n, offs))
+
+    with _span("parse.merge", cols=ncol, chunks=len(chunks), rows=n):
+        mw = _pool_workers(ncol or 1)
+        if mw > 1:
+            with ThreadPoolExecutor(mw) as ex:
+                merged = list(ex.map(_merge_col, range(ncol)))
         else:
-            vecs.append(_merge_categorical(parts, n, offs))
+            merged = [_merge_col(j) for j in range(ncol)]
+    # pack phase: merged host arrays → codec-packed Vec planes (born
+    # cold into the tier pager under a budget / H2O3_TPU_INGEST_COLD)
+    vecs = []
+    with pack_span(cols=ncol):
+        for kind, payload in merged:
+            if kind == "num":
+                vecs.append(Vec.from_numpy(payload, type=T_NUM))
+            elif kind == "time":
+                vecs.append(Vec.from_numpy(payload, type=T_TIME))
+            elif kind == "str":
+                vecs.append(Vec.from_numpy(payload, type=T_STR))
+            elif kind == "uuid":
+                from h2o3_tpu.core.frame import UuidVec
+                vecs.append(UuidVec.encode(payload))
+            else:
+                codes, mask, domain = payload
+                vecs.append(Vec._from_floats(codes, mask, T_CAT, domain))
+    for v in vecs:
+        ch = getattr(v, "_chunk", None)
+        if ch is not None:
+            INGEST_BYTES.inc(ch.nbytes, stage="pack")
+    INGEST_ROWS.inc(n)
     return Frame(names[:ncol], vecs, destination_frame)
 
 
-def _merge_categorical(parts, n: int, offs: np.ndarray) -> Vec:
-    """Phase C cat merge (EnumUpdateTask): union per-chunk levels into one
-    sorted global domain, renumber each chunk's codes against it."""
-    locals_ = [_chunk_tokens(*p) for p in parts]
-    levels = set()
-    for toks in locals_:
-        levels.update(str(t) for t in toks if t is not None)
-    domain = np.asarray(sorted(levels), dtype=object)
-    lookup = {s: i for i, s in enumerate(domain)}
+def _merge_time(parts, offs: np.ndarray) -> np.ndarray:
+    """Time-column merge: numeric chunks concatenate; string tokens are
+    batched — each UNIQUE token parses once, then scatters (the per-row
+    `_parse_time_ms` dict loop was most of time-column ingest)."""
+    num = np.concatenate([p[0] for p in parts]) if parts \
+        else np.empty(0, np.float64)
+    rows_l, vals = [], []
+    for k, (_pnum, smap) in enumerate(parts):
+        if smap:
+            rows_l.append(np.fromiter(smap.keys(), np.int64,
+                                      len(smap)) + offs[k])
+            vals.extend(smap.values())
+    if vals:
+        uvals, inv = np.unique(np.asarray(vals, dtype=object),
+                               return_inverse=True)
+        parsed = np.empty(len(uvals), np.float64)
+        for i, s in enumerate(uvals):
+            try:
+                parsed[i] = _parse_time_ms(s)
+            except ValueError:
+                parsed[i] = np.nan
+        num[np.concatenate(rows_l)] = parsed[inv]
+    return num
+
+
+def _chunk_level_codes(num: np.ndarray, smap: dict):
+    """One chunk column → (sorted unique token levels, int codes with
+    -1 = NA). Numeric-looking tokens reconstruct through `_num_token`
+    over the UNIQUE values only; per-row work is numpy gathers."""
+    codes = np.full(len(num), -1, np.int64)
+    nn = ~np.isnan(num)
+    # negative zero: np.unique collapses -0.0 into 0.0, but the source
+    # tokens "-0" and "0" are DISTINCT levels (_num_token keeps the
+    # sign) — route -0.0 rows through the string side instead
+    nz = nn & (num == 0.0) & np.signbit(num)
+    if nz.any():
+        nn = nn & ~nz
+    u_num, inv = (np.unique(num[nn], return_inverse=True)
+                  if nn.any() else (np.empty(0), np.empty(0, np.int64)))
+    num_toks = np.asarray([_num_token(v) for v in u_num], dtype=object)
+    if smap:
+        srows = np.fromiter(smap.keys(), np.int64, len(smap))
+        svals = np.asarray(list(smap.values()), dtype=object)
+        u_str, sinv = np.unique(svals, return_inverse=True)
+    else:
+        srows = np.empty(0, np.int64)
+        u_str = np.empty(0, object)
+        sinv = np.empty(0, np.int64)
+    parts = [num_toks, u_str]
+    if nz.any():
+        parts.append(np.asarray([_num_token(-0.0)], dtype=object))
+    levels = np.unique(np.concatenate(parts)) \
+        if any(len(p) for p in parts) else np.empty(0, object)
+    if nn.any():
+        codes[nn] = np.searchsorted(levels, num_toks)[inv]
+    if len(srows):
+        codes[srows] = np.searchsorted(levels, u_str)[sinv]
+    if nz.any():
+        codes[nz] = int(np.searchsorted(levels, _num_token(-0.0)))
+    return levels, codes
+
+
+def _chunk_tokens(num: np.ndarray, smap: dict) -> np.ndarray:
+    """Reconstruct the token strings of a string/uuid chunk column
+    (numeric-looking tokens came through as doubles; None = NA). Object
+    gathers over unique values — no per-row Python loop."""
+    levels, codes = _chunk_level_codes(num, smap)
+    toks = np.empty(len(num), object)
+    ok = codes >= 0
+    toks[ok] = levels[codes[ok]]
+    return toks
+
+
+def _merge_categorical(parts, n: int, offs: np.ndarray):
+    """Phase C cat merge (EnumUpdateTask), vectorized: per-chunk unique
+    levels union into one sorted global domain (np.unique), each chunk's
+    codes renumber through a searchsorted remap table — replaces the
+    per-row Python dict loop that dominated categorical ingest.
+    Returns (codes f64, NA mask, domain) for the pack phase."""
+    per_chunk = [_chunk_level_codes(*p) for p in parts]
+    all_levels = [lv for lv, _c in per_chunk if len(lv)]
+    domain = np.unique(np.concatenate(all_levels)) if all_levels \
+        else np.empty(0, object)
     codes = np.empty(n, np.float64)
     mask = np.zeros(n, bool)
-    for k, toks in enumerate(locals_):
+    for k, (levels, ccodes) in enumerate(per_chunk):
         o = int(offs[k])
-        for i, t in enumerate(toks):
-            if t is None:
-                codes[o + i] = 0.0
-                mask[o + i] = True
-            else:
-                codes[o + i] = lookup[str(t)]
-    return Vec._from_floats(codes, mask, T_CAT, domain)
+        e = o + len(ccodes)
+        remap = np.searchsorted(domain, levels).astype(np.int64) \
+            if len(levels) else np.empty(0, np.int64)
+        na = ccodes < 0
+        out = np.zeros(len(ccodes), np.float64)
+        if len(levels):
+            ok = ~na
+            out[ok] = remap[ccodes[ok]]
+        codes[o:e] = out
+        mask[o:e] = na
+    return codes, mask, domain
 
 
 def _rbind_frames(frames, dest) -> Frame:
@@ -267,15 +866,25 @@ def _rbind_frames(frames, dest) -> Frame:
             vecs.append(Vec.from_numpy(
                 np.concatenate([v.host_data for v in vts]), type=T_STR))
         elif vts[0].type == T_CAT:
-            dom = sorted({lv for v in vts for lv in (v.levels() or [])})
-            lut = {lv: i for i, lv in enumerate(dom)}
+            # searchsorted renumber, same as the chunked merge — the old
+            # per-element list comprehension re-hashed every row through
+            # a Python dict (quadratically worse than the path it backs
+            # up for wide domains)
+            doms = [np.asarray(v.levels() or [], dtype=object)
+                    for v in vts]
+            nonempty = [d for d in doms if len(d)]
+            dom = np.unique(np.concatenate(nonempty)) if nonempty \
+                else np.empty(0, object)
             cols = []
-            for v in vts:
+            for v, d in zip(vts, doms):
                 c_np = v.to_numpy()
-                vdom = v.levels() or []
-                cols.append(np.array(
-                    [np.nan if np.isnan(x) else lut[vdom[int(x)]]
-                     for x in c_np], np.float64))
+                remap = np.searchsorted(dom, d).astype(np.float64) \
+                    if len(d) else np.empty(0, np.float64)
+                out = np.full(len(c_np), np.nan)
+                ok = ~np.isnan(c_np)
+                if len(d):
+                    out[ok] = remap[c_np[ok].astype(np.int64)]
+                cols.append(out)
             merged = np.concatenate(cols)
             mask = np.isnan(merged)
             vecs.append(Vec._from_floats(
@@ -290,9 +899,10 @@ def _rbind_frames(frames, dest) -> Frame:
 
 def import_files(paths, destination_frame: Optional[str] = None,
                  col_types: Optional[dict] = None,
-                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                 workers: Optional[int] = None) -> Frame:
-    """h2o.import_file(path=folder/pattern/list) analog on the distributed
-    parse path."""
+                 chunk_bytes: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 broadcaster=None) -> Frame:
+    """h2o.import_file(path=folder/pattern/list/URL) analog on the
+    distributed parse path."""
     return parse_files(paths, None, destination_frame, col_types,
-                       chunk_bytes, workers)
+                       chunk_bytes, workers, broadcaster=broadcaster)

@@ -1,10 +1,16 @@
 """ctypes bridge to the native CSV parser (native/fastcsv.cpp).
 
 The reference's ingest hot loop is a JVM per-byte tokenizer
-(water/parser/CsvParser.java); here it's a C++ pass exporting column-major
-doubles + a string side table over a C ABI (no pybind11 in the image).
-Build: `make -C native` (or scripts/build_native.sh); the Python parser falls
-back to the csv module when the library is absent.
+(water/parser/CsvParser.java); here it's a C++ pass with an in-place
+numeric fast path (exact Clinger fast-float + SWAR digit extraction, see
+fastcsv.cpp) exporting column-major doubles + a string side table over a
+C ABI (no pybind11 in the image). Two entry points feed the distributed
+ingest pipeline (io/dparse.py): `parse_columns` for byte ranges of local
+files (the native code does its own read, so pool threads overlap read
+with tokenize) and `parse_bytes_columns` for caller-staged buffers
+(streaming-decompressed gzip/zip windows, HTTP/object-store range reads).
+Build: `make -C native` (or scripts/build_native.sh); the Python parser
+falls back to the csv module when the library is absent.
 """
 
 from __future__ import annotations
@@ -47,6 +53,10 @@ def _lib():
         lib.fastcsv_parse_range.argtypes = [ctypes.c_char_p, ctypes.c_char,
                                             ctypes.c_long, ctypes.c_long,
                                             ctypes.c_int]
+        lib.fastcsv_parse_bytes.restype = ctypes.c_void_p
+        lib.fastcsv_parse_bytes.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                            ctypes.c_char, ctypes.c_int,
+                                            ctypes.c_int]
         lib.fastcsv_nrows.restype = ctypes.c_int64
         lib.fastcsv_nrows.argtypes = [ctypes.c_void_p]
         lib.fastcsv_ncols.restype = ctypes.c_int64
@@ -63,6 +73,18 @@ def _lib():
         lib.fastcsv_str_val.restype = ctypes.c_char_p
         lib.fastcsv_str_val.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                         ctypes.c_int64]
+        lib.fastcsv_str_rows_ptr.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.fastcsv_str_rows_ptr.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int64]
+        lib.fastcsv_str_lens_ptr.restype = ctypes.POINTER(ctypes.c_int32)
+        lib.fastcsv_str_lens_ptr.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int64]
+        lib.fastcsv_str_bytes_ptr.restype = ctypes.POINTER(ctypes.c_char)
+        lib.fastcsv_str_bytes_ptr.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
+        lib.fastcsv_str_bytes_len.restype = ctypes.c_int64
+        lib.fastcsv_str_bytes_len.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
         lib.fastcsv_free.argtypes = [ctypes.c_void_p]
         _LIB = lib
     return _LIB
@@ -74,6 +96,36 @@ def available() -> bool:
         return True
     except OSError:
         return False
+
+
+def _extract_columns(lib, h):
+    """(numeric ndarray, {row: str}) per column from a parse handle.
+    The string side table ships through the BULK export (three planes:
+    rows / lens / concatenated bytes) — the old per-cell
+    fastcsv_str_row/fastcsv_str_val pair cost two ctypes round trips per
+    string cell, which dominated categorical-heavy ingest."""
+    nrows = lib.fastcsv_nrows(h)
+    ncols = lib.fastcsv_ncols(h)
+    out = []
+    for j in range(ncols):
+        ptr = lib.fastcsv_col_data(h, j)
+        arr = np.ctypeslib.as_array(ptr, shape=(nrows,)).copy() \
+            if nrows else np.empty(0, np.float64)
+        nstr = lib.fastcsv_col_nstr(h, j)
+        smap = {}
+        if nstr:
+            rows = np.ctypeslib.as_array(
+                lib.fastcsv_str_rows_ptr(h, j), shape=(nstr,))
+            lens = np.ctypeslib.as_array(
+                lib.fastcsv_str_lens_ptr(h, j), shape=(nstr,))
+            blen = lib.fastcsv_str_bytes_len(h, j)
+            raw = ctypes.string_at(lib.fastcsv_str_bytes_ptr(h, j), blen)
+            offs = np.concatenate([[0], np.cumsum(lens)])
+            for i in range(nstr):
+                smap[int(rows[i])] = raw[offs[i]:offs[i + 1]].decode(
+                    "utf-8", "replace")
+        out.append((arr, smap))
+    return out
 
 
 def parse_columns(path: str, sep: str, header: bool,
@@ -96,18 +148,28 @@ def parse_columns(path: str, sep: str, header: bool,
         raise IOError(f"fastcsv failed on {path}")
     FASTCSV_BYTES.inc(max(span_bytes, 0))
     try:
-        nrows = lib.fastcsv_nrows(h)
-        ncols = lib.fastcsv_ncols(h)
-        out = []
-        for j in range(ncols):
-            ptr = lib.fastcsv_col_data(h, j)
-            arr = np.ctypeslib.as_array(ptr, shape=(nrows,)).copy()
-            nstr = lib.fastcsv_col_nstr(h, j)
-            smap = {}
-            for i in range(nstr):
-                smap[lib.fastcsv_str_row(h, j, i)] = \
-                    lib.fastcsv_str_val(h, j, i).decode("utf-8", "replace")
-            out.append((arr, smap))
-        return out
+        return _extract_columns(lib, h)
+    finally:
+        lib.fastcsv_free(h)
+
+
+def parse_bytes_columns(buf: bytes, sep: str, header: bool,
+                        skip_partial_first: bool = False):
+    """Tokenize caller-staged bytes (a streaming-decompressed gzip/zip
+    window, an HTTP range read) with the same chunk contract as
+    `parse_columns`: `skip_partial_first` applies the start>0 half (the
+    head up to the first newline belongs to the previous chunk);
+    otherwise the buffer must hold whole lines. Same return shape."""
+    lib = _lib()
+    # h2o3-ok: R011 same tokenize stage as the range entry above — one engine, two native entry points
+    with _span("parse.tokenize", engine="fastcsv_bytes", nbytes=len(buf)):
+        h = lib.fastcsv_parse_bytes(buf, len(buf), sep.encode(),
+                                    1 if header else 0,
+                                    1 if skip_partial_first else 0)
+    if not h:
+        raise IOError("fastcsv failed on byte buffer")
+    FASTCSV_BYTES.inc(len(buf))
+    try:
+        return _extract_columns(lib, h)
     finally:
         lib.fastcsv_free(h)

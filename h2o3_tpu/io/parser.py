@@ -44,6 +44,12 @@ NA_TOKENS = {"", "NA", "N/A", "na", "NaN", "nan", "null", "NULL", "None", "?"}
 _SEPARATORS = [",", "\t", ";", "|", " "]
 
 
+def pack_span(**attrs):
+    """The `parse.pack` stage span — one literal declaration site shared
+    by the single-file path here and the chunked merge (io/dparse)."""
+    return _span("parse.pack", **attrs)
+
+
 # ---------------------------------------------------------------------------
 @dataclass
 class ParseSetup:
@@ -215,7 +221,7 @@ def _parse_dispatch(path, setup, destination_frame, col_types) -> Frame:
         for k, v in col_types.items():
             if k in names:
                 types[names.index(k)] = v
-    with _span("parse.pack", cols=len(cols)):
+    with pack_span(cols=len(cols)):
         vecs = [_column_to_vec(cols[j], types[j]) for j in range(len(cols))]
         return Frame(names[: len(vecs)], vecs, destination_frame)
 
@@ -276,12 +282,10 @@ def _native_parse(path: str, setup: ParseSetup, dest, col_types):
                     out[i] = np.nan
             vecs.append(Vec.from_numpy(out, type=T_TIME))
         else:  # enum / str / uuid: reconstruct token strings
-            toks = np.empty(len(num), object)
-            isnan = np.isnan(num)
-            for i in range(len(num)):
-                toks[i] = None if isnan[i] else _num_token(num[i])
-            for i, s in smap.items():
-                toks[i] = s
+            # vectorized: _num_token over UNIQUE numeric values only,
+            # object gathers for the rest (io/dparse._chunk_tokens)
+            from h2o3_tpu.io.dparse import _chunk_tokens
+            toks = _chunk_tokens(num, smap)
             if t == T_UUID:
                 vecs.append(UuidVec.encode(toks))
             else:
@@ -440,7 +444,22 @@ def import_file(path: str, destination_frame: Optional[str] = None,
                                   col_types)
     staged = None
     if _uri.is_remote(path):
-        # eager remote read (PersistManager + PersistEagerHTTP / persist-gcs)
+        # range-capable remote CSV sources ride the chunked plan — the
+        # same byte-range pipeline as local files, no whole-file staging
+        # (PersistEagerHTTP upgraded to ranged reads); columnar formats
+        # and range-less servers fall back to the eager fetch below
+        if header is None and sep is None \
+                and _uri.supports_ranges(path) and not path.endswith(
+                    (".parquet", ".orc", ".feather", ".avro", ".xlsx")):
+            from h2o3_tpu.io import dparse
+            try:
+                return dparse.parse_files([path], None,
+                                          destination_frame, col_types)
+            except (OSError, NotImplementedError):
+                # staging fallback ONLY for transport failures (the
+                # server lied about ranges, fsspec backend missing) —
+                # real parse bugs must surface, not silently re-download
+                pass
         path = staged = _uri.fetch_to_local(path)
     try:
         if not os.path.exists(path):
@@ -454,6 +473,13 @@ def import_file(path: str, destination_frame: Optional[str] = None,
             setup.header = header
         if sep is not None:
             setup.separator = sep
+        if path.endswith((".gz", ".zip")) and setup.parse_type == "CSV":
+            # compressed CSV: one streaming inflate pass feeding the
+            # chunked native pipeline (io/dparse) instead of the
+            # sequential per-line python tokenizer
+            from h2o3_tpu.io import dparse
+            return dparse.parse_files([path], setup, destination_frame,
+                                      col_types)
         return parse(path, setup, destination_frame, col_types)
     finally:
         if staged is not None:

@@ -45,6 +45,64 @@ def _fs_for(path: str):
     return fs, rel
 
 
+def path_size(path: str) -> int:
+    """Byte size of a local path or remote URI (HEAD content-length for
+    http(s), fs.size for fsspec backends) — phase A of the distributed
+    parse plans byte ranges over remote sources with this."""
+    if not is_remote(path):
+        return os.path.getsize(path)
+    if path.startswith(("http://", "https://")):
+        req = urllib.request.Request(path, method="HEAD")
+        with urllib.request.urlopen(req) as r:
+            ln = r.headers.get("Content-Length")
+        if ln is None:
+            raise OSError(f"no Content-Length for {path}")
+        return int(ln)
+    fs, rel = _fs_for(path)
+    return int(fs.size(rel))
+
+
+def supports_ranges(path: str) -> bool:
+    """Whether `path` can serve byte-range reads (the chunked-parse
+    prerequisite). Local files and fsspec backends always can; http(s)
+    needs the server to advertise Accept-Ranges/Content-Length."""
+    if not is_remote(path):
+        return True
+    if not path.startswith(("http://", "https://")):
+        return True
+    try:
+        req = urllib.request.Request(path, method="HEAD")
+        with urllib.request.urlopen(req) as r:
+            accept = (r.headers.get("Accept-Ranges") or "").lower()
+            has_len = r.headers.get("Content-Length") is not None
+        return has_len and accept != "none"
+    except Exception:   # noqa: BLE001 — probe failure: stage eagerly
+        return False
+
+
+def read_range(path: str, start: int, end: int) -> bytes:
+    """Read bytes [start, end) from a local path or remote URI (HTTP
+    Range request / fsspec cat_file) — phase B's remote chunk reader."""
+    if end <= start:
+        return b""
+    if not is_remote(path):
+        with open(path, "rb") as f:
+            f.seek(start)
+            return f.read(end - start)
+    if path.startswith(("http://", "https://")):
+        req = urllib.request.Request(
+            path, headers={"Range": f"bytes={start}-{end - 1}"})
+        with urllib.request.urlopen(req) as r:
+            body = r.read()
+            if r.status == 200 and start != 0:
+                # server ignored the Range header: serve the slice so
+                # the chunk contract still holds (wasteful but correct)
+                return body[start:end]
+            return body[: end - start]
+    fs, rel = _fs_for(path)
+    return fs.cat_file(rel, start=start, end=end)
+
+
 def fetch_to_local(path: str, suffix: str = "") -> str:
     """Eager-read a (possibly remote) URI to a local staging file and
     return its path. Local paths pass through untouched."""

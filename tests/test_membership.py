@@ -403,7 +403,11 @@ def test_join_syncs_epoch_and_snapshot(cloud_env):
         bc.broadcast("POST", "/3/ModelBuilders/gbm", {"id": "m1"})
         w3 = FakeWorker(port, 3, join=True)
         # welcome carries the bumped epoch, next seq and the MUTATING
-        # request log (the replayed-state snapshot)
+        # request log (the replayed-state snapshot). The welcome is sent
+        # BEFORE the join commits, so poll the singleton briefly.
+        deadline = time.monotonic() + 10
+        while MB.MEMBERSHIP.epoch < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
         assert w3.welcome["epoch"] == 2 == MB.MEMBERSHIP.epoch
         assert w3.welcome["snapshot_truncated"] is False
         snap = [(r["method"], r["path"]) for r in w3.welcome["snapshot"]]
@@ -575,6 +579,14 @@ def test_kill_and_replace_worker_zero_failed_requests(elastic_server):
         time.sleep(0.5)                   # load continues on survivors
         # replacement joins mid-load and serves
         w3 = FakeWorker(bc._srv.getsockname()[1], 3, join=True)
+        # the welcome is deliberately sent BEFORE the join commits (a
+        # joiner dying mid-handshake must not become a ghost member), so
+        # the singleton's epoch trails the welcome by a beat — bounded
+        # poll, the file's idiom for post-handshake asserts
+        deadline = time.monotonic() + 10
+        while MB.MEMBERSHIP.epoch < w3.welcome["epoch"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
         assert w3.welcome["epoch"] == MB.MEMBERSHIP.epoch
         time.sleep(0.5)
         stop.set()
