@@ -14,6 +14,7 @@ Prints ONE JSON line.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -43,6 +44,25 @@ def _registry():
     return om.REGISTRY
 
 
+def _short_cause(text: str, limit: int = 220) -> str:
+    """Collapse a traceback (or an exception repr with escaped newlines)
+    into ONE bounded line: the final exception line plus the deepest
+    in-repo frame. BENCH_r09 lesson: `blocked_detail` must be a root
+    cause a human can read in the record, never a raw traceback."""
+    t = (text or "").replace("\\n", "\n")
+    lines = [ln.strip() for ln in t.strip().splitlines() if ln.strip()]
+    if not lines:
+        return "unknown"
+    exc = lines[-1]
+    frame = ""
+    for ln in reversed(lines):
+        m = re.search(r'(h2o3_tpu/[\w/.]+)", line (\d+), in (\w+)', ln)
+        if m:
+            frame = f" (at {m.group(1)}:{m.group(2)} {m.group(3)})"
+            break
+    return (exc + frame)[:limit]
+
+
 def blocked_record(stage: str, detail: str, backend: str = "none") -> dict:
     """Structured evidence when the chip is unreachable (BENCH_r03 lesson:
     a raw traceback at import left the round with zero perf record). The
@@ -68,7 +88,8 @@ def blocked_record(stage: str, detail: str, backend: str = "none") -> dict:
         "backend": backend,
         "blocked": True,
         "blocked_stage": stage,
-        "blocked_detail": detail[-2000:],
+        "blocked_detail": (_short_cause(detail)
+                           if "Traceback" in detail else detail[-2000:]),
     }
 
 
@@ -245,7 +266,13 @@ def distributed_ingest_bench(single_host: dict | None,
             while time.time() < deadline:
                 j = _req(rest, f"/3/Jobs/{jk}")["jobs"][0]
                 if j["status"] in ("DONE", "FAILED", "CANCELLED"):
-                    assert j["status"] == "DONE", j
+                    if j["status"] != "DONE":
+                        # the job's own exception repr IS the root cause —
+                        # re-raising the whole job dict buried it in a
+                        # traceback (BENCH_r09)
+                        raise RuntimeError(
+                            f"distributed parse {j['status']}: "
+                            + _short_cause(str(j.get("exception") or "")))
                     return time.perf_counter() - t0
                 time.sleep(0.1)
             raise TimeoutError("distributed parse did not finish")
@@ -272,7 +299,7 @@ def distributed_ingest_bench(single_host: dict | None,
     except Exception:
         return {**record, "blocked": True,
                 "blocked_stage": "2proc-distributed-ingest",
-                "blocked_detail": traceback.format_exc()[-800:]}
+                "blocked_detail": _short_cause(traceback.format_exc())}
     finally:
         for p in procs:
             if p.poll() is None:
@@ -340,10 +367,16 @@ def scoring_bench() -> dict:
     # alternating best-of-5 per mode: one span (or log record) per
     # iteration costs microseconds, so a naive single pair of loops
     # measures scheduler jitter, not instrumentation — min-of-N against
-    # interleaved runs cancels it
+    # interleaved runs cancels it. BENCH_r09 regression root cause (1-core
+    # container): the logged loop enqueues async records whose 0.5s-batch
+    # DRAIN thread then fires DURING the next alternation's off/traced
+    # loops, stealing the only core and inflating BOTH baselines — so the
+    # drain is forced synchronously (log.flush) after every logged loop,
+    # keeping each timed window drain-free.
     prev_trace = tracing.set_current(None)
     dt_off = dt_on = dt_log = float("inf")
     out = None
+    _ulog.flush()
     for _ in range(5):
         tracing.set_current(None)                    # tracing off
         dt, out = timed_loop()
@@ -355,6 +388,7 @@ def scoring_bench() -> dict:
         # shape): the logging pillar's warm-path cost
         dt, out = timed_loop_logged()
         dt_log = min(dt_log, dt)
+        _ulog.flush()            # drain NOW, outside the timed windows
     tracing.set_current(prev_trace)
     assert out is not None and len(out) >= batch
     warm_compiles = om.xla_compile_count() - c0
@@ -370,10 +404,15 @@ def scoring_bench() -> dict:
     fast_hits = int(_scc.HITS.value() - hits0)
     fallbacks = int(sum(e["value"] for e in _scc.FALLBACKS._json()) - fb0)
     param_bytes = int(_sp.PARAMS.bytes_for(m.key))
+    cores = os.cpu_count() or 1
     rec = {"rows_per_sec": round(rows_per_sec),
            "rows_per_sec_untraced": round(batch * iters / dt_off),
            "tracing_overhead_pct": round(overhead_pct, 2),
            "logging_overhead_pct": round(logging_overhead_pct, 2),
+           # the overhead samples are only meaningful relative to the
+           # core count they ran on: on 1 core ANY background thread
+           # (span drain, GC) lands inside the measured loop
+           "cores": cores,
            "batch_rows": batch, "iters": iters,
            "bucket": serving.row_bucket(batch),
            "warm_compiles": int(warm_compiles),
@@ -381,6 +420,16 @@ def scoring_bench() -> dict:
            "fallbacks": fallbacks,
            "param_hbm_bytes": param_bytes,
            "params_shared": bool(_scc._shares_params(m))}
+    if (overhead_pct > 5.0 or logging_overhead_pct > 1.0) and cores < 2:
+        # structured bound-waiver (ISSUE 14 satellite): with one physical
+        # core the instrumented and baseline loops time-slice against
+        # every background thread in the process, so the <5%/<1% bounds
+        # are not measurable — record the cause instead of a silent miss
+        rec["overhead_bound_waiver"] = {
+            "cause": f"{cores}-core container: measured loop time-slices "
+                     "against drain/GC threads; bounds need >=2 cores "
+                     "(r06/r07 measured 0.09%/0.47% on 2 cores)",
+            "bounds": {"tracing_pct": 5.0, "logging_pct": 1.0}}
     for k in (fr.key, sf.key, m.key):
         DKV.remove(k)
     return rec
@@ -477,7 +526,17 @@ def multihost_scoring_bench(timeout_s: int = 240) -> dict:
         while time.time() < deadline:
             j = _req(rest, f"/3/Jobs/{jk}")["jobs"][0]
             if j["status"] in ("DONE", "FAILED", "CANCELLED"):
-                assert j["status"] == "DONE", j
+                if j["status"] != "DONE":
+                    # known root cause on this image: the first device
+                    # dispatch the 2-proc build reaches (the frame rollup
+                    # kernel, a host-serialized collective) hits jax-CPU's
+                    # "Multiprocess computations aren't implemented" — the
+                    # rollup guard serializes dispatch, it did not break
+                    # the run. Surface the job's OWN exception as a
+                    # one-line cause, not the job dict's traceback.
+                    raise RuntimeError(
+                        f"gbm build {j['status']}: "
+                        + _short_cause(str(j.get("exception") or "")))
                 break
             time.sleep(0.3)
         # warm, then timed scoring round trips over the 2-host cloud
@@ -495,7 +554,7 @@ def multihost_scoring_bench(timeout_s: int = 240) -> dict:
         return record
     except Exception:
         return {"blocked": True, "blocked_stage": "2proc-cloud-run",
-                "blocked_detail": traceback.format_exc()[-800:]}
+                "blocked_detail": _short_cause(traceback.format_exc())}
     finally:
         for p in procs:
             if p.poll() is None:
@@ -503,6 +562,9 @@ def multihost_scoring_bench(timeout_s: int = 240) -> dict:
 
 
 def main():
+    # --gbm-only (ISSUE 14 CI fast mode): train + AUC-gate the headline
+    # GBM stage only, skipping the ingest / scoring / multihost stages
+    gbm_only = "--gbm-only" in sys.argv
     rec = probe_backend()
     if rec is not None:
         print(json.dumps(rec))
@@ -557,17 +619,18 @@ def main():
 
     # ---- kernel parity gate (pre-step): a misrouting Pallas kernel must
     # not ship behind a good throughput number
-    import sys
     from h2o3_tpu.ops.parity import kernel_parity_check
     from h2o3_tpu.ops import hist_pallas as HP
     if HP.use_pallas():
         kernel_parity_check(seed=0)
         print("kernel parity: OK", file=sys.stderr)
 
-    # bin spec from a host-side sample (29MB readback), codes on device
+    # bin spec from a host-side sample (29MB readback), codes on device:
+    # uint8 planes end-to-end, packed to the i32 word layout for the
+    # Pallas kernels (1 B/code in HBM — 4x less code-stream traffic)
     Xs = np.asarray(X[: 1 << 18])
     spec = BN.make_bins(Xs, np.zeros(C, bool), NBINS)
-    codes = BN.quantize(X, spec)
+    codes = BN.prepare_codes(BN.quantize(X, spec))
     del X
 
     # ---- AUC: rank-sum (Mann-Whitney) on device; a broken histogram or
@@ -594,22 +657,30 @@ def main():
         engine's executed program (mirrors grow()'s level loop: full hist
         at d=0, sibling-subtraction half windows after; windows of
         GW leaves x S_STATS sublanes; codes re-streamed per pass and per
-        route). Counts the dot as written — lane padding below 128 counts
-        AGAINST utilization, as it should."""
+        unfused route at ONE byte/code — the round-4 packed uint8 planes;
+        levels the fused route+hist covers read the plane once). Counts
+        the dot as written — lane padding below 128 counts AGAINST
+        utilization, as it should."""
         from h2o3_tpu.ops import hist_pallas as _hp
         S, GW, nb = _hp.S_STATS, _hp.GW, NBINS + 1
         macs = b = 0
         stat_b = 1 if int8 else 4
+        code_b = 1                                     # uint8/packed plane
         for d in range(DEPTH):
             l_eff = 1 if d == 0 else (1 << d) >> 1
             gwe = min(l_eff, GW)
             npass = -(-l_eff // gwe)
             macs += npass * c_pad * (gwe * S) * nb * np_rows
-            b += npass * (c_pad * np_rows * 4          # codes re-stream
+            b += npass * (c_pad * np_rows * code_b     # codes re-stream
                           + S * np_rows * stat_b + np_rows * 4)
             b += l_eff * c_pad * S * nb * 4            # hist writeback
-            if d >= 1:                                 # route stream
-                b += c_pad * np_rows * 4 + 3 * np_rows * 4
+            if d >= 1:
+                # mirror the real dispatch gate (incl. the VMEM cap) so the
+                # byte model can't claim fusion grow() would refuse
+                fused = _hp._fused_applicable(1 << d, nb, c_pad)
+                b += 2 * np_rows * 4                   # heap in/out
+                if not fused:                          # unfused route re-
+                    b += c_pad * np_rows * code_b      # streams the codes
         return macs, b
 
     # v5e peaks (ops/PERF_NOTES.md): bf16 197 TFLOP/s (int8 2x), HBM 819 GB/s
@@ -641,7 +712,9 @@ def main():
         ntrees = CHUNK * NCHUNK
         from h2o3_tpu.models.tree.engine import ROW_TREES
         ROW_TREES.inc(N * ntrees, engine="binned")   # /metrics sees the bench
-        macs, hbm_b = roofline_model(codes.shape[0], codes.shape[1], int8)
+        # codes may be the packed (W_pad, n_pad) plane — column count for
+        # the analytic model comes from the bin spec, not the plane shape
+        macs, hbm_b = roofline_model(spec.c_pad, codes.shape[1], int8)
         mode = "int8" if int8 else "f32"
         mfu = 2 * macs * ntrees / dt / PEAK_FLOPS[mode]
         hbm_frac = hbm_b * ntrees / dt / PEAK_HBM
@@ -681,57 +754,114 @@ def main():
             traceback.print_exc()
             paths["int8"] = {"error": traceback.format_exc()[-500:]}
 
-    ingest = None
+    # ---- per-level cost arbiter (ISSUE 14): ONE eagerly-dispatched tree
+    # with a host sync per level fills h2o3_tree_level_seconds{engine=
+    # "binned", level} and gives the record its per-level table — the
+    # breakdown that names the residual cost whenever the on-chip 25M
+    # row-trees/s target is missed
+    level_seconds = None
     try:
-        ingest = ingest_bench()
-        print(f"ingest: {ingest['mb_per_sec']:.1f} MB/s "
-              f"({ingest['cores']} cores, "
-              f"native={ingest['native_parser']})", file=sys.stderr)
+        g_lb = BN.BinnedGrower(spec, max_depth=DEPTH, min_rows=1.0,
+                               min_split_improvement=0.0)
+        stats_lb = jnp.stack(
+            [w1, w1 * (y1 - p0), w1 * (p0 * (1 - p0)),
+             jnp.zeros_like(w1)], axis=0)
+        F_lb = jnp.where(jnp.arange(n_pad) < N, f0, 0.0) \
+            .astype(jnp.float32)
+        level_seconds = BN.measure_level_seconds(g_lb, codes, stats_lb,
+                                                 F_lb)
+        print("level seconds: " + " ".join(
+            f"L{r['level']}={r['seconds'] * 1e3:.0f}ms"
+            for r in level_seconds), file=sys.stderr)
     except Exception:
         traceback.print_exc()
+
+    # ---- kernel-flag stamp (acceptance record) + chip evidence block
+    kernel_flags = {
+        # uint8 code planes are END-TO-END now: the binner emits uint8,
+        # the XLA fallbacks consume it, the Pallas kernels stream the
+        # packed word layout — true on every backend
+        "int8_codes": True,
+        "radix_shallow": bool(HP.radix_supported()),
+        "fused_level": bool(HP.fused_supported()),
+        "int8_stats": mode == "int8",
+    }
+    chip = None
+    target = 25_000_000
+    if jax.default_backend() != "tpu":
+        # state only what is KNOWN: the resolved backend and how the
+        # platform was selected — never assert an unverified root cause
+        chip = {"blocked": True,
+                "blocked_stage": "tpu-backend-unavailable",
+                "blocked_detail": (
+                    f"default backend is {jax.default_backend()!r}, not "
+                    "'tpu' (JAX_PLATFORMS="
+                    f"{os.environ.get('JAX_PLATFORMS') or 'unset'}; the "
+                    "probe falls back to CPU smoke mode when the chip "
+                    "doesn't answer); the kernel work and CPU parity "
+                    "gates land regardless"),
+                "target_row_trees_per_sec": target}
+    elif throughput < target:
+        chip = {"blocked": False, "shortfall": True,
+                "target_row_trees_per_sec": target,
+                "level_seconds": level_seconds}
+
+    ingest = None
+    if not gbm_only:
+        try:
+            ingest = ingest_bench()
+            print(f"ingest: {ingest['mb_per_sec']:.1f} MB/s "
+                  f"({ingest['cores']} cores, "
+                  f"native={ingest['native_parser']})", file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
 
     distributed_ingest = None
-    try:
-        distributed_ingest = distributed_ingest_bench(ingest)
-        if distributed_ingest.get("blocked"):
-            print("2-proc ingest sample blocked: "
-                  f"{distributed_ingest['blocked_stage']}",
-                  file=sys.stderr)
-        else:
-            print(f"2-proc ingest: "
-                  f"{distributed_ingest['mb_per_sec']:.1f} MB/s over "
-                  f"REST (cloud_size {distributed_ingest['cloud_size']}"
-                  f", scaling "
-                  f"{distributed_ingest.get('scaling_vs_single_host')})",
-                  file=sys.stderr)
-    except Exception:
-        traceback.print_exc()
+    if not gbm_only:
+        try:
+            distributed_ingest = distributed_ingest_bench(ingest)
+            if distributed_ingest.get("blocked"):
+                print("2-proc ingest sample blocked: "
+                      f"{distributed_ingest['blocked_stage']}",
+                      file=sys.stderr)
+            else:
+                print(f"2-proc ingest: "
+                      f"{distributed_ingest['mb_per_sec']:.1f} MB/s over "
+                      f"REST (cloud_size {distributed_ingest['cloud_size']}"
+                      f", scaling "
+                      f"{distributed_ingest.get('scaling_vs_single_host')})",
+                      file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
 
     scoring = None
-    try:
-        scoring = scoring_bench()
-        print(f"scoring: {scoring['rows_per_sec']/1e3:.1f}k rows/s warm "
-              f"(batch {scoring['batch_rows']}, "
-              f"{scoring['warm_compiles']} warm compiles, "
-              f"{scoring['fast_path_hits']} hits / "
-              f"{scoring['fallbacks']} fallbacks, "
-              f"params {scoring['param_hbm_bytes']}B shared)",
-              file=sys.stderr)
-    except Exception:
-        traceback.print_exc()
+    if not gbm_only:
+        try:
+            scoring = scoring_bench()
+            print(f"scoring: {scoring['rows_per_sec']/1e3:.1f}k rows/s warm "
+                  f"(batch {scoring['batch_rows']}, "
+                  f"{scoring['warm_compiles']} warm compiles, "
+                  f"{scoring['fast_path_hits']} hits / "
+                  f"{scoring['fallbacks']} fallbacks, "
+                  f"params {scoring['param_hbm_bytes']}B shared)",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
 
     multihost_scoring = None
-    try:
-        multihost_scoring = multihost_scoring_bench()
-        if multihost_scoring.get("blocked"):
-            print("2-proc scoring sample blocked: "
-                  f"{multihost_scoring['blocked_stage']}", file=sys.stderr)
-        else:
-            print("2-proc scoring: "
-                  f"{multihost_scoring['scoring_rows_per_sec']/1e3:.1f}k "
-                  "rows/s over REST", file=sys.stderr)
-    except Exception:
-        traceback.print_exc()
+    if not gbm_only:
+        try:
+            multihost_scoring = multihost_scoring_bench()
+            if multihost_scoring.get("blocked"):
+                print("2-proc scoring sample blocked: "
+                      f"{multihost_scoring['blocked_stage']}",
+                      file=sys.stderr)
+            else:
+                print("2-proc scoring: "
+                      f"{multihost_scoring['scoring_rows_per_sec']/1e3:.1f}k "
+                      "rows/s over REST", file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
 
     baseline = 157e6  # H100 gpu_hist row*trees/s reference point (header)
     # publish into the obs registry, then emit the JSON line FROM it —
@@ -765,7 +895,14 @@ def main():
         "backend": jax.default_backend(),
         "mfu": round(g.value(stat="mfu"), 4),
         "hbm_frac": round(g.value(stat="hbm_frac"), 4),
-        "radix_shallow": bool(HP.radix_supported()),
+        "radix_shallow": kernel_flags["radix_shallow"],
+        "int8_codes": kernel_flags["int8_codes"],
+        "fused_level": kernel_flags["fused_level"],
+        "kernel_flags": kernel_flags,
+        "cores": os.cpu_count(),
+        "gbm_only": gbm_only,
+        "level_seconds": level_seconds,
+        "chip": chip,
         "scoring_rows_per_sec": (scoring or {}).get("rows_per_sec"),
         "fast_path_hits": (scoring or {}).get("fast_path_hits"),
         "fallbacks": (scoring or {}).get("fallbacks"),

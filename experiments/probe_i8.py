@@ -10,7 +10,8 @@ R = HP.BLOCK_ROWS
 n_pad = -(-(N + 1) // R) * R
 C_pad, BP = 32, 256
 rng = np.random.default_rng(0)
-codesT = jnp.asarray(rng.integers(0, 255, (C_pad, n_pad)), jnp.int32)
+codesU8 = jnp.asarray(rng.integers(0, 255, (C_pad, n_pad)), jnp.uint8)
+codesT = HP.pack_codes(codesU8)      # packed i32 code plane (round 4)
 stats = jnp.asarray(rng.normal(0, 1, (4, n_pad)), jnp.float32)
 stats_i8 = jnp.asarray(rng.integers(-127, 128, (4, n_pad)), jnp.int32)
 
@@ -37,12 +38,13 @@ for d, L in ((3, 8), (7, 128)):
 
 # correctness: i8 vs exact numpy on small
 n0 = 4 * R
-c0 = jnp.asarray(rng.integers(0, BP, (C_pad, n0)), jnp.int32)
+c0u = jnp.asarray(rng.integers(0, BP, (C_pad, n0)), jnp.uint8)
+c0 = HP.pack_codes(c0u)
 h0 = jnp.asarray(rng.integers(7, 15, n0), jnp.int32)
 s0 = jnp.asarray(rng.integers(-127, 128, (4, n0)), jnp.int32)
 out = np.asarray(HP.sbh_hist_pallas_i8(c0, h0, s0, base=7, L=8, n_bins=BP))
 ref = np.zeros((8, C_pad, 4, BP), np.int64)
-cn, hn, sn = np.asarray(c0), np.asarray(h0), np.asarray(s0)
+cn, hn, sn = np.asarray(c0u).astype(np.int64), np.asarray(h0), np.asarray(s0)
 for c in range(C_pad):
     for st in range(4):
         np.add.at(ref[:, c, st, :], (hn - 7, cn[c]), sn[st])

@@ -13,7 +13,8 @@ R = HP.BLOCK_ROWS
 n_pad = -(-(N + 1) // R) * R
 C_pad, BP = 32, 256
 rng = np.random.default_rng(0)
-codesT = jnp.asarray(rng.integers(0, 255, (C_pad, n_pad)), jnp.int32)
+codesU8 = jnp.asarray(rng.integers(0, 255, (C_pad, n_pad)), jnp.uint8)
+codesT = HP.pack_codes(codesU8)      # packed i32 code plane (round 4)
 stats = jnp.asarray(rng.normal(0, 1, (4, n_pad)), jnp.float32)
 F = jnp.zeros(n_pad, jnp.float32)
 

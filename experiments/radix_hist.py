@@ -1,6 +1,8 @@
 """Radix-factored shallow-level histogram kernel — PERF_NOTES item 1,
 scoped to the regime the analysis says it can win (UNSORTED rows, small
-leaf windows).
+leaf windows). The production kernel now lives in
+h2o3_tpu/ops/hist_pallas.py (`sbh_hist_radix`, packed code planes); this
+drive is the on-chip parity + timing harness for it.
 
 Idea: at level windows L<=2 the dense kernel's cost floor is the 256-wide
 one-hot generation (~210ms/level at 11M x 32). Factor code = hi*16+lo and
@@ -16,11 +18,15 @@ VPU element-ops per (row, col): L*16 (compare) + L*16*S (select) + 16
     L=1:  96 vs 260  (2.7x)     L=2: 176 vs 264  (1.5x)
     L=4: 336 vs 272  (worse)    -> use radix ONLY for L<=2, dense beyond.
 
-Run on TPU:   python experiments/radix_hist.py            (measures)
-Correctness:  python experiments/radix_hist.py --interpret (any backend)
+Run on TPU:   python experiments/radix_hist.py          (parity + timings;
+              prints ONE JSON line — blocked-structured off-chip)
+Correctness:  python experiments/radix_hist.py --interpret
+              (the factorization math vs the XLA reference, any backend —
+              promoted into tier-1 as tests/test_binned_engine.py
+              test_radix_factorization_math)
 """
 
-import functools
+import json
 import sys
 import time
 
@@ -28,91 +34,20 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 sys.path.insert(0, "/root/repo")
 from h2o3_tpu.ops import hist_pallas as HP  # noqa: E402
 
-try:
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-except Exception:  # pragma: no cover
-    pl = None
-
-NH = 16                       # hi radix width
+NH = HP.RADIX_NH
 S = HP.S_STATS
-CB = HP.COL_TILE
 R = HP.BLOCK_ROWS
 
 
-def _radix_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
-                  nb, interpret):
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    heap = heap_ref[0, :]                               # (R,)
-    leaf = heap - base
-    inw = (leaf >= 0) & (leaf < L)
-    leaf_c = jnp.where(inw, leaf, L)                    # dead -> key >= L*NH
-    nl = nb // NH                                       # lo width
-    stats = stats_ref[...]                              # (S, R)
-    acc = out_ref[...]
-    iota_k = lax.broadcasted_iota(jnp.int32, (L * NH, R), 0)
-    iota_lo = lax.broadcasted_iota(jnp.int32, (nl, R), 0)
-    parts = []
-    for c in range(CB):
-        code = codesT_ref[c, :]                         # (R,)
-        key = leaf_c * NH + (code // nl if nl != NH else code >> 4)
-        lo = code % nl
-        J = (iota_k == key[None, :])                    # (L*NH, R) i1
-        # A[(l,hi,s), r] = J ? stats[s] : 0
-        A = jnp.where(J[:, None, :], stats[None, :, :], 0.0) \
-            .reshape(L * NH * S, R).astype(jnp.bfloat16)
-        ohlo = (iota_lo == lo[None, :]).astype(jnp.bfloat16)   # (nl, R)
-        h = lax.dot_general(A, ohlo, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        parts.append(h)                                 # (L*NH*S, nl)
-    out_ref[...] = acc + jnp.stack(parts)[None]
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("base", "L", "nb", "interpret"))
-def radix_hist(codesT, heap, stats, *, base, L, nb=256, interpret=False):
-    """(L, C_pad, S, nb) histogram via the radix factorization; L <= 8."""
-    c_pad, n_pad = codesT.shape
-    ncb = c_pad // CB
-    kernel = functools.partial(_radix_kernel, base=base, L=L, nb=nb,
-                               interpret=interpret)
-    out = pl.pallas_call(
-        kernel,
-        grid=(ncb, n_pad // R),
-        in_specs=[
-            pl.BlockSpec((CB, R), lambda g, j: (g, j)),
-            pl.BlockSpec((1, R), lambda g, j: (0, j)),
-            pl.BlockSpec((S, R), lambda g, j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, CB, L * NH * S, nb // NH),
-                               lambda g, j: (g, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((ncb, CB, L * NH * S, nb // NH),
-                                       jnp.float32),
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
-    )(codesT, heap.reshape(1, n_pad), stats)
-    # (ncb, CB, L*NH*S, nl) -> (L, C_pad, S, nb)
-    nl = nb // NH
-    out = out.reshape(ncb, CB, L, NH, S, nl)
-    return out.transpose(2, 0, 1, 4, 3, 5).reshape(L, c_pad, S, nb)
-
-
 def radix_math(codes, heap, stats, *, base, L, nb):
-    """Pure-jnp replica of the kernel body (the factorization math, minus
-    the pallas tiling) — pallas interpret mode is impractically slow at
-    kernel shapes, so correctness splits into (a) this math check and
-    (b) the on-TPU parity check in measure()."""
+    """Pure-jnp replica of the kernel's factorization (minus the pallas
+    tiling) — pallas interpret mode is impractically slow at kernel
+    shapes, so correctness splits into (a) this math check (tier-1) and
+    (b) the on-TPU parity check in measure()/ops/parity.py."""
     c_pad, n_pad = codes.shape
     nl = nb // NH
     leaf = heap - base
@@ -120,7 +55,7 @@ def radix_math(codes, heap, stats, *, base, L, nb):
     leaf_c = jnp.where(inw, leaf, L)
     outs = []
     for c in range(c_pad):
-        code = codes[c]
+        code = codes[c].astype(jnp.int32)
         key = leaf_c * NH + code // nl
         lo = code % nl
         J = jax.nn.one_hot(key, L * NH, dtype=jnp.float32)      # (n, L*NH)
@@ -136,7 +71,7 @@ def radix_math(codes, heap, stats, *, base, L, nb):
 def check_math(L=2, nb=256):
     rng = np.random.default_rng(0)
     n, c_pad = 4096, 8
-    codes = jnp.asarray(rng.integers(0, nb, (c_pad, n)), jnp.int32)
+    codes = jnp.asarray(rng.integers(0, nb, (c_pad, n)), jnp.uint8)
     base = L - 1
     heap = jnp.asarray(rng.integers(base, base + L + 1, n), jnp.int32)
     stats = jnp.asarray(rng.normal(0, 1, (S, n)), jnp.float32)
@@ -148,56 +83,76 @@ def check_math(L=2, nb=256):
     return d
 
 
-def check(interpret=True, n_pad=2 * R, L=2, nb=256):
+def check_chip(n_pad=2 * R, L=2, nb=256):
+    """On-chip parity: the packed radix kernel vs the XLA reference."""
     rng = np.random.default_rng(0)
-    c_pad = 2 * CB
-    codes = jnp.asarray(rng.integers(0, nb, (c_pad, n_pad)), jnp.int32)
+    c_pad = 16
+    u8 = jnp.asarray(rng.integers(0, nb, (c_pad, n_pad)), jnp.uint8)
+    packed = HP.pack_codes(u8)
     base = L - 1
     heap = jnp.asarray(rng.integers(base, base + L, n_pad), jnp.int32)
     stats = jnp.asarray(rng.normal(0, 1, (S, n_pad)), jnp.float32)
-    got = radix_hist(codes, heap, stats, base=base, L=L, nb=nb,
-                     interpret=interpret)
-    want = HP.sbh_hist_xla(codes, heap, stats, base=base, L=L, n_bins=nb)
-    d = float(jnp.max(jnp.abs(got - want[:L])))
-    print(f"radix L={L} max dev vs xla: {d:.4f}")
+    got = HP.sbh_hist_radix(packed, heap, stats, base=base, L=L, n_bins=nb)
+    want = HP.sbh_hist_xla(u8, heap, stats, base=base, L=L, n_bins=nb)
+    d = float(jnp.max(jnp.abs(got[:L, :c_pad] - want[:L])))
+    print(f"radix L={L} max dev vs xla: {d:.4f}", file=sys.stderr)
     assert d < 0.5, d          # bf16 accumulation tolerance
     return d
 
 
 def measure():
+    """Per-window radix vs dense timings at the honest bench shape;
+    returns the rows for the JSON record."""
     N = 11_000_000
     n_pad = -(-N // R) * R
     c_pad = 32
     rng = np.random.default_rng(0)
-    codes = jnp.asarray(rng.integers(0, 255, (c_pad, n_pad)), jnp.int32)
+    u8 = jnp.asarray(rng.integers(0, 255, (c_pad, n_pad)), jnp.uint8)
+    packed = HP.pack_codes(u8)
     stats = jnp.asarray(rng.normal(0, 1, (S, n_pad)), jnp.float32)
+    rows = []
     for L in (1, 2, 4):
         base = L - 1
         heap = jnp.asarray(rng.integers(base, base + L, n_pad), jnp.int32)
-        r = radix_hist(codes, heap, stats, base=base, L=L)
-        float(r[0, 0, 0, 0])
-        t0 = time.time()
-        for _ in range(3):
-            r = radix_hist(codes, heap, stats, base=base, L=L)
-        float(r[0, 0, 0, 0])
-        tr = (time.time() - t0) / 3 * 1e3
-        d = HP.sbh_hist_pallas(codes, heap, stats, base=base, L=L,
-                               n_bins=256)
-        float(d[0, 0, 0, 0])
-        t0 = time.time()
-        for _ in range(3):
-            d = HP.sbh_hist_pallas(codes, heap, stats, base=base, L=L,
-                                   n_bins=256)
-        float(d[0, 0, 0, 0])
-        td = (time.time() - t0) / 3 * 1e3
+
+        def timed(fn):
+            r = fn()
+            float(r[0, 0, 0, 0])         # relay-safe sync
+            t0 = time.time()
+            for _ in range(3):
+                r = fn()
+            float(r[0, 0, 0, 0])
+            return (time.time() - t0) / 3 * 1e3
+
+        tr = timed(lambda: HP.sbh_hist_radix(
+            packed, heap, stats, base=base, L=L, n_bins=256))
+        td = timed(lambda: HP.sbh_hist_pallas(
+            packed, heap, stats, base=base, L=L, n_bins=256))
         print(f"L={L}: radix {tr:.0f} ms  dense {td:.0f} ms  "
-              f"({td / tr:.2f}x)")
+              f"({td / tr:.2f}x)", file=sys.stderr)
+        rows.append({"window": L, "radix_ms": round(tr, 1),
+                     "dense_ms": round(td, 1),
+                     "speedup": round(td / tr, 2)})
+    return rows
 
 
 if __name__ == "__main__":
     if "--interpret" in sys.argv:        # CPU-safe factorization check
         for L in (1, 2, 4):
             check_math(L=L)
+    elif not HP.use_pallas():
+        # the drive's record must be structured even when the chip is
+        # unreachable — name the stage, never a bare traceback
+        print(json.dumps({
+            "drive": "radix_hist", "blocked": True,
+            "blocked_stage": "tpu-backend-unavailable",
+            "backend": jax.default_backend(),
+            "radix_supported": False}))
     else:                                # on-TPU parity + timings
-        check(interpret=False)
-        measure()
+        dev = check_chip()
+        print(json.dumps({
+            "drive": "radix_hist", "blocked": False,
+            "backend": jax.default_backend(),
+            "radix_supported": HP.radix_supported(),
+            "parity_max_dev": dev,
+            "windows": measure()}))

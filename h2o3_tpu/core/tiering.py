@@ -225,16 +225,21 @@ class ChunkPager:
         self._prefetch_hits += 1
 
     # ---- registration ----------------------------------------------------
-    def new_chunk(self, data, mask, host=None, label: str = "") -> TierChunk:
+    def new_chunk(self, data, mask, host=None, label: str = "",
+                  pinned: int = 0) -> TierChunk:
         """Wrap freshly-ingested planes and register with the pager.
         `data` may be None when only packed host bytes exist (budgeted
         ingest parks new chunks in the host tier — an eager device_put
-        would spike HBM past the budget before the pager could act)."""
+        would spike HBM past the budget before the pager could act).
+        `pinned` pins BEFORE registration: incrementing after new_chunk
+        returns leaves a window where _enforce_budgets() below could pick
+        the brand-new chunk as a demotion victim."""
         key = f"{label or 'chunk'}#{next(self._ids)}"
         dev = (data, mask) if data is not None else None
         ch = TierChunk(key, dev,
                        host=host if (self.enabled or dev is None)
                        else None)
+        ch.pinned = pinned
         ch._last = self.tick()
 
         def _on_gc(_ref, _key=key, _pager=self):

@@ -103,7 +103,10 @@ def padded_rows(n: int, shards: int = 1) -> int:
 def _quantize(X, edges, *, b_val, c_pad, n_pad):
     """codes[r,c] = #edges < x (0..b_val-1), NA -> b_val. Rows are padded to
     the kernel block multiple with dummy rows (code 0, zero stats) and dummy
-    columns for the kernel's column tiling."""
+    columns for the kernel's column tiling. Codes are uint8 END-TO-END
+    (b_val <= 255 so the NA code fits): the code plane is the per-level
+    HBM bandwidth floor (ops/PERF_NOTES.md) and one byte per code is 4x
+    less stream than the old i32 planes."""
     n, C = X.shape
 
     def one_col(x, e):
@@ -111,17 +114,27 @@ def _quantize(X, edges, *, b_val, c_pad, n_pad):
         return jnp.where(jnp.isnan(x), b_val, code)
 
     codes = jax.vmap(one_col, in_axes=(1, 0), out_axes=0)(X, edges)
-    codes = jnp.clip(codes, 0, b_val)                    # (C, n)
-    out = jnp.zeros((c_pad, n_pad), jnp.int32)
+    codes = jnp.clip(codes, 0, b_val).astype(jnp.uint8)  # (C, n)
+    out = jnp.zeros((c_pad, n_pad), jnp.uint8)
     return lax.dynamic_update_slice(out, codes, (0, 0))
 
 
 def quantize(X, spec: BinSpec, n_pad: int | None = None):
+    """(n, C) f32 -> (C_pad, n_pad) uint8 code plane (the XLA-fallback /
+    canonical layout; `prepare_codes` derives the TPU kernel layout)."""
     n = X.shape[0]
     if n_pad is None:
         n_pad = padded_rows(n)
     return _quantize(X, jnp.asarray(spec.edges),
                      b_val=spec.b_val, c_pad=spec.c_pad, n_pad=n_pad)
+
+
+def prepare_codes(codes_u8):
+    """Backend-appropriate kernel layout for a quantized plane: the packed
+    i32 word plane (4 codes/word, HP.pack_codes) on the Pallas backend,
+    the uint8 plane unchanged everywhere else. Row axis untouched — row
+    sharding specs carry over."""
+    return HP.prepare_codes(codes_u8)
 
 
 def pad_rows(x, n_pad: int):
@@ -285,7 +298,9 @@ class BinnedGrower:
                  reg_alpha: float = 0.0, use_hess_denom: bool = False,
                  monotone: np.ndarray | None = None,
                  axis_name: str | None = None,
-                 int8_stats: bool | None = None):
+                 int8_stats: bool | None = None,
+                 use_radix_shallow: bool | None = None,
+                 fused_level: bool | None = None):
         # axis_name: mesh axis the row dimension is sharded over. grow() then
         # runs shard-local and merges per-level histograms with ONE psum —
         # the reduce-tree of ScoreBuildHistogram.java:98 / MRTask.java:907
@@ -298,6 +313,15 @@ class BinnedGrower:
         # end-to-end model accuracy matches the f32 path; until the on-chip
         # AUC-parity measurement lands (bench --int8), default stays off.
         self.int8 = False if int8_stats is None else bool(int8_stats)
+        # use_radix_shallow / fused_level: AUTO-ON (None) the way
+        # int8_stats=auto gates — each kernel family carries its own
+        # probe compile (HP.radix_supported / HP.fused_supported) and its
+        # own shape gate, so auto engages exactly where the Pallas
+        # program compiles and the level qualifies; False forces the
+        # dense/sequential reference paths (the parity baselines).
+        self.use_radix = None if use_radix_shallow in (None, True) \
+            else False
+        self.fused = None if fused_level in (None, True) else False
         self.spec = spec
         self.D = int(max_depth)
         self.L = 2 ** self.D
@@ -320,13 +344,19 @@ class BinnedGrower:
         return padded_rows(n, shards)
 
     def grow(self, codes, stats, F, *, eta, clip_val, key, mtries: int = 0,
-             tree_mask=None):
+             tree_mask=None, level_cb=None):
         """Grow ONE tree and apply its margin update — all device-resident.
 
-        codes: (C_pad, n_pad) i32 bin codes, COLUMN-major (dummy rows
-               carry zero stats)
+        codes: uint8 (C_pad, n_pad) code plane from `quantize`, or the
+               packed i32 (W_pad, n_pad) plane from `prepare_codes` on the
+               Pallas backend — COLUMN-major either way (dummy rows carry
+               zero stats)
         stats: (S_STATS, n_pad) f32 — rows 0=w 1=w*grad 2=w*hess 3=0
         F:     (n_pad,) f32 margins (updated in the terminal route pass)
+        level_cb: optional host callback `cb(d, sync_array)` invoked after
+               each level's dispatches — ONLY for the eager per-level
+               instrumentation path (bench measure_level_seconds); must be
+               None under jit.
 
         Returns dict(col, bin, nal, route, val, cover, gains, F).
         Per-row state is ONE heap-id int32 array; no row reordering ever
@@ -334,7 +364,8 @@ class BinnedGrower:
         kernel — see ops/hist_pallas.py header).
         """
         spec, D = self.spec, self.D
-        C, n_pad = codes.shape
+        C = spec.c_pad
+        n_pad = codes.shape[1]
         BP = spec.n_bins
         big = jnp.float32(3e38)
         nodes_p = -(-(self.nodes + 1) // 128) * 128
@@ -351,7 +382,6 @@ class BinnedGrower:
         lo = jnp.full(1, -big)
         hi = jnp.full(1, big)
         any_cat = bool(spec.is_cat.any())
-        zerovt = jnp.zeros((8, 128), jnp.float32)
         if self.int8:
             # per-tree, per-stat-row symmetric quantization: stats are fixed
             # for the whole tree, so ONE quantization pass serves every level
@@ -376,27 +406,29 @@ class BinnedGrower:
         for d in range(D):
             L = 1 << d
             base = L - 1
-            if prev is not None:
-                heap, _ = HP.sbh_route(codes, heap, prev["tbl"],
-                                       prev["route_f"], zerovt,
-                                       F, base=(L >> 1) - 1, L=L >> 1,
-                                       any_cat=any_cat,
-                                       na_code=spec.b_val)
             if d == 0:
                 hacc = hist_fn(codes, heap, stats_in, base=base, L=L,
-                               n_bins=BP)[:L, :C]
+                               n_bins=BP, radix=self.use_radix)[:L, :C]
                 if self.axis_name:
                     # the ScoreBuildHistogram reduce: merge shard-local
                     # histograms in one collective per level
                     hacc = lax.psum(hacc, self.axis_name)
             else:
-                # sibling subtraction: histogram LEFT children only (half
-                # the leaf window -> half the MXU dot), derive right =
-                # parent - left. Routing moves every row of a split leaf,
+                # ONE fused-or-sequential pass: route the previous level's
+                # splits, then (sibling subtraction) histogram LEFT
+                # children only over the UPDATED heap — half the leaf
+                # window -> half the MXU dot, and on the fused Pallas path
+                # the code tile is read ONCE for both phases. Right =
+                # parent - left: routing moves every row of a split leaf,
                 # so parent = left + right exactly; unsplit parents are
                 # masked to zero (their child slots are dead).
-                left = hist_fn(codes, heap, stats_in, base=base, L=L,
-                               n_bins=BP, half=True)[: L >> 1, :C]
+                heap, left = HP.sbh_route_hist(
+                    codes, heap, prev["tbl"], prev["route_f"], stats_in,
+                    base_r=(L >> 1) - 1, L_r=L >> 1, base_h=base, L_h=L,
+                    n_bins=BP, any_cat=any_cat, na_code=spec.b_val,
+                    int8=self.int8, fused=self.fused,
+                    radix=self.use_radix)
+                left = left[: L >> 1, :C]
                 if self.axis_name:
                     # psum BEFORE subtraction: hist_prev is already global
                     left = lax.psum(left, self.axis_name)
@@ -469,6 +501,12 @@ class BinnedGrower:
             hi = jnp.stack([jnp.where(did, hi_l, hi),
                             jnp.where(did, hi_r, hi)], 1).reshape(2 * L)
 
+            if level_cb is not None:
+                # eager instrumentation only (bench per-level breakdown):
+                # the callback syncs on the level's routing table — the
+                # array downstream of hist + find_splits
+                level_cb(d, prev["tbl"])
+
         # terminal pass: route the last level + fused F update
         L = 1 << D
         valt = jnp.clip(valA, -clip_val, clip_val) if clip_val else valA
@@ -479,6 +517,47 @@ class BinnedGrower:
                                na_code=spec.b_val)
         return dict(col=colA, bin=binA, nal=nalA, route=routeA, val=valt,
                     cover=coverA, gains=gains[:C], F=F, heap=heap)
+
+
+# ===========================================================================
+def measure_level_seconds(grower: BinnedGrower, codes, stats, F, *,
+                          eta=0.1, clip_val=0.0, key=None):
+    """Grow ONE tree EAGERLY with a host sync after every level and record
+    each level's wall time into `h2o3_tree_level_seconds{engine="binned",
+    level=d}` — the ISSUE-1 arbiter for the per-level cost breakdown (the
+    jitted K-tree trainer is one opaque program; ad-hoc timers inside it
+    cannot attribute the residual cost to a level). Returns
+    [{"level": d, "seconds": s}, ...] for the bench record."""
+    import time as _time
+    from h2o3_tpu.models.tree import engine as _E
+
+    rows: list[dict] = []
+    last = [0.0]
+
+    def sync_cb(d, sync_arr):
+        # scalar readback: through the TPU relay block_until_ready can
+        # return early; a float() readback is the reliable sync
+        # (ops/PERF_NOTES.md relay gotchas)
+        float(jnp.sum(sync_arr))
+
+    def cb(d, sync_arr):
+        sync_cb(d, sync_arr)
+        now = _time.perf_counter()
+        dt = now - last[0]
+        last[0] = now
+        _E._LEVEL_SECONDS.observe(dt, engine="binned", level=str(d))
+        rows.append({"level": d, "seconds": round(dt, 6)})
+
+    k = key if key is not None else jax.random.PRNGKey(0)
+    # warmup pass, synced but untimed: every level's static L compiles
+    # its own programs on first dispatch, and a compile (0.1-10 s) would
+    # swamp the ms-scale device cost the arbiter exists to expose
+    grower.grow(codes, stats, F, eta=eta, clip_val=clip_val, key=k,
+                level_cb=sync_cb)
+    last[0] = _time.perf_counter()
+    grower.grow(codes, stats, F, eta=eta, clip_val=clip_val, key=k,
+                level_cb=cb)
+    return rows
 
 
 # ===========================================================================
@@ -572,9 +651,10 @@ def gbm_chunk_trainer(grower: BinnedGrower, n: int, *, dist: str, eta: float,
                       mesh=None):
     """Build (and cache) the jitted K-tree training program.
 
-    Contract: codes (C_pad, n_pad) i32 from `quantize` (n real rows, the
-    rest dummies); y1/w1/F are (n_pad,) f32 with zeros beyond row n.
-    Returns (new F, stacked tree arrays) per call.
+    Contract: codes from `quantize` (uint8 (C_pad, n_pad)) run through
+    `prepare_codes` (the packed i32 plane on the Pallas backend; n real
+    rows, the rest dummies); y1/w1/F are (n_pad,) f32 with zeros beyond
+    row n. Returns (new F, stacked tree arrays) per call.
 
     With `mesh` given (and grower.axis_name set) the program is shard_mapped
     over the rows axis: codes/y1/w1/F are row-sharded, each shard grows the

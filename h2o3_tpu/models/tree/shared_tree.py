@@ -62,9 +62,15 @@ class SharedTreeEstimator(ModelBase):
         # at 255 (a root histogram at 1024 bins halved 2 levels ≈ 256).
         # None = derive from nbins alone (the engine's own default).
         "nbins_top_level": None,
-        # TPU extension: int8-quantized histogram stats on the 2x-rate int8
-        # MXU path (None = auto: on wherever the Pallas kernels run)
+        # TPU extensions (None = auto: on wherever the kernel family's
+        # probe compile passes and the shape qualifies; False = force the
+        # dense/sequential reference paths): int8-quantized histogram
+        # stats on the 2x-rate int8 MXU path; the radix-factored
+        # shallow-window histogram kernel; the level-fused route+hist
+        # kernel (ops/hist_pallas.py).
         "int8_hist": None,
+        "radix_shallow": None,
+        "fused_level": None,
     }
 
     def _cat_mode(self):
@@ -191,9 +197,14 @@ class SharedTreeEstimator(ModelBase):
             min_split_improvement=float(p["min_split_improvement"]),
             monotone=mono if mc else None,
             axis_name=MESH.ROWS if multi else None,
-            int8_stats=p.get("int8_hist"))
+            int8_stats=p.get("int8_hist"),
+            use_radix_shallow=p.get("radix_shallow"),
+            fused_level=p.get("fused_level"))
         n_pad = grower.layout(n, shards=shards if multi else 1)
-        codes = BN.quantize(X, spec, n_pad=n_pad)
+        # uint8 code plane (1 byte/code in HBM), packed to the Pallas
+        # kernels' i32 word layout on TPU — the row axis is untouched so
+        # the rows sharding spec below applies to either layout
+        codes = BN.prepare_codes(BN.quantize(X, spec, n_pad=n_pad))
         y1 = BN.pad_rows(y, n_pad)
         w1 = BN.pad_rows(w, n_pad)
         if multi:
@@ -201,10 +212,22 @@ class SharedTreeEstimator(ModelBase):
             codes = jax.device_put(codes, cl.sharding(P(None, MESH.ROWS)))
             y1 = jax.device_put(y1, cl.rows_sharding(1))
             w1 = jax.device_put(w1, cl.rows_sharding(1))
+        # register the code plane with the DKV tier pager: training
+        # re-streams it every level, so it is pinned (never an LRU victim
+        # mid-build) but now VISIBLE to the HBM accounting that budget
+        # demotions are judged against (h2o3_dkv_tier_bytes) — and at
+        # uint8/packed size it is 4x smaller than the old i32 planes.
+        # The chunk dies with the training context (weakref reaping).
+        codes_chunk = None
+        from h2o3_tpu.core.tiering import PAGER
+        if PAGER.enabled:
+            codes_chunk = PAGER.new_chunk(codes, None, label="tree_codes",
+                                          pinned=1)
         return dict(BN=BN, X=X, y=y, w=w, y1=y1, w1=w1, codes=codes, n=n,
                     C=C, is_cat=is_cat, spec=spec, grower=grower,
                     n_pad=n_pad, cl=cl, multi=multi,
-                    mesh=cl.mesh if multi else None)
+                    mesh=cl.mesh if multi else None,
+                    codes_chunk=codes_chunk)
 
     def _binned_tree_arrays(self, ctx, chunks, prev=None, lead=None):
         """Assemble E.TreeArrays from trainer chunk outputs (+ an optional

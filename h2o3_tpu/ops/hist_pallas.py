@@ -13,28 +13,55 @@ TPU-native design (measured on v5e): random gathers/scatters run at only
 (an explicit leaf-partition + gather design measured ~10x slower than the
 kernels it fed). Rows stay in original order; per-row state is ONE int32
 `heap` (node id in the 2^(D+1)-1 heap; a row whose node did not split keeps
-its heap id and freezes). Codes are stored COLUMN-major (C_pad, n_pad) —
-the natural layout for both kernels (rows ride the 128-wide lane dimension)
-and the only one whose column blocks satisfy Mosaic's lane-tiling rules.
+its heap id and freezes). Codes are stored COLUMN-major — the natural
+layout for both kernels (rows ride the 128-wide lane dimension).
 
-Two kernels per level:
+CODE PLANES (round 4): bins are <= 255+NA so a code needs ONE byte, and the
+HBM code stream at 150-200 GB/s effective is the measured per-level
+bandwidth floor (ops/PERF_NOTES.md). The binner therefore emits codes as
+uint8 (C_pad, n_pad); for the TPU kernels `pack_codes` packs FOUR uint8
+codes per int32 word along the COLUMN axis into a (W_pad, n_pad) i32
+"packed plane" — 1 byte/code in HBM (4x less code traffic than the old i32
+planes) while every Pallas block stays an i32 tile that satisfies Mosaic's
+sublane granule (a raw uint8 (8, R) block would violate the (32, 128) int8
+tile; the i32 word is the legal carrier and bytes are extracted INSIDE the
+kernel tile, never widened in HBM). The XLA fallbacks (CPU tests, exotic
+backends) consume the uint8 plane directly — dtype-agnostic segment sums,
+bit-identical to the old i32 planes.
+
+Kernels per level:
 
   * sbh_route — phase 1. Applies the previous level's splits: the per-leaf
     split metadata lives in small VMEM tables and every per-row lookup is a
     one-hot matmul / compare-select (there is no vector gather on TPU).
+    The split column's code comes from a word compare-select over the
+    packed plane's sublanes plus a per-lane variable shift (byte extract).
     The full (numeric threshold / categorical SET / NA direction) decision
     is precompiled by the split search into a per-leaf
     `route[leaf, code] -> goes-right` table, so the kernel is decision-
-    agnostic. Optionally fuses the margin update F += eta*val[heap] (the
-    terminal-pass variant) — ComputePredAndRes's gather folded into the
-    same stream.
+    agnostic. Non-terminal levels no longer stream F through the kernel
+    (8 bytes/row/level saved); the terminal pass fuses the margin update
+    F += eta*val[heap] (ComputePredAndRes's gather folded into the stream).
 
-  * sbh_hist — phase 2. Grid (pass, col-block, row-tile); output block
-    (CB cols, nb bins, GW*S lanes) stays VMEM-resident across the whole
+  * sbh_hist — phase 2. Grid (pass, word-block, row-tile); output block
+    (32 cols, gwe*S lanes, nb bins) stays VMEM-resident across the whole
     row sweep (the grouped-matmul revisiting pattern) and accumulates
-    onehot(codes) @ A where A packs (leaf-slot x {w,wg,wh}) into exactly
-    GW*S_STATS = 128 MXU lanes. No CAS, no private copies, no reduce tree:
-    cross-shard merging is one psum over the mesh row axis by the caller.
+    onehot(codes) @ A where A packs (leaf-slot x {w,wg,wh}) MXU lanes.
+    No CAS, no private copies, no reduce tree: cross-shard merging is one
+    psum over the mesh row axis by the caller.
+
+  * sbh_route_hist — the LEVEL-FUSED pass (PERF_NOTES item 4, the
+    ScoreBuildHistogram2 shape itself): ONE kernel reads the code tile
+    once, routes the rows, and accumulates the histogram over the UPDATED
+    heap — halving code traffic again at the shallow levels where the
+    histogram is bandwidth-floor (not dot) bound. Auto-on only where the
+    fused program compiles (`fused_supported` probe) and the whole-level
+    histogram fits VMEM (`_fused_applicable`); the unfused route+hist
+    pair is always the fallback and the XLA path.
+
+  * sbh_hist_radix — radix-factored shallow-window histogram (PERF_NOTES
+    item 1): code = hi*16+lo with the leaf slot fused into the hi key
+    kills the 256-wide VPU one-hot floor at effective windows <= 2.
 
 Stats panel rows (S_STATS=4): 0=w, 1=w*grad, 2=w*hess, 3=spare(0) —
 (w, wg, wh) feed split gain, min_rows and Newton leaf values
@@ -58,85 +85,192 @@ except Exception:  # pragma: no cover
 
 # Rows per kernel grid step. n_pad must be a multiple of this.
 BLOCK_ROWS = 4096
-# Stats panel sublane count; GW * S_STATS = 128 lanes exactly.
+# Stats panel sublane count.
 S_STATS = 4
-# Leaf-window width per histogram pass (M = GW*S_STATS lanes, max 512).
-GW = 128
-# Column tile per histogram grid step.
+# Leaf-window width per histogram pass. 64 (not 128): the packed kernels
+# sweep 32 columns per grid step, and a 128-leaf window's output block
+# (32 x 512 x 256 f32) would blow the 16MB VMEM budget; 64 keeps the
+# resident block at 8MB and only doubles npass at l_eff >= 128 — where
+# the packed plane already cut the re-streamed code bytes 4x.
+GW = 64
+# Column tile of the LEGACY (unpacked) layout; kept for the XLA fallbacks'
+# callers and the padded-column contract (c_pad is a COL_TILE multiple).
 COL_TILE = 8
+# uint8 codes per packed i32 word (column-axis packing).
+PACK = 4
+# Packed words per histogram grid step (PACK*WORD_TILE = 32 columns).
+WORD_TILE = 8
 
 
 def use_pallas() -> bool:
     return _HAVE_PALLAS and jax.default_backend() == "tpu"
 
 
+def is_packed(codes) -> bool:
+    """True when `codes` is a packed i32 plane for the Pallas kernels (the
+    TPU layout produced by pack_codes); uint8/int32-unpacked planes run
+    the XLA fallbacks. The dtype IS the layout tag: prepare_codes only
+    ever emits i32 on the Pallas backend."""
+    return use_pallas() and codes.dtype == jnp.int32
+
+
+# ===========================================================================
+# Packed code planes
+def packed_words(c_pad: int) -> int:
+    """Words per packed plane for a c_pad-column code plane: ceil(C/4),
+    padded to a WORD_TILE multiple once it exceeds one tile (sub-tile
+    planes ride a single full-dim block, like the (S, R) stats panel)."""
+    w = -(-c_pad // PACK)
+    return w if w <= WORD_TILE else -(-w // WORD_TILE) * WORD_TILE
+
+
+@jax.jit
+def pack_codes(codes_u8):
+    """(C_pad, n_pad) uint8 -> (W_pad, n_pad) int32 packed plane: little-
+    endian bytes, 4 codes/word along the COLUMN axis (dummy columns pack
+    as code 0 = zero-stat rows' bin). The row axis is untouched, so row
+    sharding specs carry over unchanged."""
+    c_pad, n_pad = codes_u8.shape
+    w_pad = packed_words(c_pad)
+    c = jnp.pad(codes_u8, ((0, w_pad * PACK - c_pad), (0, 0))) \
+        .astype(jnp.int32).reshape(w_pad, PACK, n_pad)
+    return c[:, 0] | (c[:, 1] << 8) | (c[:, 2] << 16) | (c[:, 3] << 24)
+
+
+@functools.partial(jax.jit, static_argnames=("c_pad",))
+def unpack_codes(packed, *, c_pad):
+    """Inverse of pack_codes (tests + reference math)."""
+    w_pad, n_pad = packed.shape
+    parts = [(packed >> (8 * k)) & 255 for k in range(PACK)]
+    u = jnp.stack(parts, axis=1).reshape(w_pad * PACK, n_pad)
+    return u[:c_pad].astype(jnp.uint8)
+
+
+def prepare_codes(codes_u8):
+    """Backend-appropriate kernel layout for a quantized uint8 plane:
+    packed i32 words on the Pallas backend, the uint8 plane itself (the
+    XLA fallbacks' input) everywhere else."""
+    if use_pallas():
+        return pack_codes(codes_u8)
+    return codes_u8
+
+
+# ===========================================================================
+# Probes: auto-enabling a kernel family must never brick training (or the
+# bench) on a TPU generation whose Mosaic rejects its tiling — compile each
+# once with a tiny shape and cache the answer.
 _I8_OK: bool | None = None
+_RADIX_OK: bool | None = None
+_FUSED_OK: bool | None = None
+
+
+def _probe_plane():
+    u8 = jnp.zeros((COL_TILE, BLOCK_ROWS), jnp.uint8)
+    return pack_codes(u8)
 
 
 def i8_supported() -> bool:
-    """True when the int8 histogram kernel compiles + runs on this chip.
-    Auto-enabling int8 stats must not brick training (or the bench) on a
-    TPU generation whose Mosaic rejects the int8 tiling — probe once with
-    a tiny shape and cache the answer."""
+    """True when the int8-stats histogram kernel compiles + runs here."""
     global _I8_OK
     if _I8_OK is None:
         if not use_pallas():
             _I8_OK = False
         else:
             try:
-                c = jnp.zeros((COL_TILE, BLOCK_ROWS), jnp.int32)
+                cp = _probe_plane()
                 h = jnp.zeros(BLOCK_ROWS, jnp.int32)
                 s = jnp.ones((S_STATS, BLOCK_ROWS), jnp.int32)
-                out = sbh_hist_pallas_i8(c, h, s, base=0, L=1, n_bins=128)
+                out = sbh_hist_pallas_i8(cp, h, s, base=0, L=1, n_bins=128)
                 _I8_OK = int(jnp.sum(out[0, 0, 0])) == BLOCK_ROWS
             except Exception:  # pragma: no cover - chip-specific
                 _I8_OK = False
     return _I8_OK
 
 
-# ===========================================================================
-# Phase 1: route rows by the previous level's splits
-def _route_kernel(codesT_ref, heap_ref, tbl_ref, route_ref, valtab_ref,
-                  f_ref, heap_out_ref, f_out_ref, *, base, L, n_cols,
-                  n_bins, eta, emit_f, any_cat, na_code):
-    """One row tile: apply splits of the level whose leaves sit at heap ids
-    [base, base+L); optionally add eta*val[newheap] into F.
+def radix_supported() -> bool:
+    """Probe-compile the radix shallow-window kernel once."""
+    global _RADIX_OK
+    if _RADIX_OK is None:
+        if not use_pallas():
+            _RADIX_OK = False
+        else:
+            try:
+                cp = _probe_plane()
+                h = jnp.zeros(BLOCK_ROWS, jnp.int32)
+                s = jnp.ones((S_STATS, BLOCK_ROWS), jnp.float32)
+                out = sbh_hist_radix(cp, h, s, base=0, L=1, n_bins=256)
+                _RADIX_OK = abs(float(out[0, 0, 0, 0])
+                                - BLOCK_ROWS) < 0.5
+            except Exception:  # pragma: no cover - chip-specific
+                _RADIX_OK = False
+    return _RADIX_OK
 
-    codesT_ref: (C_pad, R) i32    heap_ref/heap_out_ref: (1, R) i32
-    tbl_ref:    (8, Lp) f32 — row 0 = split col, row 1 = did (0/1)
-    route_ref:  (Lp, n_bins) f32 — 1.0 = code goes right
-    valtab_ref: (8, NODES_P) f32 — row 0 = leaf value table (terminal pass)
-    f_ref/f_out_ref: (1, R) f32 margins
-    """
-    R = BLOCK_ROWS
-    heap = heap_ref[0, :]                                     # (R,)
+
+def fused_supported() -> bool:
+    """Probe-compile the level-fused route+hist kernel once."""
+    global _FUSED_OK
+    if _FUSED_OK is None:
+        if not use_pallas():
+            _FUSED_OK = False
+        else:
+            try:
+                cp = _probe_plane()
+                heap = jnp.zeros(BLOCK_ROWS, jnp.int32)
+                tbl = jnp.zeros((8, 8), jnp.float32).at[1, 0].set(1.0)
+                route_f = jnp.zeros((8, 256), jnp.float32)
+                s = jnp.ones((S_STATS, BLOCK_ROWS), jnp.float32)
+                nh, hist = sbh_route_hist_fused_pallas(
+                    cp, heap, tbl, route_f, s, base_r=0, L_r=1, base_h=1,
+                    L_h=2, n_bins=256, any_cat=True, na_code=255)
+                # every row splits left (route table all-zero): heap 0 -> 1,
+                # leaf 0 (even) lands in window slot 0, bin 0
+                _FUSED_OK = (int(nh[0]) == 1
+                             and abs(float(hist[0, 0, 0, 0])
+                                     - BLOCK_ROWS) < 0.5)
+            except Exception:  # pragma: no cover - chip-specific
+                _FUSED_OK = False
+    return _FUSED_OK
+
+
+# ===========================================================================
+# Shared kernel bodies (route math / stats panel / per-column accumulation)
+# — one definition each so the standalone kernels and the fused kernel
+# cannot drift semantically.
+def _route_math(words, heap, tbl, route, *, base, L, n_bins, any_cat,
+                na_code):
+    """New heap ids for one row tile. `words` is the loaded packed-plane
+    tile (W_pad, R); `tbl`/`route` the loaded split tables."""
+    R = heap.shape[0]
     leaf = heap - base
     active = (leaf >= 0) & (leaf < L)
     leaf_c = jnp.where(active, leaf, 0)
     # one-hot over the level's leaves — per-row table lookups are matmuls
-    Lp = tbl_ref.shape[1]
+    Lp = tbl.shape[1]
     iota_l = lax.broadcasted_iota(jnp.int32, (R, Lp), 1)
     active_f = active.astype(jnp.float32)
     ohl_f = ((iota_l == leaf_c[:, None]).astype(jnp.float32)
              * active_f[:, None])                             # (R, Lp) f32
-    ohl = ohl_f.astype(jnp.bfloat16)
     # props lookup stays f32: bf16 cannot represent col ids > 256 or split
     # bins > 256 exactly, which would silently misroute wide frames
-    props = lax.dot_general(ohl_f, tbl_ref[...],
+    props = lax.dot_general(ohl_f, tbl,
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (R, 8)
-    col_r = props[:, 0]
     did_r = props[:, 1] > 0.5
-    # code of the split column: compare-select over the column sublanes
-    codes_f = codesT_ref[...].astype(jnp.float32)             # (C, R)
-    iota_c = lax.broadcasted_iota(jnp.int32, (n_cols, R), 0) \
-        .astype(jnp.float32)
-    csel = (iota_c == col_r[None, :]).astype(jnp.float32)     # (C, R)
-    code_sel = jnp.sum(codes_f * csel, axis=0)                # (R,)
+    # split column's code: word compare-select over the packed sublanes
+    # (exact i32 sum — a one-hot f32 dot would round packed words > 2^24),
+    # then a per-lane variable shift extracts the byte
+    col_i = props[:, 0].astype(jnp.int32)
+    wi = col_i >> 2
+    shift = (col_i & 3) * 8
+    w_pad = words.shape[0]
+    iota_w = lax.broadcasted_iota(jnp.int32, (w_pad, R), 0)
+    wsel = jnp.sum(jnp.where(iota_w == wi[None, :], words, 0), axis=0)
+    code_i = (wsel >> shift) & 255                            # (R,) i32
+    code_sel = code_i.astype(jnp.float32)
     if any_cat:
         # goes-right bit via the full route table: route[leaf, code]
         rowroute = lax.dot_general(
-            ohl, route_ref[...].astype(jnp.bfloat16),
+            ohl_f.astype(jnp.bfloat16), route.astype(jnp.bfloat16),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (R, BP)
         iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1) \
@@ -153,42 +287,179 @@ def _route_kernel(codesT_ref, heap_ref, tbl_ref, route_ref, valtab_ref,
         gt_f = (code_sel > bin_r).astype(jnp.float32)
         go = (isna_f * (1.0 - nal_f) + (1.0 - isna_f) * gt_f) > 0.5
     splits = active & did_r
-    newheap = jnp.where(splits, 2 * heap + 1 + go.astype(jnp.int32), heap)
-    heap_out_ref[0, :] = newheap
-    if emit_f:
-        nodes_p = valtab_ref.shape[1]
-        iota_n = lax.broadcasted_iota(jnp.int32, (R, nodes_p), 1)
-        # f32 one-hot x f32 table: leaf values must reach F at full
-        # precision (scoring reads the same values as f32)
-        ohn = (iota_n == newheap[:, None]).astype(jnp.float32)
-        val_r = lax.dot_general(
-            ohn, valtab_ref[...],
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)[:, 0]
-        f_out_ref[0, :] = f_ref[0, :] + eta * val_r
+    return jnp.where(splits, 2 * heap + 1 + go.astype(jnp.int32), heap)
+
+
+def _stats_panel(heap, stats, *, base, L, gwe, p, half, int8):
+    """The (gwe*S_STATS, R) MXU lhs panel A: row (slot, s) holds stat s of
+    rows whose leaf sits in window slot `slot` of pass `p`. With half=True
+    only EVEN leaf indices (left children) are accumulated — window slot =
+    leaf >> 1 — and the caller derives right children by sibling
+    subtraction (parent minus left; the same trick xgboost/lightgbm use —
+    valid because routing moves EVERY row of a split leaf to a child, so
+    parent = left + right exactly; i32 accumulation makes it lossless on
+    the int8-stats path)."""
+    R = heap.shape[0]
+    leaf = heap - base
+    if half:
+        slot = (leaf >> 1) - p * gwe
+        inw = (leaf >= 0) & (leaf < L) & ((leaf & 1) == 0)
     else:
-        f_out_ref[0, :] = f_ref[0, :]
+        slot = leaf - p * gwe
+        inw = (leaf >= 0) & (leaf < L)
+    inw = inw & (slot >= 0) & (slot < gwe)
+    slot_c = jnp.where(inw, slot, 0)
+    iota_s = lax.broadcasted_iota(jnp.int32, (gwe, R), 0)
+    if int8:
+        sel = (iota_s == slot_c[None, :]) & inw[None, :]      # (gwe, R)
+        return (jnp.where(sel[:, None, :], stats[None, :, :], 0)
+                .reshape(gwe * S_STATS, R)).astype(jnp.int8)
+    inw_f = inw.astype(jnp.float32)
+    ohs = ((iota_s == slot_c[None, :]).astype(jnp.float32)
+           * inw_f[None, :])                                  # (gwe, R)
+    return (ohs[:, None, :] * stats[None, :, :]) \
+        .reshape(gwe * S_STATS, R).astype(jnp.bfloat16)
+
+
+def _dense_parts(words, A, *, n_bins, int8):
+    """Per-column histogram dots for one packed-word tile: byte-extract
+    each code INSIDE the tile (never widened in HBM), one-hot it, dot
+    against the stats panel. Returns 4*W parts of (M, nb)."""
+    R = words.shape[1]
+    if int8:
+        iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1)
+    else:
+        # one-hot built TRANSPOSED (nb, R): bins on sublanes, rows on
+        # lanes. Measured 1.9x faster than the (R, nb) orientation — the
+        # compare broadcast is a major-dim insert (free) instead of a
+        # minor-dim relayout, and the dot contracts the rhs on dim 1.
+        iota_b = lax.broadcasted_iota(jnp.int32, (n_bins, R), 0)
+    parts = []
+    for w in range(words.shape[0]):
+        word = words[w, :]                                    # (R,) static w
+        for k in range(PACK):
+            code = (word >> (8 * k)) & 255
+            if int8:
+                oh = (iota_b == code[:, None]).astype(jnp.int8)
+                h = lax.dot_general(A, oh, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            else:
+                ohT = (iota_b == code[None, :]).astype(jnp.bfloat16)
+                h = lax.dot_general(A, ohT, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            parts.append(h)                                   # (M, nb)
+    return parts
+
+
+def _radix_parts(words, slot_c, stats, *, gwe, n_bins, int8):
+    """Radix-factored per-column accumulation: code = hi*16 + lo with the
+    leaf slot fused into the hi key — a gwe*16-wide joint compare plus a
+    16-wide lo one-hot replaces the 256-wide dense compare (2.7x fewer
+    VPU element-ops at window 1; see PERF_NOTES item 1). `slot_c` is the
+    window slot with dead rows already pushed out of range (>= gwe)."""
+    NH = RADIX_NH
+    nl = n_bins // NH
+    R = words.shape[1]
+    iota_k = lax.broadcasted_iota(jnp.int32, (gwe * NH, R), 0)
+    iota_lo = lax.broadcasted_iota(jnp.int32, (nl, R), 0)
+    parts = []
+    for w in range(words.shape[0]):
+        word = words[w, :]
+        for k in range(PACK):
+            code = (word >> (8 * k)) & 255
+            key = slot_c * NH + code // nl
+            lo = code % nl
+            J = iota_k == key[None, :]                        # (gwe*NH, R)
+            if int8:
+                A = jnp.where(J[:, None, :], stats[None, :, :], 0) \
+                    .reshape(gwe * NH * S_STATS, R).astype(jnp.int8)
+                ohlo = (iota_lo == lo[None, :]).astype(jnp.int8)
+                h = lax.dot_general(A, ohlo, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            else:
+                A = jnp.where(J[:, None, :], stats[None, :, :], 0.0) \
+                    .reshape(gwe * NH * S_STATS, R).astype(jnp.bfloat16)
+                ohlo = (iota_lo == lo[None, :]).astype(jnp.bfloat16)
+                h = lax.dot_general(A, ohlo, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            parts.append(h)                                   # (gwe*NH*S, nl)
+    return parts
+
+
+# ===========================================================================
+# Phase 1: route rows by the previous level's splits
+def _route_kernel(codesP_ref, heap_ref, tbl_ref, route_ref,
+                  heap_out_ref, *, base, L, n_bins, any_cat, na_code):
+    """Non-terminal route: heap update only — F is NOT streamed through
+    the kernel (it is untouched between terminal passes)."""
+    heap_out_ref[0, :] = _route_math(
+        codesP_ref[...], heap_ref[0, :], tbl_ref[...], route_ref[...],
+        base=base, L=L, n_bins=n_bins, any_cat=any_cat, na_code=na_code)
+
+
+def _route_kernel_f(codesP_ref, heap_ref, tbl_ref, route_ref, valtab_ref,
+                    f_ref, heap_out_ref, f_out_ref, *, base, L, n_bins,
+                    eta, any_cat, na_code):
+    """Terminal route: heap update + fused margin update F += eta*val[heap]
+    (ComputePredAndRes's gather folded into the same stream)."""
+    R = f_ref.shape[1]
+    newheap = _route_math(
+        codesP_ref[...], heap_ref[0, :], tbl_ref[...], route_ref[...],
+        base=base, L=L, n_bins=n_bins, any_cat=any_cat, na_code=na_code)
+    heap_out_ref[0, :] = newheap
+    nodes_p = valtab_ref.shape[1]
+    iota_n = lax.broadcasted_iota(jnp.int32, (R, nodes_p), 1)
+    # f32 one-hot x f32 table: leaf values must reach F at full precision
+    # (scoring reads the same values as f32)
+    ohn = (iota_n == newheap[:, None]).astype(jnp.float32)
+    val_r = lax.dot_general(
+        ohn, valtab_ref[...],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)[:, 0]
+    f_out_ref[0, :] = f_ref[0, :] + eta * val_r
 
 
 @functools.partial(jax.jit,
                    static_argnames=("base", "L", "eta", "emit_f",
                                     "any_cat", "na_code"))
-def sbh_route_pallas(codesT, heap, tbl, route_f, valtab, F, *, base, L,
-                     eta=0.0, emit_f=False, any_cat=True, na_code=255):
-    """codesT (C_pad, n_pad) i32; heap (n_pad,) i32; tbl (8, Lp) f32;
-    route_f (Lp, n_bins) f32; valtab (8, NODES_P) f32; F (n_pad,) f32.
-    Returns (newheap, newF)."""
-    c_pad, n_pad = codesT.shape
+def sbh_route_pallas(codesP, heap, tbl, route_f, valtab=None, F=None, *,
+                     base, L, eta=0.0, emit_f=False, any_cat=True,
+                     na_code=255):
+    """codesP (W_pad, n_pad) i32 packed plane; heap (n_pad,) i32;
+    tbl (8, Lp) f32 (row 0 = split col, 1 = did, 2 = split bin,
+    3 = na-goes-left); route_f (Lp, n_bins) f32 (1.0 = code goes right);
+    valtab (8, NODES_P) f32 / F (n_pad,) f32 only with emit_f.
+    Returns (newheap, newF) — newF is None when emit_f=False."""
+    w_pad, n_pad = codesP.shape
     nblk = n_pad // BLOCK_ROWS
     n_bins = route_f.shape[1]
-    kernel = functools.partial(_route_kernel, base=base, L=L, n_cols=c_pad,
-                               n_bins=n_bins, eta=eta, emit_f=emit_f,
-                               any_cat=any_cat, na_code=na_code)
+    if not emit_f:
+        kernel = functools.partial(_route_kernel, base=base, L=L,
+                                   n_bins=n_bins, any_cat=any_cat,
+                                   na_code=na_code)
+        newheap = pl.pallas_call(
+            kernel,
+            grid=(nblk,),
+            in_specs=[
+                pl.BlockSpec((w_pad, BLOCK_ROWS), lambda j: (0, j)),
+                pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+                pl.BlockSpec(tbl.shape, lambda j: (0, 0)),
+                pl.BlockSpec(route_f.shape, lambda j: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+            out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+        )(codesP, heap.reshape(1, n_pad), tbl, route_f)
+        return newheap[0], None
+    kernel = functools.partial(_route_kernel_f, base=base, L=L,
+                               n_bins=n_bins, eta=eta, any_cat=any_cat,
+                               na_code=na_code)
     newheap, newF = pl.pallas_call(
         kernel,
         grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((c_pad, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec((w_pad, BLOCK_ROWS), lambda j: (0, j)),
             pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
             pl.BlockSpec(tbl.shape, lambda j: (0, 0)),
             pl.BlockSpec(route_f.shape, lambda j: (0, 0)),
@@ -205,14 +476,17 @@ def sbh_route_pallas(codesT, heap, tbl, route_f, valtab, F, *, base, L,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-    )(codesT, heap.reshape(1, n_pad), tbl, route_f, valtab,
+    )(codesP, heap.reshape(1, n_pad), tbl, route_f, valtab,
       F.reshape(1, n_pad))
     return newheap[0], newF[0]
 
 
-def sbh_route_xla(codesT, heap, tbl, route_f, valtab, F, *, base, L,
-                  eta=0.0, emit_f=False, any_cat=True, na_code=255):
-    """Pure-XLA fallback: same contract (CPU scatter/gather is fast)."""
+def sbh_route_xla(codesT, heap, tbl, route_f, valtab=None, F=None, *,
+                  base, L, eta=0.0, emit_f=False, any_cat=True,
+                  na_code=255):
+    """Pure-XLA fallback: same contract (CPU scatter/gather is fast).
+    codesT is the UNPACKED (C_pad, n_pad) plane — uint8 or legacy i32;
+    the integer arithmetic below is dtype-agnostic and bit-identical."""
     leaf = heap - base
     active = (leaf >= 0) & (leaf < L)
     leaf_c = jnp.where(active, leaf, 0)
@@ -220,7 +494,7 @@ def sbh_route_xla(codesT, heap, tbl, route_f, valtab, F, *, base, L,
     did_r = (tbl[1, leaf_c] > 0.5) & active
     code_sel = jnp.take_along_axis(
         codesT, jnp.clip(col_r, 0, codesT.shape[0] - 1)[None, :],
-        axis=0)[0]
+        axis=0)[0].astype(jnp.int32)
     n_bins = route_f.shape[1]
     go = route_f.reshape(-1)[leaf_c * n_bins + code_sel] > 0.5
     splits = active & did_r
@@ -229,35 +503,23 @@ def sbh_route_xla(codesT, heap, tbl, route_f, valtab, F, *, base, L,
     return newheap, newF
 
 
-def sbh_route(codesT, heap, tbl, route_f, valtab, F, *, base, L,
+def sbh_route(codes, heap, tbl, route_f, valtab=None, F=None, *, base, L,
               eta=0.0, emit_f=False, any_cat=True, na_code=255):
-    if use_pallas():
-        return sbh_route_pallas(codesT, heap, tbl, route_f, valtab, F,
+    if is_packed(codes):
+        return sbh_route_pallas(codes, heap, tbl, route_f, valtab, F,
                                 base=base, L=L, eta=eta, emit_f=emit_f,
                                 any_cat=any_cat, na_code=na_code)
-    return sbh_route_xla(codesT, heap, tbl, route_f, valtab, F,
+    return sbh_route_xla(codes, heap, tbl, route_f, valtab, F,
                          base=base, L=L, eta=eta, emit_f=emit_f,
                          any_cat=any_cat, na_code=na_code)
 
 
 # ===========================================================================
 # Phase 2: leaf-window histogram accumulation
-def _hist_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
-                 n_bins, gwe, r_blk, half):
-    """Grid (pass, col-block, row-tile): accumulate the (CB, gwe*S, nb)
-    window block over the row sweep; gwe = min(L_eff, GW) leaves per pass.
-
-    With half=True only EVEN leaf indices (left children) are accumulated —
-    window slot = leaf >> 1 — and the caller derives right children by
-    sibling subtraction (parent histogram minus left child; the same trick
-    xgboost/lightgbm use — valid because routing moves EVERY row of a split
-    leaf to a child, so parent = left + right exactly).
-
-    codesT_ref: (COL_TILE, R) i32 — this col-block's codes
-    heap_ref:   (1, R) i32        stats_ref: (S_STATS, R) f32
-    out_ref:    (1, COL_TILE, gwe*S_STATS, n_bins) f32
-    """
-    R = r_blk
+def _hist_kernel(codesP_ref, heap_ref, stats_ref, out_ref, *, base, L,
+                 n_bins, gwe, half, int8):
+    """Grid (pass, word-block, row-tile): accumulate the (4*W, gwe*S, nb)
+    window block over the row sweep; gwe = min(l_eff, GW) leaves/pass."""
     p = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -265,83 +527,79 @@ def _hist_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    heap = heap_ref[0, :]                                  # (R,) lanes
-    leaf = heap - base
-    if half:
-        slot = (leaf >> 1) - p * gwe
-        inw = (leaf >= 0) & (leaf < L) & ((leaf & 1) == 0)
-    else:
-        slot = leaf - p * gwe
-        inw = (leaf >= 0) & (leaf < L)
-    inw = inw & (slot >= 0) & (slot < gwe)
-    slot_c = jnp.where(inw, slot, 0)
-    # A ((gwe*S), R): row (slot, s); rows of the tile ride the lanes — the
-    # measured-fast dot orientation is (M, R) @ (R, nb)
-    iota_s = lax.broadcasted_iota(jnp.int32, (gwe, R), 0)
-    inw_f = inw.astype(jnp.float32)
-    ohs = ((iota_s == slot_c[None, :]).astype(jnp.float32)
-           * inw_f[None, :])                               # (gwe, R)
-    stats = stats_ref[...]                                 # (S, R) f32
-    A = (ohs[:, None, :] * stats[None, :, :]) \
-        .reshape(gwe * S_STATS, R).astype(jnp.bfloat16)    # (M, R)
-
-    acc = out_ref[...]
-    # one-hot built TRANSPOSED (nb, R): bins on sublanes, rows on lanes.
-    # Measured 1.9x faster than the (R, nb) orientation — the compare
-    # broadcast is a major-dim insert (free) instead of a minor-dim
-    # relayout, and the dot contracts the rhs on dim 1 directly.
-    iota_b = lax.broadcasted_iota(jnp.int32, (n_bins, R), 0)
-    parts = []
-    for c in range(COL_TILE):
-        code_c = codesT_ref[c, :]                          # (R,) static c
-        ohT = (iota_b == code_c[None, :]).astype(jnp.bfloat16)  # (nb, R)
-        h = lax.dot_general(A, ohT, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (M, nb)
-        parts.append(h)
-    out_ref[...] = acc + jnp.stack(parts)[None]            # (1, CB, M, nb)
+    A = _stats_panel(heap_ref[0, :], stats_ref[...], base=base, L=L,
+                     gwe=gwe, p=p, half=half, int8=int8)
+    parts = _dense_parts(codesP_ref[...], A, n_bins=n_bins, int8=int8)
+    out_ref[...] = out_ref[...] + jnp.stack(parts)[None]
 
 
-@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
-def sbh_hist_pallas(codesT, heap, stats, *, base, L, n_bins, half=False):
-    """codesT (C_pad, n_pad) i32; heap (n_pad,) i32; stats (S, n_pad) f32.
-    Returns (L_pad, C_pad, S_STATS, n_bins) f32 with L_pad = npass*gwe:
-    hist[l] = per-(col, stat, bin) sums over rows with heap == base + l
-    (half=True: over rows with heap == base + 2l — left children only)."""
-    c_pad, n_pad = codesT.shape
+def _hist_pallas(codesP, heap, stats, *, base, L, n_bins, half, int8):
+    w_pad, n_pad = codesP.shape
+    cw = min(w_pad, WORD_TILE)
+    ncw = w_pad // cw
+    cc = cw * PACK
     l_eff = (L + 1) // 2 if half else L
     gwe = min(l_eff, GW)
     npass = max(1, -(-l_eff // gwe))
-    ncb = c_pad // COL_TILE
-    # VMEM budget: A (M, R) bf16 + oh (R, nb) bf16 + out (CB, M, nb) f32
-    # hit the 16MB limit at M=512, so deep levels run narrower row tiles
-    r_blk = BLOCK_ROWS if gwe * S_STATS <= 256 else BLOCK_ROWS // 2
+    # VMEM budget: out (cc, gwe*S, nb) f32 + A (gwe*S, R) + ohT (nb, R);
+    # at gwe*S = 256 the 8MB out block forces a narrower row tile
+    r_blk = BLOCK_ROWS if gwe * S_STATS <= 128 else BLOCK_ROWS // 2
     nblk = n_pad // r_blk
     kernel = functools.partial(_hist_kernel, base=base, L=L, n_bins=n_bins,
-                               gwe=gwe, r_blk=r_blk, half=half)
+                               gwe=gwe, half=half, int8=int8)
     out = pl.pallas_call(
         kernel,
-        grid=(npass, ncb, nblk),
+        grid=(npass, ncw, nblk),
         in_specs=[
-            pl.BlockSpec((COL_TILE, r_blk), lambda p, g, j: (g, j)),
+            pl.BlockSpec((cw, r_blk), lambda p, g, j: (g, j)),
             pl.BlockSpec((1, r_blk), lambda p, g, j: (0, j)),
             pl.BlockSpec((S_STATS, r_blk), lambda p, g, j: (0, j)),
         ],
         out_specs=pl.BlockSpec(
-            (1, COL_TILE, gwe * S_STATS, n_bins),
-            lambda p, g, j: (p * ncb + g, 0, 0, 0)),
+            (1, cc, gwe * S_STATS, n_bins),
+            lambda p, g, j: (p * ncw + g, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(
-            (npass * ncb, COL_TILE, gwe * S_STATS, n_bins), jnp.float32),
+            (npass * ncw, cc, gwe * S_STATS, n_bins),
+            jnp.int32 if int8 else jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
-    )(codesT, heap.reshape(1, n_pad), stats)
-    # (npass*ncb, CB, gwe*S, nb) -> (L_pad, C_pad, S, nb)
-    out = out.reshape(npass, ncb, COL_TILE, gwe, S_STATS, n_bins)
+    )(codesP, heap.reshape(1, n_pad), stats)
+    # (npass*ncw, cc, gwe*S, nb) -> (L_pad, c_pack, S, nb)
+    out = out.reshape(npass, ncw, cc, gwe, S_STATS, n_bins)
     return out.transpose(0, 3, 1, 2, 4, 5).reshape(
-        npass * gwe, c_pad, S_STATS, n_bins)
+        npass * gwe, ncw * cc, S_STATS, n_bins)
 
 
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
+def sbh_hist_pallas(codesP, heap, stats, *, base, L, n_bins, half=False):
+    """codesP (W_pad, n_pad) i32 packed plane; heap (n_pad,) i32;
+    stats (S, n_pad) f32. Returns (L_pad, c_pack, S_STATS, n_bins) f32
+    with L_pad = npass*gwe and c_pack = 4*W_pad:
+    hist[l] = per-(col, stat, bin) sums over rows with heap == base + l
+    (half=True: over rows with heap == base + 2l — left children only)."""
+    return _hist_pallas(codesP, heap, stats, base=base, L=L, n_bins=n_bins,
+                        half=half, int8=False)
+
+
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
+def sbh_hist_pallas_i8(codesP, heap, stats_i8, *, base, L, n_bins,
+                       half=False):
+    """int8-stats variant: stats (S, n_pad) int32 holding [-127, 127]
+    (i32 input dtype: Mosaic's (S, R) int8 blocks don't meet the
+    32-sublane granule; the kernel casts to i8 in VMEM), exact i32
+    accumulation on the 2x-rate int8 MXU path (127 * 11M rows < 2^31)."""
+    return _hist_pallas(codesP, heap, stats_i8, base=base, L=L,
+                        n_bins=n_bins, half=half, int8=True)
+
+
+@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
 def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins, half=False):
-    """Pure-XLA fallback via segment-sum (CPU tests / non-TPU backends)."""
+    """Pure-XLA fallback via segment-sum (CPU tests / non-TPU backends).
+    codesT is the UNPACKED (C_pad, n_pad) plane — uint8 or legacy i32
+    (bit-identical: the segment indices agree element-for-element).
+    Jitted with static config: the lax.map below is a fresh-closure scan
+    that would otherwise recompile on EVERY eager call (the per-level
+    dispatch-count guard in tests/test_compile_guard.py watches this)."""
     c_pad, n_pad = codesT.shape
     l_eff = (L + 1) // 2 if half else L
     gwe = min(l_eff, GW)
@@ -355,7 +613,7 @@ def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins, half=False):
     lf = jnp.where(ok, leaf, L_pad)
 
     def one_col(c):
-        idx = lf * n_bins + codesT[c]
+        idx = lf * n_bins + codesT[c].astype(jnp.int32)
         return jax.ops.segment_sum(stats.T, idx,
                                    num_segments=(L_pad + 1) * n_bins)
 
@@ -364,175 +622,67 @@ def sbh_hist_xla(codesT, heap, stats, *, base, L, n_bins, half=False):
              .transpose(1, 0, 3, 2)
 
 
-def sbh_hist(codesT, heap, stats, *, base, L, n_bins, half=False):
-    if use_pallas():
-        if _radix_applicable(L, n_bins, half):
-            return sbh_hist_radix(codesT, heap, stats, base=base, L=L,
+def sbh_hist(codes, heap, stats, *, base, L, n_bins, half=False,
+             radix=None):
+    """Histogram dispatch. `radix`: None = auto (engage the radix
+    shallow-window kernel wherever its probe compiled and the window
+    qualifies), False = never, True = same as auto (the factorization
+    only exists for qualifying windows)."""
+    if is_packed(codes):
+        if radix is not False and _radix_applicable(L, n_bins, half):
+            return sbh_hist_radix(codes, heap, stats, base=base, L=L,
                                   n_bins=n_bins, half=half, int8=False)
-        return sbh_hist_pallas(codesT, heap, stats, base=base, L=L,
+        return sbh_hist_pallas(codes, heap, stats, base=base, L=L,
                                n_bins=n_bins, half=half)
-    return sbh_hist_xla(codesT, heap, stats, base=base, L=L, n_bins=n_bins,
+    return sbh_hist_xla(codes, heap, stats, base=base, L=L, n_bins=n_bins,
                         half=half)
 
 
-def sbh_hist_i8(codesT, heap, stats_i8, *, base, L, n_bins, half=False):
-    """int8-stats histogram: i32 in [-127,127] per stat row, i32 out (exact
-    accumulation). The XLA fallback is the same segment-sum with integer
-    dtype passthrough — bit-identical semantics for the CPU tests."""
-    if use_pallas():
-        if _radix_applicable(L, n_bins, half):
-            return sbh_hist_radix(codesT, heap, stats_i8, base=base, L=L,
+def sbh_hist_i8(codes, heap, stats_i8, *, base, L, n_bins, half=False,
+                radix=None):
+    """int8-stats histogram dispatch: i32 in [-127,127] per stat row, i32
+    out (exact accumulation). The XLA fallback is the same segment-sum
+    with integer dtype passthrough — bit-identical for the CPU tests."""
+    if is_packed(codes):
+        if radix is not False and _radix_applicable(L, n_bins, half):
+            return sbh_hist_radix(codes, heap, stats_i8, base=base, L=L,
                                   n_bins=n_bins, half=half, int8=True)
-        return sbh_hist_pallas_i8(codesT, heap, stats_i8, base=base, L=L,
+        return sbh_hist_pallas_i8(codes, heap, stats_i8, base=base, L=L,
                                   n_bins=n_bins, half=half)
-    return sbh_hist_xla(codesT, heap, stats_i8, base=base, L=L,
+    return sbh_hist_xla(codes, heap, stats_i8, base=base, L=L,
                         n_bins=n_bins, half=half)
 
 
 # ===========================================================================
-# int8 histogram variant: one-hot (exact in i8) x per-stat-quantized stats
-# on the v5e's 2x-rate int8 MXU path, int32 accumulation (exact: 127 * 11M
-# rows < 2^31), dequantized by the caller. Same grid/window structure as
-# the bf16 kernel.
-def _hist_kernel_i8(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
-                    n_bins, gwe, r_blk, half=False):
-    R = r_blk
-    p = pl.program_id(0)
-    j = pl.program_id(2)
-
-    @pl.when(j == 0)
-    def _():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    heap = heap_ref[0, :]
-    leaf = heap - base
-    if half:
-        # left children only (even leaf index): window slot = leaf >> 1;
-        # the caller derives right = parent - left EXACTLY (i32 arithmetic
-        # makes sibling subtraction lossless, unlike bf16)
-        slot = (leaf >> 1) - p * gwe
-        inw = (leaf >= 0) & (leaf < L) & ((leaf & 1) == 0)
-    else:
-        slot = leaf - p * gwe
-        inw = (leaf >= 0) & (leaf < L)
-    inw = inw & (slot >= 0) & (slot < gwe)
-    slot_c = jnp.where(inw, slot, 0)
-    iota_s = lax.broadcasted_iota(jnp.int32, (gwe, R), 0)
-    sel = (iota_s == slot_c[None, :]) & inw[None, :]          # (gwe, R)
-    stats = stats_ref[...]                                    # (S, R) i32
-    A = (jnp.where(sel[:, None, :], stats[None, :, :], 0)
-         .reshape(gwe * S_STATS, R)).astype(jnp.int8)
-
-    acc = out_ref[...]
-    iota_b = lax.broadcasted_iota(jnp.int32, (R, n_bins), 1)
-    parts = []
-    for c in range(COL_TILE):
-        code_c = codesT_ref[c, :]
-        oh = (iota_b == code_c[:, None]).astype(jnp.int8)
-        h = lax.dot_general(A, oh, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.int32)
-        parts.append(h)
-    out_ref[...] = acc + jnp.stack(parts)[None]
-
-
-@functools.partial(jax.jit, static_argnames=("base", "L", "n_bins", "half"))
-def sbh_hist_pallas_i8(codesT, heap, stats_i8, *, base, L, n_bins,
-                       half=False):
-    """stats_i8 (S, n_pad) int32 holding values in [-127, 127] (i32 input
-    dtype: Mosaic's (1, R) int8 blocks don't meet the 32-sublane granule;
-    the kernel casts to i8 in VMEM). Returns int32 histogram."""
-    c_pad, n_pad = codesT.shape
-    l_eff = (L + 1) // 2 if half else L
-    gwe = min(l_eff, GW)
-    npass = max(1, -(-l_eff // gwe))
-    ncb = c_pad // COL_TILE
-    r_blk = BLOCK_ROWS if gwe * S_STATS <= 256 else BLOCK_ROWS // 2
-    nblk = n_pad // r_blk
-    kernel = functools.partial(_hist_kernel_i8, base=base, L=L,
-                               n_bins=n_bins, gwe=gwe, r_blk=r_blk,
-                               half=half)
-    out = pl.pallas_call(
-        kernel,
-        grid=(npass, ncb, nblk),
-        in_specs=[
-            pl.BlockSpec((COL_TILE, r_blk), lambda p, g, j: (g, j)),
-            pl.BlockSpec((1, r_blk), lambda p, g, j: (0, j)),
-            pl.BlockSpec((S_STATS, r_blk), lambda p, g, j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, COL_TILE, gwe * S_STATS, n_bins),
-            lambda p, g, j: (p * ncb + g, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct(
-            (npass * ncb, COL_TILE, gwe * S_STATS, n_bins), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
-    )(codesT, heap.reshape(1, n_pad), stats_i8)
-    out = out.reshape(npass, ncb, COL_TILE, gwe, S_STATS, n_bins)
-    return out.transpose(0, 3, 1, 2, 4, 5).reshape(
-        npass * gwe, c_pad, S_STATS, n_bins)
-
-
-# ===========================================================================
 # Radix-factored shallow-window histogram (PERF_NOTES item 1, measured-win
-# regime only). The dense kernel's shallow-level floor is VPU one-hot
-# generation: a 256-wide (iota == code) compare per (row, col). Factor
-# code = hi*16 + lo and fuse the leaf slot into the hi key:
-#
-#     key[r]        = slot[r]*16 + hi[r,c]           (i32 VPU)
-#     J[(l,hi), r]  = (iota == key)                  (gwe*16-wide compare)
-#     A[(l,hi,s),r] = J ? stats[s,r] : 0             (select)
-#     H[(l,hi,s),lo]= A @ onehot_lo.T                (16-wide lo one-hot)
-#
-# VPU element-ops per (row, col): gwe*16*(1+S) + 16 vs dense 256 + gwe*S:
-# 2.7x at window 1, 1.5x at window 2, WORSE at window 4 — so the dispatch
-# (`_radix_applicable`) engages only for effective windows <= 2, i.e.
-# levels 0-2 once sibling subtraction halves the window. Reference
-# semantics unchanged: identical histograms to sbh_hist (parity-gated).
+# regime only). VPU element-ops per (row, col): gwe*16*(1+S) + 16 vs dense
+# 256 + gwe*S: 2.7x at window 1, 1.5x at window 2, WORSE at window 4 — so
+# the dispatch engages only for effective windows <= 2, i.e. levels 0-2
+# once sibling subtraction halves the window. Reference semantics
+# unchanged: identical histograms to sbh_hist (parity-gated).
 RADIX_NH = 16
 RADIX_MAX_WINDOW = 2
 
-_RADIX_OK: bool | None = None
 
-
-def radix_supported() -> bool:
-    """Probe-compile the radix kernel once (never brick a TPU gen whose
-    Mosaic rejects the (gwe*16*S, 16) tiling)."""
-    global _RADIX_OK
-    if _RADIX_OK is None:
-        if not use_pallas():
-            _RADIX_OK = False
-        else:
-            try:
-                c = jnp.zeros((COL_TILE, BLOCK_ROWS), jnp.int32)
-                h = jnp.zeros(BLOCK_ROWS, jnp.int32)
-                s = jnp.ones((S_STATS, BLOCK_ROWS), jnp.float32)
-                out = sbh_hist_radix(c, h, s, base=0, L=1, n_bins=256,
-                                     half=False, int8=False)
-                _RADIX_OK = abs(float(out[0, 0, 0, 0])
-                                - BLOCK_ROWS) < 0.5
-            except Exception:  # pragma: no cover - chip-specific
-                _RADIX_OK = False
-    return _RADIX_OK
+def _radix_shape_ok(l_eff: int, n_bins: int) -> bool:
+    return (l_eff <= RADIX_MAX_WINDOW and n_bins % RADIX_NH == 0
+            and n_bins // RADIX_NH >= 8)
 
 
 def _radix_applicable(L, n_bins, half) -> bool:
     l_eff = (L + 1) // 2 if half else L
-    return (l_eff <= RADIX_MAX_WINDOW and n_bins % RADIX_NH == 0
-            and n_bins // RADIX_NH >= 8 and radix_supported())
+    return _radix_shape_ok(l_eff, n_bins) and radix_supported()
 
 
-def _radix_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
+def _radix_kernel(codesP_ref, heap_ref, stats_ref, out_ref, *, base, L,
                   n_bins, gwe, half, int8):
-    R = BLOCK_ROWS
-    NH = RADIX_NH
-    nl = n_bins // NH
     j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    heap = heap_ref[0, :]                                  # (R,)
+    heap = heap_ref[0, :]
     leaf = heap - base
     if half:
         # left children only; caller derives right = parent - left
@@ -542,65 +692,186 @@ def _radix_kernel(codesT_ref, heap_ref, stats_ref, out_ref, *, base, L,
         slot = leaf
         inw = (leaf >= 0) & (leaf < L)
     slot_c = jnp.where(inw, slot, gwe)     # dead rows -> key out of range
-    stats = stats_ref[...]                                 # (S, R)
-    acc = out_ref[...]
-    iota_k = lax.broadcasted_iota(jnp.int32, (gwe * NH, R), 0)
-    iota_lo = lax.broadcasted_iota(jnp.int32, (nl, R), 0)
-    parts = []
-    for c in range(COL_TILE):
-        code = codesT_ref[c, :]                            # (R,)
-        key = slot_c * NH + code // nl
-        lo = code % nl
-        J = iota_k == key[None, :]                         # (gwe*NH, R)
-        if int8:
-            A = jnp.where(J[:, None, :], stats[None, :, :], 0) \
-                .reshape(gwe * NH * S_STATS, R).astype(jnp.int8)
-            ohlo = (iota_lo == lo[None, :]).astype(jnp.int8)
-            h = lax.dot_general(A, ohlo, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.int32)
-        else:
-            A = jnp.where(J[:, None, :], stats[None, :, :], 0.0) \
-                .reshape(gwe * NH * S_STATS, R).astype(jnp.bfloat16)
-            ohlo = (iota_lo == lo[None, :]).astype(jnp.bfloat16)
-            h = lax.dot_general(A, ohlo, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        parts.append(h)                                    # (gwe*NH*S, nl)
-    out_ref[...] = acc + jnp.stack(parts)[None]
+    parts = _radix_parts(codesP_ref[...], slot_c, stats_ref[...],
+                         gwe=gwe, n_bins=n_bins, int8=int8)
+    out_ref[...] = out_ref[...] + jnp.stack(parts)[None]
 
 
 @functools.partial(jax.jit,
                    static_argnames=("base", "L", "n_bins", "half", "int8"))
-def sbh_hist_radix(codesT, heap, stats, *, base, L, n_bins, half=False,
+def sbh_hist_radix(codesP, heap, stats, *, base, L, n_bins, half=False,
                    int8=False):
     """Radix-factored histogram for effective windows <= RADIX_MAX_WINDOW.
-    Same contract as sbh_hist_pallas but returns exactly (l_eff, C_pad,
+    Same contract as sbh_hist_pallas but returns exactly (l_eff, c_pack,
     S_STATS, n_bins); f32 out (bf16 accumulation) or i32 when int8."""
-    c_pad, n_pad = codesT.shape
+    w_pad, n_pad = codesP.shape
+    cw = min(w_pad, WORD_TILE)
+    ncw = w_pad // cw
+    cc = cw * PACK
     l_eff = (L + 1) // 2 if half else L
     gwe = max(1, l_eff)
     NH = RADIX_NH
     nl = n_bins // NH
-    ncb = c_pad // COL_TILE
     nblk = n_pad // BLOCK_ROWS
     kernel = functools.partial(_radix_kernel, base=base, L=L, n_bins=n_bins,
                                gwe=gwe, half=half, int8=int8)
     out = pl.pallas_call(
         kernel,
-        grid=(ncb, nblk),
+        grid=(ncw, nblk),
         in_specs=[
-            pl.BlockSpec((COL_TILE, BLOCK_ROWS), lambda g, j: (g, j)),
+            pl.BlockSpec((cw, BLOCK_ROWS), lambda g, j: (g, j)),
             pl.BlockSpec((1, BLOCK_ROWS), lambda g, j: (0, j)),
             pl.BlockSpec((S_STATS, BLOCK_ROWS), lambda g, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((1, COL_TILE, gwe * NH * S_STATS, nl),
+        out_specs=pl.BlockSpec((1, cc, gwe * NH * S_STATS, nl),
                                lambda g, j: (g, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(
-            (ncb, COL_TILE, gwe * NH * S_STATS, nl),
+            (ncw, cc, gwe * NH * S_STATS, nl),
             jnp.int32 if int8 else jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
-    )(codesT, heap.reshape(1, n_pad), stats)
-    # (ncb, CB, gwe, NH, S, nl) -> (gwe, C_pad, S, NH*nl = n_bins)
-    out = out.reshape(ncb, COL_TILE, gwe, NH, S_STATS, nl)
+    )(codesP, heap.reshape(1, n_pad), stats)
+    # (ncw, cc, gwe, NH, S, nl) -> (gwe, c_pack, S, NH*nl = n_bins)
+    out = out.reshape(ncw, cc, gwe, RADIX_NH, S_STATS, nl)
     return out.transpose(2, 0, 1, 4, 3, 5).reshape(
-        gwe, c_pad, S_STATS, n_bins)
+        gwe, ncw * cc, S_STATS, n_bins)
+
+
+# ===========================================================================
+# Level-fused route+hist (PERF_NOTES item 4 — the last big code-stream
+# saving: route and hist were TWO full streams of the code plane per
+# level; one kernel reads the tile once, updates the heap, and
+# accumulates the histogram over the UPDATED heap).
+#
+# Applicability is VMEM-bound: the WHOLE level's histogram block
+# (c_pack, l_eff*S, nb) must stay resident across the single row sweep
+# (there is no col-block grid dimension — the route phase needs every
+# column's words in the tile anyway). That caps fusion at shallow levels
+# (l_eff <= FUSE_MAX_WINDOW), exactly where the histogram is bandwidth-
+# floor bound and the saving is real; deep (dot-bound) levels keep the
+# tiled unfused kernels.
+FUSE_MAX_WINDOW = 16
+_FUSE_VMEM_OUT = 6 * 2 ** 20
+
+
+def _fused_applicable(L_h: int, n_bins: int, c_pack: int) -> bool:
+    l_eff = (L_h + 1) // 2
+    return (l_eff <= FUSE_MAX_WINDOW
+            and c_pack * l_eff * S_STATS * n_bins * 4 <= _FUSE_VMEM_OUT
+            and fused_supported())
+
+
+def _fused_kernel(codesP_ref, heap_ref, tbl_ref, route_ref, stats_ref,
+                  heap_out_ref, hist_ref, *, base_r, L_r, base_h, L_h,
+                  n_bins, any_cat, na_code, gwe, int8, radix):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+
+    words = codesP_ref[...]                                   # (W_pad, R)
+    newheap = _route_math(words, heap_ref[0, :], tbl_ref[...],
+                          route_ref[...], base=base_r, L=L_r,
+                          n_bins=n_bins, any_cat=any_cat, na_code=na_code)
+    heap_out_ref[0, :] = newheap
+    # histogram over the UPDATED heap: left children of [base_h, base_h+L_h)
+    stats = stats_ref[...]
+    if radix:
+        leaf = newheap - base_h
+        slot = leaf >> 1
+        inw = (leaf >= 0) & (leaf < L_h) & ((leaf & 1) == 0)
+        slot_c = jnp.where(inw, slot, gwe)
+        parts = _radix_parts(words, slot_c, stats, gwe=gwe,
+                             n_bins=n_bins, int8=int8)
+    else:
+        A = _stats_panel(newheap, stats, base=base_h, L=L_h, gwe=gwe,
+                         p=0, half=True, int8=int8)
+        parts = _dense_parts(words, A, n_bins=n_bins, int8=int8)
+    hist_ref[...] = hist_ref[...] + jnp.stack(parts)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("base_r", "L_r", "base_h", "L_h",
+                                    "n_bins", "any_cat", "na_code", "int8",
+                                    "radix"))
+def sbh_route_hist_fused_pallas(codesP, heap, tbl, route_f, stats, *,
+                                base_r, L_r, base_h, L_h, n_bins,
+                                any_cat=True, na_code=255, int8=False,
+                                radix=False):
+    """ONE kernel: route splits of [base_r, base_r+L_r), then accumulate
+    the half (left-children) histogram of [base_h, base_h+L_h) over the
+    updated heap. Returns (newheap, hist (l_eff, c_pack, S, n_bins))."""
+    w_pad, n_pad = codesP.shape
+    c_pack = w_pad * PACK
+    l_eff = (L_h + 1) // 2
+    gwe = max(1, l_eff)
+    nblk = n_pad // BLOCK_ROWS
+    n_bins_rf = route_f.shape[1]
+    assert n_bins_rf == n_bins
+    if radix:
+        NH = RADIX_NH
+        nl = n_bins // NH
+        hist_shape = (c_pack, gwe * NH * S_STATS, nl)
+    else:
+        hist_shape = (c_pack, gwe * S_STATS, n_bins)
+    kernel = functools.partial(_fused_kernel, base_r=base_r, L_r=L_r,
+                               base_h=base_h, L_h=L_h, n_bins=n_bins,
+                               any_cat=any_cat, na_code=na_code, gwe=gwe,
+                               int8=int8, radix=radix)
+    newheap, hist = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((w_pad, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec(tbl.shape, lambda j: (0, 0)),
+            pl.BlockSpec(route_f.shape, lambda j: (0, 0)),
+            pl.BlockSpec((S_STATS, BLOCK_ROWS), lambda j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_ROWS), lambda j: (0, j)),
+            pl.BlockSpec(hist_shape, lambda j: (0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct(hist_shape,
+                                 jnp.int32 if int8 else jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(codesP, heap.reshape(1, n_pad), tbl, route_f, stats)
+    if radix:
+        nl = n_bins // RADIX_NH
+        hist = hist.reshape(c_pack, gwe, RADIX_NH, S_STATS, nl) \
+            .transpose(1, 0, 3, 2, 4).reshape(gwe, c_pack, S_STATS, n_bins)
+    else:
+        hist = hist.reshape(c_pack, gwe, S_STATS, n_bins) \
+            .transpose(1, 0, 2, 3)
+    return newheap[0], hist
+
+
+def sbh_route_hist(codes, heap, tbl, route_f, stats, *, base_r, L_r,
+                   base_h, L_h, n_bins, any_cat=True, na_code=255,
+                   int8=False, fused=None, radix=None):
+    """Fused-or-sequential level pass: route the previous level's splits,
+    then accumulate the new level's half (left-children) histogram over
+    the updated heap. `fused`: None = auto (engage the fused Pallas
+    program wherever its probe compiled and the level qualifies), False =
+    always sequential; the sequential path is also the XLA/CPU path and
+    is semantically identical (tier-1 gated). Returns (newheap, hist)."""
+    if (is_packed(codes) and fused is not False
+            and _fused_applicable(L_h, n_bins, codes.shape[0] * PACK)):
+        l_eff = (L_h + 1) // 2
+        use_radix = (radix is not False and _radix_shape_ok(l_eff, n_bins)
+                     and radix_supported())
+        return sbh_route_hist_fused_pallas(
+            codes, heap, tbl, route_f, stats, base_r=base_r, L_r=L_r,
+            base_h=base_h, L_h=L_h, n_bins=n_bins, any_cat=any_cat,
+            na_code=na_code, int8=int8, radix=use_radix)
+    newheap, _ = sbh_route(codes, heap, tbl, route_f, base=base_r, L=L_r,
+                           any_cat=any_cat, na_code=na_code)
+    hist_fn = sbh_hist_i8 if int8 else sbh_hist
+    hist = hist_fn(codes, newheap, stats, base=base_h, L=L_h,
+                   n_bins=n_bins, half=True, radix=radix)
+    return newheap, hist

@@ -7,6 +7,12 @@ fallbacks on random numeric + categorical + NA inputs and asserts
 bit-tolerance — the analog of the reference's POJO/MOJO parity discipline
 (h2o-py/tests/testdir_javapredict). Called as a bench.py pre-step on TPU
 and by tests/test_kernel_parity.py.
+
+Round-4 shape: the Pallas kernels consume PACKED code planes (4 uint8
+codes per i32 word, HP.pack_codes) while the XLA twins consume the uint8
+plane — every check below therefore also proves the pack/extract round
+trip on-chip, and the new level-fused route+hist kernel is checked
+against the sequential pair in both dense and radix windows.
 """
 
 from __future__ import annotations
@@ -20,30 +26,53 @@ from h2o3_tpu.ops import hist_pallas as HP
 
 def _rand_inputs(seed=0, n_pad=2 * HP.BLOCK_ROWS, c_pad=16, b_val=64,
                  n_bins=128, L=8):
-    """Random codes incl. NA codes + heap spread over [base, base+L)."""
+    """Random uint8 codes incl. NA codes + their packed plane + heap
+    spread over [base, base+L)."""
     rng = np.random.default_rng(seed)
-    codes = rng.integers(0, b_val, (c_pad, n_pad)).astype(np.int32)
+    codes = rng.integers(0, b_val, (c_pad, n_pad)).astype(np.uint8)
     codes[rng.random((c_pad, n_pad)) < 0.05] = b_val          # NA code
     base = L - 1
     heap = rng.integers(base, base + L, n_pad).astype(np.int32)
     stats = rng.normal(0, 1, (HP.S_STATS, n_pad)).astype(np.float32)
     stats[3] = 0.0
-    return (jnp.asarray(codes), jnp.asarray(heap), jnp.asarray(stats),
+    u8 = jnp.asarray(codes)
+    return (u8, HP.pack_codes(u8), jnp.asarray(heap), jnp.asarray(stats),
             base, L, n_bins, b_val)
 
 
+def _route_tables(rng, L, n_bins, b_val, c_pad):
+    """Random split tables incl. categorical SET routing + NA dir. The
+    pallas numeric fast path reads tbl rows 2/3 while the xla fallback
+    always reads route_f — route_num is built consistent with both."""
+    Lp = max(8, L)
+    tbl = np.zeros((8, Lp), np.float32)
+    tbl[0, :L] = rng.integers(0, c_pad, L)
+    tbl[1, :L] = rng.random(L) < 0.8
+    tbl[2, :L] = rng.integers(0, b_val - 1, L)       # numeric split bin
+    tbl[3, :L] = rng.random(L) < 0.5                 # NA goes left
+    route_cat = (rng.random((Lp, n_bins)) < 0.5).astype(np.float32)
+    route_num = np.zeros((Lp, n_bins), np.float32)
+    code_ids = np.arange(n_bins)[None, :]
+    route_num[:L] = (code_ids > tbl[2, :L, None]).astype(np.float32)
+    route_num[:L, b_val] = 1.0 - tbl[3, :L]
+    return jnp.asarray(tbl), jnp.asarray(route_cat), jnp.asarray(route_num)
+
+
 def kernel_parity_check(seed=0):
-    """Assert pallas == xla for hist (full + half), i8 hist and route.
+    """Assert pallas == xla for hist (full + half), i8 hist, radix, route
+    (with and without the F stream) and the level-fused route+hist.
     Returns a dict of max deviations."""
-    codes, heap, stats, base, L, n_bins, b_val = _rand_inputs(seed)
+    u8, packed, heap, stats, base, L, n_bins, b_val = _rand_inputs(seed)
+    c_pad = u8.shape[0]
     devs = {}
 
     for half in (False, True):
-        hp = HP.sbh_hist_pallas(codes, heap, stats, base=base, L=L,
+        hp = HP.sbh_hist_pallas(packed, heap, stats, base=base, L=L,
                                 n_bins=n_bins, half=half)
-        hx = HP.sbh_hist_xla(codes, heap, stats, base=base, L=L,
+        hx = HP.sbh_hist_xla(u8, heap, stats, base=base, L=L,
                              n_bins=n_bins, half=half)
-        d = float(jnp.max(jnp.abs(hp - hx)))
+        l_eff = (L + 1) // 2 if half else L
+        d = float(jnp.max(jnp.abs(hp[:l_eff, :c_pad] - hx[:l_eff])))
         devs[f"hist_half={half}"] = d
         assert d < 1e-2, (half, d)     # bf16 accumulation vs f32 segment-sum
 
@@ -51,71 +80,106 @@ def kernel_parity_check(seed=0):
         np.random.default_rng(seed + 1).integers(
             -127, 128, stats.shape).astype(np.int32))
     for half in (False, True):
-        ip = HP.sbh_hist_pallas_i8(codes, heap, si, base=base, L=L,
+        ip = HP.sbh_hist_pallas_i8(packed, heap, si, base=base, L=L,
                                    n_bins=n_bins, half=half)
-        ix = HP.sbh_hist_xla(codes, heap, si, base=base, L=L,
+        ix = HP.sbh_hist_xla(u8, heap, si, base=base, L=L,
                              n_bins=n_bins, half=half)
-        d = int(jnp.max(jnp.abs(ip - ix)))
+        l_eff = (L + 1) // 2 if half else L
+        d = int(jnp.max(jnp.abs(ip[:l_eff, :c_pad] - ix[:l_eff])))
         devs[f"i8_half={half}"] = d
         assert d == 0, (half, d)       # i32 accumulation is exact
 
     # radix shallow-window kernel: parity at its whole dispatch regime
     # (windows 1 and 2, full + half, f32 + i8, n_bins % 16 == 0)
     if HP.radix_supported():
-        codes2, heap2, stats2, _, _, _, bv2 = _rand_inputs(
+        u82, packed2, heap2, stats2, _, _, _, bv2 = _rand_inputs(
             seed + 3, b_val=255, n_bins=256, L=4)
         si2 = jnp.asarray(np.random.default_rng(seed + 4).integers(
             -127, 128, stats2.shape).astype(np.int32))
         for Lw, half in ((1, False), (2, False), (2, True), (4, True)):
             basew = Lw - 1
+            hw = heap2 % Lw + basew
             l_eff = (Lw + 1) // 2 if half else Lw
-            rp = HP.sbh_hist_radix(codes2, heap2 % Lw + basew, stats2,
+            rp = HP.sbh_hist_radix(packed2, hw, stats2,
                                    base=basew, L=Lw, n_bins=256, half=half)
-            rx = HP.sbh_hist_xla(codes2, heap2 % Lw + basew, stats2,
+            rx = HP.sbh_hist_xla(u82, hw, stats2,
                                  base=basew, L=Lw, n_bins=256, half=half)
-            d = float(jnp.max(jnp.abs(rp - rx[:l_eff])))
+            d = float(jnp.max(jnp.abs(rp[:l_eff, :c_pad] - rx[:l_eff])))
             devs[f"radix_L={Lw}_half={half}"] = d
             assert d < 1e-2, (Lw, half, d)
-            ri = HP.sbh_hist_radix(codes2, heap2 % Lw + basew, si2,
-                                   base=basew, L=Lw, n_bins=256,
-                                   half=half, int8=True)
-            rxi = HP.sbh_hist_xla(codes2, heap2 % Lw + basew, si2,
-                                  base=basew, L=Lw, n_bins=256, half=half)
-            di = int(jnp.max(jnp.abs(ri - rxi[:l_eff])))
+            ri = HP.sbh_hist_radix(packed2, hw, si2, base=basew, L=Lw,
+                                   n_bins=256, half=half, int8=True)
+            rxi = HP.sbh_hist_xla(u82, hw, si2, base=basew, L=Lw,
+                                  n_bins=256, half=half)
+            di = int(jnp.max(jnp.abs(ri[:l_eff, :c_pad] - rxi[:l_eff])))
             devs[f"radix_i8_L={Lw}_half={half}"] = di
             assert di == 0, (Lw, half, di)
 
-    # route: random split tables incl. categorical SET routing + NA dir
     rng = np.random.default_rng(seed + 2)
-    Lp = max(8, L)
-    tbl = np.zeros((8, Lp), np.float32)
-    tbl[0, :L] = rng.integers(0, codes.shape[0], L)
-    tbl[1, :L] = rng.random(L) < 0.8
-    tbl[2, :L] = rng.integers(0, b_val - 1, L)       # numeric split bin
-    tbl[3, :L] = rng.random(L) < 0.5                 # NA goes left
-    # categorical variant: arbitrary per-code SET routing.  numeric
-    # variant: the pallas fast path reads tbl rows 2/3 while the xla
-    # fallback always reads route_f — build route_f consistent with them.
-    route_cat = (rng.random((Lp, n_bins)) < 0.5).astype(np.float32)
-    route_num = np.zeros((Lp, n_bins), np.float32)
-    code_ids = np.arange(n_bins)[None, :]
-    route_num[:L] = (code_ids > tbl[2, :L, None]).astype(np.float32)
-    route_num[:L, b_val] = 1.0 - tbl[3, :L]
-    valtab = np.zeros((8, 128), np.float32)
-    valtab[0] = rng.normal(0, 1, 128)
-    F = jnp.asarray(rng.normal(0, 1, codes.shape[1]).astype(np.float32))
+    tbl, route_cat, route_num = _route_tables(rng, L, n_bins, b_val, c_pad)
+    valtab = jnp.asarray(
+        np.concatenate([rng.normal(0, 1, (1, 128)),
+                        np.zeros((7, 128))]).astype(np.float32))
+    F = jnp.asarray(rng.normal(0, 1, u8.shape[1]).astype(np.float32))
     for any_cat in (True, False):
         route_f = route_cat if any_cat else route_num
-        args = (codes, heap, jnp.asarray(tbl), jnp.asarray(route_f),
-                jnp.asarray(valtab), F)
-        kw = dict(base=base, L=L, eta=0.1, emit_f=True, any_cat=any_cat,
-                  na_code=b_val)
-        h_p, f_p = HP.sbh_route_pallas(*args, **kw)
-        h_x, f_x = HP.sbh_route_xla(*args, **kw)
+        kw = dict(base=base, L=L, any_cat=any_cat, na_code=b_val)
+        # terminal variant: heap + fused F update
+        h_p, f_p = HP.sbh_route_pallas(packed, heap, tbl, route_f,
+                                       valtab, F, eta=0.1, emit_f=True,
+                                       **kw)
+        h_x, f_x = HP.sbh_route_xla(u8, heap, tbl, route_f, valtab, F,
+                                    eta=0.1, emit_f=True, **kw)
         dh = int(jnp.max(jnp.abs(h_p - h_x)))
         df = float(jnp.max(jnp.abs(f_p - f_x)))
         devs[f"route_cat={any_cat}_heap"] = dh
         devs[f"route_cat={any_cat}_F"] = df
         assert dh == 0, (any_cat, dh)  # routing must be bit-identical
         assert df < 1e-5, (any_cat, df)
+        # non-terminal variant: heap only, no F stream
+        h_p2, fnone = HP.sbh_route_pallas(packed, heap, tbl, route_f, **kw)
+        dh2 = int(jnp.max(jnp.abs(h_p2 - h_x)))
+        devs[f"route_cat={any_cat}_noF_heap"] = dh2
+        assert fnone is None and dh2 == 0, (any_cat, dh2)
+
+    # level-fused route+hist vs the sequential XLA pair, dense and radix
+    # windows, f32 and i8 stats (the exact grow() level-d contract:
+    # route [base_r, base_r+L_r) then half-hist [base_h, base_h+L_h))
+    if HP.fused_supported():
+        for L_h, radix in ((2, False), (2, True), (8, False), (32, False)):
+            L_r = L_h >> 1
+            base_r, base_h = L_r - 1, L_h - 1
+            hw = heap % L_r + base_r
+            tblr, rcat, _ = _route_tables(rng, L_r, n_bins, b_val, c_pad)
+            if radix and not HP.radix_supported():
+                continue
+            nh_p, hist_p = HP.sbh_route_hist_fused_pallas(
+                packed, hw, tblr, rcat, stats, base_r=base_r, L_r=L_r,
+                base_h=base_h, L_h=L_h, n_bins=n_bins, any_cat=True,
+                na_code=b_val, radix=radix)
+            nh_x, _ = HP.sbh_route_xla(u8, hw, tblr, rcat,
+                                       base=base_r, L=L_r, na_code=b_val)
+            hist_x = HP.sbh_hist_xla(u8, nh_x, stats, base=base_h, L=L_h,
+                                     n_bins=n_bins, half=True)
+            l_eff = (L_h + 1) // 2
+            dh = int(jnp.max(jnp.abs(nh_p - nh_x)))
+            dv = float(jnp.max(jnp.abs(hist_p[:l_eff, :c_pad]
+                                       - hist_x[:l_eff])))
+            devs[f"fused_L={L_h}_radix={radix}_heap"] = dh
+            devs[f"fused_L={L_h}_radix={radix}_hist"] = dv
+            assert dh == 0, (L_h, radix, dh)
+            assert dv < 1e-2, (L_h, radix, dv)
+            sii = jnp.asarray(np.random.default_rng(seed + 5).integers(
+                -127, 128, stats.shape).astype(np.int32))
+            nh_i, hist_i = HP.sbh_route_hist_fused_pallas(
+                packed, hw, tblr, rcat, sii, base_r=base_r, L_r=L_r,
+                base_h=base_h, L_h=L_h, n_bins=n_bins, any_cat=True,
+                na_code=b_val, int8=True, radix=radix)
+            hist_xi = HP.sbh_hist_xla(u8, nh_x, sii, base=base_h, L=L_h,
+                                      n_bins=n_bins, half=True)
+            dvi = int(jnp.max(jnp.abs(hist_i[:l_eff, :c_pad]
+                                      - hist_xi[:l_eff])))
+            devs[f"fused_i8_L={L_h}_radix={radix}_hist"] = dvi
+            assert int(jnp.max(jnp.abs(nh_i - nh_x))) == 0
+            assert dvi == 0, (L_h, radix, dvi)
     return devs

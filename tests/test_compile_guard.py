@@ -55,3 +55,60 @@ def test_one_bucket_three_row_counts_at_most_one_compile():
         "serving path")
     for k in keys:
         DKV.remove(k)
+
+
+def test_binned_level_loop_dispatch_bounded():
+    """ISSUE 14 dispatch-count guard: the eager per-level grow loop (the
+    bench's instrumented path) must dispatch a BOUNDED number of compiled
+    programs per level — a change that sneaks a per-leaf or per-column
+    jit into the loop (a closure jit, an unhashable static arg, a fresh
+    lambda) shows up here as a compile-count explosion; and a second
+    identical run must add ZERO compiles (every program is cached)."""
+    import jax
+    import jax.numpy as jnp
+    from h2o3_tpu.models.tree import binned as BN
+
+    rng = np.random.default_rng(3)
+    n, C, D = 1500, 4, 4
+    X = rng.normal(0, 1, (n, C)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    spec = BN.make_bins(X, np.zeros(C, bool), 32)
+    n_pad = BN.padded_rows(n)
+    codes = BN.prepare_codes(BN.quantize(jnp.asarray(X), spec,
+                                         n_pad=n_pad))
+    w1 = BN.pad_rows(jnp.ones(n, jnp.float32), n_pad)
+    y1 = BN.pad_rows(jnp.asarray(y), n_pad)
+    stats = jnp.stack([w1, w1 * (y1 - 0.5), w1 * 0.25,
+                       jnp.zeros_like(w1)], axis=0)
+    F = jnp.zeros(n_pad, jnp.float32)
+    grower = BN.BinnedGrower(spec, max_depth=D, min_rows=2.0,
+                             min_split_improvement=0.0)
+
+    def run(g):
+        out = g.grow(codes, stats, F, eta=0.1, clip_val=0.0,
+                     key=jax.random.PRNGKey(0))
+        jax.block_until_ready(out["F"])
+
+    c0 = om.xla_compile_count()
+    run(grower)
+    first = om.xla_compile_count() - c0
+    run(grower)
+    second = om.xla_compile_count() - c0 - first
+    assert second == 0, (
+        f"second identical eager grow re-compiled {second} programs — a "
+        "per-call recompile crept into the level loop")
+    # scaling guard: deepening the tree adds a BOUNDED number of programs
+    # per NEW level (each level's static L recompiles the per-level
+    # programs once — that is the contract). A per-leaf or per-column jit
+    # would scale the per-level cost with 2^d and explode this ratio.
+    D2 = 6
+    grower2 = BN.BinnedGrower(spec, max_depth=D2, min_rows=2.0,
+                              min_split_improvement=0.0)
+    c1 = om.xla_compile_count()
+    run(grower2)
+    deep = om.xla_compile_count() - c1
+    per_level, per_level_deep = first / D, deep / D2
+    assert per_level_deep <= 2.0 * per_level + 8, (
+        f"per-level compile cost grew from {per_level:.1f} (depth {D}) to "
+        f"{per_level_deep:.1f} (depth {D2}) — dispatch count is scaling "
+        "with the leaf count, not the level count")

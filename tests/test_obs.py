@@ -250,8 +250,13 @@ def test_metrics_endpoint_prometheus(server, gbm_via_rest):
     assert m and int(m.group(1)) >= 3 * 200, "rows*trees counter"
     m = re.search(r'^h2o3_dkv_objects\{what="keys"\} (\d+)$', text, re.M)
     assert m and int(m.group(1)) >= 1, "dkv gauge"
-    m = re.search(r'^h2o3_tree_level_seconds_count (\d+)$', text, re.M)
-    assert m and int(m.group(1)) >= 9, "level histogram (3 trees x 3 lvls)"
+    # level histogram is labeled per (engine, level) now: 3 trees land
+    # 3+ observations on each adaptive level series
+    counts = [int(v) for v in re.findall(
+        r'^h2o3_tree_level_seconds_count\{engine="adaptive",'
+        r'level="\d+"\} (\d+)$', text, re.M)]
+    assert len(counts) >= 3 and sum(counts) >= 9, \
+        "level histogram (3 trees x 3 lvls)"
 
 
 def test_timeline_endpoint_spans_and_nesting(server, gbm_via_rest):
