@@ -435,6 +435,121 @@ def scoring_bench() -> dict:
     return rec
 
 
+def qos_overload_bench(duration_s: float = 3.0) -> dict:
+    """Multi-tenant QoS overload sample (ISSUE 15): a real REST server
+    with two basic-auth tenants, one flooding unpaced from 3 threads and
+    one well-behaved at ~10 rps. Records the victim's p50/p99, both
+    tenants' outcome counts and the QoS shed/reject counters — the
+    bounded, CI-sized version of the win-condition race harness. A
+    server that can't form records a structured blocked record."""
+    import base64
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+    from h2o3_tpu.core.frame import Frame
+    from h2o3_tpu.core.kvstore import DKV
+    from h2o3_tpu.models import ESTIMATORS
+    from h2o3_tpu.serving import qos as _qos
+
+    try:
+        from h2o3_tpu.api.server import H2OServer
+        rng = np.random.default_rng(11)
+        fr = Frame.from_dict(
+            {"a": rng.normal(size=400), "b": rng.normal(size=400),
+             "resp": rng.choice(["no", "yes"], size=400).astype(object)})
+        m = ESTIMATORS["glm"](family="binomial")
+        m.train(x=["a", "b"], y="resp", training_frame=fr)
+        srv = H2OServer(port=0,
+                        auth={"flood": "pw", "victim": "pw"}).start()
+    except Exception:
+        return {"blocked": True, "blocked_stage": "qos-server-formation",
+                "blocked_detail": _short_cause(traceback.format_exc())}
+    url = f"http://127.0.0.1:{srv.port}/3/Predictions/models/{m.key}"
+    body = _json.dumps({"rows": [{"a": 0.1, "b": 0.2}]}).encode()
+
+    def post(user, timeout=10.0):
+        tok = base64.b64encode(f"{user}:pw".encode()).decode()
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Basic {tok}"})
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    try:
+        post("victim").read()               # warm: compile outside the clock
+        stop = threading.Event()
+        # one tally dict PER THREAD, summed after join — a shared dict's
+        # read-modify-write increments from 3 threads can lose counts
+        tallies = [{"ok": 0, "rejected": 0, "errors": 0}
+                   for _ in range(3)]
+
+        def flooder(tally):
+            while not stop.is_set():
+                try:
+                    with post("flood") as r:
+                        r.read()
+                        tally["ok"] += 1
+                except urllib.error.HTTPError as ex:
+                    ex.read()
+                    if ex.code in (429, 503):
+                        tally["rejected"] += 1
+                    else:
+                        tally["errors"] += 1
+                except Exception:
+                    tally["errors"] += 1
+
+        threads = [threading.Thread(target=flooder, args=(tally,))
+                   for tally in tallies]
+        for t in threads:
+            t.start()
+        lat, failures = [], 0
+        t_end = time.time() + duration_s
+        while time.time() < t_end:
+            t0 = time.perf_counter()
+            try:
+                with post("victim") as r:
+                    r.read()
+                lat.append(time.perf_counter() - t0)
+            except Exception:
+                failures += 1
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(20)
+        flood = {k: sum(t[k] for t in tallies)
+                 for k in ("ok", "rejected", "errors")}
+        shed = {reason: _qos.SHED.value(reason=reason)
+                for reason in ("entry", "admission", "batch")}
+        return {
+            "victim_requests": len(lat),
+            "victim_failures": failures,
+            "victim_p50_ms": round(1e3 * float(np.percentile(lat, 50)), 2)
+            if lat else None,
+            "victim_p99_ms": round(1e3 * float(np.percentile(lat, 99)), 2)
+            if lat else None,
+            "flood_ok": flood["ok"], "flood_rejected": flood["rejected"],
+            "flood_errors": flood["errors"],
+            "flood_to_victim_ratio": round(
+                (flood["ok"] + flood["rejected"]) / max(1, len(lat)), 1),
+            "shed_total": shed,
+            "gate_waits": sum(
+                e["value"] for e in _qos.GATE_WAITS._json()),
+        }
+    except Exception:
+        return {"blocked": True, "blocked_stage": "qos-overload-run",
+                "blocked_detail": _short_cause(traceback.format_exc())}
+    finally:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+        for k in (fr.key, m.key):
+            DKV.remove(k)
+
+
 def multihost_scoring_bench(timeout_s: int = 240) -> dict:
     """2-process-cloud scaling sample (ISSUE 11): form the real
     jax.distributed CPU cloud (tests/multiproc_runner.py), train a GBM
@@ -848,6 +963,23 @@ def main():
         except Exception:
             traceback.print_exc()
 
+    qos_overload = None
+    if not gbm_only:
+        try:
+            qos_overload = qos_overload_bench()
+            if qos_overload.get("blocked"):
+                print("qos overload sample blocked: "
+                      f"{qos_overload['blocked_stage']}", file=sys.stderr)
+            else:
+                print(f"qos overload: victim p99 "
+                      f"{qos_overload['victim_p99_ms']}ms / "
+                      f"{qos_overload['victim_failures']} failures under "
+                      f"{qos_overload['flood_to_victim_ratio']}x flood "
+                      f"({qos_overload['flood_rejected']} flood rejects)",
+                      file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+
     multihost_scoring = None
     if not gbm_only:
         try:
@@ -915,6 +1047,7 @@ def main():
         "ingest": ingest,
         "distributed_ingest": distributed_ingest,
         "scoring": scoring,
+        "qos_overload": qos_overload,
         "multihost_scoring": multihost_scoring,
     }))
 

@@ -3,12 +3,22 @@
 The in-process surface (`h2o3_tpu.client`) evaluates Rapids directly;
 this package is for callers on the OTHER side of the REST boundary — load
 generators, notebooks on a laptop, sidecar services — and it encodes the
-client half of the server's backpressure and elasticity contracts:
+client half of the server's backpressure, QoS and elasticity contracts:
 
   * **503 + Retry-After** (micro-batch queue-depth backpressure, and the
     brief unavailability window while a worker is excised/replaced) is
     retried with capped jittered exponential backoff honoring the
     server's Retry-After hint, instead of surfacing the first 503.
+  * **429 + Retry-After** (per-tenant token-bucket rate limits and job
+    quotas, serving/qos) is retried the same way — the server is healthy,
+    THIS caller is over its configured rate, so backing off and retrying
+    is exactly the right response.
+  * **Deadlines**: a per-call ``deadline_ms=`` budget is sent as
+    ``X-H2O3-Deadline-Ms`` (re-computed to the REMAINING budget on each
+    retry, so the server sheds work the client has already given up on)
+    and bounds the retry loop itself — once the budget is blown the
+    client raises H2ORetryError with the accounting instead of sleeping
+    into a deadline nobody can meet.
   * Transient transport drops (connection reset/refused mid-restart) are
     retried the same way when `retry_connect=True`.
 
@@ -17,7 +27,8 @@ Stdlib-only (urllib), like the server. Usage:
     from h2o3_client import H2OClient
     c = H2OClient("http://127.0.0.1:54321")
     cloud = c.get("/3/Cloud")
-    preds = c.post("/3/Predictions/models/m1", rows=[[1.0, 2.0]])
+    preds = c.post("/3/Predictions/models/m1", deadline_ms=250,
+                   rows=[[1.0, 2.0]])
 """
 
 from __future__ import annotations
@@ -31,13 +42,21 @@ import urllib.request
 
 __all__ = ["H2OClient", "H2ORetryError"]
 
+_RETRY_CODES = (429, 503)
+
 
 class H2ORetryError(RuntimeError):
-    """The retry budget ran out; `.last` holds the final HTTPError."""
+    """The retry budget ran out; `.last` holds the final HTTPError.
+    When a per-call deadline bounded the loop, `.budget_s`, `.elapsed_s`
+    and `.attempts` carry the accounting."""
 
-    def __init__(self, msg, last=None):
+    def __init__(self, msg, last=None, budget_s=None, elapsed_s=None,
+                 attempts=0):
         super().__init__(msg)
         self.last = last
+        self.budget_s = budget_s
+        self.elapsed_s = elapsed_s
+        self.attempts = attempts
 
 
 class H2OClient:
@@ -50,7 +69,7 @@ class H2OClient:
                   caller
     timeout       per-request socket timeout, seconds (default 60)
     retry_connect also retry dropped/refused connections (worker
-                  replacement windows), not just 503s
+                  replacement windows), not just 429/503s
     rng           random source for jitter (tests pass a seeded one)
     """
 
@@ -69,46 +88,73 @@ class H2OClient:
         self.retries_performed = 0     # observability for tests/tools
 
     # ---- public verbs ----------------------------------------------------
-    def get(self, path: str, **params):
-        return self.request("GET", path, params or None)
+    def get(self, path: str, deadline_ms=None, **params):
+        return self.request("GET", path, params or None,
+                            deadline_ms=deadline_ms)
 
-    def post(self, path: str, **params):
-        return self.request("POST", path, params or None)
+    def post(self, path: str, deadline_ms=None, **params):
+        return self.request("POST", path, params or None,
+                            deadline_ms=deadline_ms)
 
-    def delete(self, path: str, **params):
-        return self.request("DELETE", path, params or None)
+    def delete(self, path: str, deadline_ms=None, **params):
+        return self.request("DELETE", path, params or None,
+                            deadline_ms=deadline_ms)
 
     # ---- core ------------------------------------------------------------
     def _backoff_s(self, attempt: int, retry_after) -> float:
         """Capped exponential with full jitter; a server Retry-After hint
         (already load-aware) is honored up to the cap, jittered ±50% so a
-        herd of 503'd clients doesn't return in lockstep."""
+        herd of rejected clients doesn't return in lockstep."""
         if retry_after is not None:
             base = min(float(retry_after), self.backoff_cap)
             return base * (0.5 + self._rng.random())
         ceiling = min(self.backoff_base * (2 ** attempt), self.backoff_cap)
         return ceiling * self._rng.random()
 
-    def request(self, method: str, path: str, params=None):
+    def request(self, method: str, path: str, params=None,
+                deadline_ms=None):
         body = None
         url = self.url + path
-        headers = dict(self.headers)
+        base_headers = dict(self.headers)
         if params is not None and method in ("POST", "PUT"):
             body = json.dumps(params).encode()
-            headers["Content-Type"] = "application/json"
+            base_headers["Content-Type"] = "application/json"
         elif params:
             url += "?" + urllib.parse.urlencode(params)
+        # `is not None`, not truthiness: deadline_ms=0 is an already-
+        # exhausted budget (immediate error), NOT "no deadline"
+        budget_s = (float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        t0 = time.monotonic()
         last = None
         for attempt in range(self.max_retries + 1):
+            headers = dict(base_headers)
+            timeout = self.timeout
+            if budget_s is not None:
+                remaining = budget_s - (time.monotonic() - t0)
+                # < 1ms is exhausted: the header is whole milliseconds,
+                # and sending "0" means already-spent to the server — a
+                # guaranteed 504 round trip instead of this accounting
+                if remaining < 1e-3:
+                    raise H2ORetryError(
+                        f"{method} {path}: deadline budget "
+                        f"{budget_s * 1e3:.0f}ms exhausted before attempt "
+                        f"{attempt + 1} (last: {last})", last=last,
+                        budget_s=budget_s,
+                        elapsed_s=time.monotonic() - t0, attempts=attempt)
+                # the server sheds on the REMAINING budget, not the
+                # original one — a retry after 150ms of a 250ms budget
+                # advertises the ~100ms left
+                headers["X-H2O3-Deadline-Ms"] = str(int(remaining * 1e3))
+                timeout = min(timeout, remaining)
             req = urllib.request.Request(url, data=body, method=method,
                                          headers=headers)
             try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout) as r:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
                     raw = r.read()
                     return json.loads(raw) if raw else None
             except urllib.error.HTTPError as ex:
-                if ex.code != 503:
+                if ex.code not in _RETRY_CODES:
                     raise               # real errors surface immediately
                 last = ex
                 ex.read()               # drain so the connection recycles
@@ -120,8 +166,24 @@ class H2OClient:
                 retry_after = None
             if attempt >= self.max_retries:
                 break
+            sleep_s = self._backoff_s(attempt, retry_after)
+            if budget_s is not None:
+                remaining = budget_s - (time.monotonic() - t0)
+                if sleep_s >= remaining:
+                    # sleeping would blow the caller's own deadline:
+                    # stop retrying NOW with the budget accounting
+                    raise H2ORetryError(
+                        f"{method} {path}: next backoff "
+                        f"{sleep_s * 1e3:.0f}ms exceeds the "
+                        f"{remaining * 1e3:.0f}ms left of the "
+                        f"{budget_s * 1e3:.0f}ms budget (last: {last})",
+                        last=last, budget_s=budget_s,
+                        elapsed_s=time.monotonic() - t0,
+                        attempts=attempt + 1)
             self.retries_performed += 1
-            time.sleep(self._backoff_s(attempt, retry_after))
+            time.sleep(sleep_s)
         raise H2ORetryError(
             f"{method} {path}: exhausted {self.max_retries} retries "
-            f"(last: {last})", last=last)
+            f"(last: {last})", last=last, budget_s=budget_s,
+            elapsed_s=time.monotonic() - t0,
+            attempts=self.max_retries + 1)

@@ -810,6 +810,17 @@ def _h_model_metrics_list(h):
 
 
 # ===========================================================================
+
+# handlers that start a background Job — quota-prepaid at the REST
+# edge before the replay broadcast (see api/server.starts_job)
+_h_create_frame._starts_job = True
+_h_interaction._starts_job = True
+_h_missing_inserter._starts_job = True
+_h_frame_export._starts_job = True
+_h_pdp_build._starts_job = True
+_h_grid_build._starts_job = True
+_h_parse_svmlight._starts_job = True
+
 def build_routes():
     """(pattern, method, handler) rows appended to server.ROUTES."""
     R = re.compile

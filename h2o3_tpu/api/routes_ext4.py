@@ -526,6 +526,14 @@ def _h_import_sql_99(h):
 
 
 # ===========================================================================
+
+# handlers that start a background Job — quota-prepaid at the REST
+# edge before the replay broadcast (see api/server.starts_job)
+_h_grid_resume._starts_job = True
+# scoring handler — QoS admission at the REST edge before the replay
+# broadcast (see api/server.scores)
+_h_predict_v4._scores = True
+
 def build_routes():
     R = re.compile
     from h2o3_tpu.api import routes_ext as E1

@@ -79,13 +79,20 @@ class _Handler(BaseHTTPRequestHandler):
         super().end_headers()
 
     # ---- security (water/H2OSecurityManager.java + webserver auth) ------
-    def _check_auth(self) -> bool:
+    def _check_auth(self):
         """HTTP Basic credentials checked against the configured
         authenticator (utils/auth: basic file, LDAP simple bind, custom
-        LoginModule — the -basic_auth/-ldap_login surface)."""
+        LoginModule — the -basic_auth/-ldap_login surface).
+
+        Returns the authenticated USER NAME (the QoS principal seed) on
+        success, "" on an unauthenticated server (every caller lands in
+        the stable `anonymous` principal — the QoS path never branches
+        on auth mode), or None after answering 401. This runs BEFORE
+        any QoS admission or queue accounting: an unauthenticated flood
+        burns nothing but the 401 itself."""
         authn = getattr(self.server, "authenticator", None)
         if authn is None:
-            return True
+            return ""
         import base64
         hdr = self.headers.get("Authorization", "")
         if hdr.startswith("Basic "):
@@ -98,7 +105,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # a crafted pre-auth header must yield 401, never a
                 # handler crash — custom LoginModules may raise
                 if authn.authenticate(user, pwd):
-                    return True
+                    return user
             except Exception:
                 pass
         self.send_response(401)
@@ -106,7 +113,7 @@ class _Handler(BaseHTTPRequestHandler):
                          'Basic realm="h2o3-tpu"')
         self.send_header("Content-Length", "0")
         self.end_headers()
-        return False
+        return None
 
     # ---- plumbing -------------------------------------------------------
     def _send(self, obj, code=200, extra_headers=None):
@@ -132,6 +139,22 @@ class _Handler(BaseHTTPRequestHandler):
                     "msg": str(qf), "http_status": 503}, 503,
                    extra_headers={"Retry-After":
                                   str(getattr(qf, "retry_after_s", 1))})
+
+    def _rate_limited(self, ex):
+        """429 + Retry-After: the CALLER is over its configured rate or
+        quota (serving/qos token buckets / job quotas) — deliberately
+        distinct from 503, where the server is out of capacity."""
+        self._send({"__meta": {"schema_type": "H2OError"},
+                    "msg": str(ex), "http_status": 429}, 429,
+                   extra_headers={"Retry-After":
+                                  str(getattr(ex, "retry_after_s", 1))})
+
+    def _deadline_exceeded(self, ex):
+        """504: the request's X-H2O3-Deadline-Ms budget elapsed before
+        the work would have run — shed instead of computing an answer
+        nobody is waiting for (counted in h2o3_qos_shed_total)."""
+        self._send({"__meta": {"schema_type": "H2OError"},
+                    "msg": str(ex), "http_status": 504}, 504)
 
     def _params(self) -> dict:
         cached = getattr(self, "_cached_params", None)
@@ -219,16 +242,93 @@ class _Handler(BaseHTTPRequestHandler):
             _tracing.set_current(prev_trace)
             # the trace id rides the histogram as an OpenMetrics exemplar:
             # a Grafana latency spike clicks through to GET /3/Trace/{id}
+            dt = _time_mod.perf_counter() - t0
             REQUEST_SECONDS.observe(
-                _time_mod.perf_counter() - t0, exemplar=tid,
+                dt, exemplar=tid,
                 route=self._route_label, method=method,
                 status=str(self._status or 0))
+            # per-tenant SLI: scoring requests also land in the
+            # principal-labeled histogram the per-tenant SLO specs
+            # (obs/slo.py `principal` filter) burn against. Keyed on the
+            # matched handler's @scores mark (stashed by _route_inner
+            # before the entry-deadline shed, so edge 504s still count)
+            # — one registration-site source of truth, not a parallel
+            # path-prefix list that drifts when a scoring route is added.
+            if getattr(self, "_principal", None) \
+                    and getattr(self, "_scores_route", False):
+                from h2o3_tpu.serving import qos as _qos
+                _qos.observe_request(
+                    dt, exemplar=tid, principal=self._principal,
+                    status=str(self._status or 0))
 
     def _route_inner(self, method):
-        if not self._check_auth():
+        # ORDER MATTERS: authentication runs before any QoS admission or
+        # queue accounting, so an unauthenticated flood is rejected at
+        # 401 without consuming queue depth, tokens or principal state.
+        user = self._check_auth()
+        if user is None:
             self._route_label = "auth"
             return
+        from h2o3_tpu.serving import qos as _qos
+        # multi-tenant QoS context: the principal (authenticated user,
+        # else the stable `anonymous` bucket) and the caller's optional
+        # deadline budget ride the obs TLS alongside the trace id —
+        # admission, the micro-batcher and Job quotas all read them
+        # from there
+        principal = _qos.resolve_principal(user)
+        self._principal = principal
+        deadline = None
+        hdr = self.headers.get("X-H2O3-Deadline-Ms")
+        if hdr:
+            try:
+                ms = float(hdr)
+            except ValueError:
+                ms = None       # a junk header is "no deadline", not 400
+            if ms is not None:
+                deadline = _time_mod.monotonic() + ms / 1e3
+        # one route match per request: the pre-broadcast QoS marks, the
+        # route label and the dispatch below all reuse this result
         path = urllib.parse.urlparse(self.path).path
+        self._req_path = path
+        pat, fn, groups = _match_route(method, path)
+        # the per-tenant SLI emit in _route's finally keys on this:
+        # matched BEFORE the entry shed, so an edge 504 still counts
+        self._scores_route = fn is not None and \
+            getattr(fn, "_scores", False)
+        with _tracing.request_context(principal, deadline):
+            try:
+                # a budget that arrived already spent is shed at the
+                # edge — before params parse, broadcast or handler work
+                if _qos.enabled():
+                    _qos.check_deadline("entry")
+                    # PRE-BROADCAST rejections (multi-host divergence
+                    # guard): a 429 after the replay broadcast would
+                    # leave the workers running work the coordinator
+                    # refused — a build for job routes, a lone
+                    # collective scoring dispatch for scoring routes.
+                    # Job-starting handlers (marked @starts_job) charge
+                    # the concurrent-job quota here; scoring handlers
+                    # (marked @scores) pay deadline + token admission
+                    # here (the in-pipeline admit() sees the TLS flag
+                    # and skips the double charge).
+                    if method != "GET" and fn is not None:
+                        if getattr(fn, "_starts_job", False):
+                            _qos.prepay_job_slot()
+                        if getattr(fn, "_scores", False):
+                            _qos.edge_admit()
+                self._dispatch_routed(method, path, pat, fn, groups)
+            except _qos.RateLimited as ex:
+                self._rate_limited(ex)
+            except _qos.QuotaExceeded as ex:
+                self._rate_limited(ex)
+            except _qos.DeadlineExceeded as ex:
+                self._deadline_exceeded(ex)
+            finally:
+                # clear the edge-admission flag and return a prepaid
+                # charge no Job adopted (the handler 4xx'd first)
+                _qos.end_request()
+
+    def _dispatch_routed(self, method, path, pat, fn, groups):
         # SPMD replay (deploy/multihost): requests broadcast to every
         # worker BEFORE local dispatch so all hosts issue the same device
         # programs (a lone host in a collective would deadlock). GETs are
@@ -261,17 +361,54 @@ class _Handler(BaseHTTPRequestHandler):
                              trace=getattr(self, "_trace_id", None),
                              sampled=self.headers.get(
                                  "X-H2O3-Sample") == "1")
-            for pat, m, fn in ROUTES:
-                if m != method:
-                    continue
-                mm = pat.fullmatch(path)
-                if mm:
-                    self._route_label = pat.pattern
-                    fn(self, *mm.groups())
-                    return
+            if fn is not None:
+                self._route_label = pat.pattern
+                fn(self, *groups)
+                return
             self._error(f"no route {method} {path}", 404)
         except Exception as ex:  # noqa: BLE001 — handler errors → H2OError
+            # QoS rejections raised inside handlers (rate limit at
+            # admission, job quota at Job.start, deadline shed) are not
+            # handler errors: let _route_inner map them to 429/504
+            from h2o3_tpu.serving import qos as _qos
+            if isinstance(ex, (_qos.RateLimited, _qos.QuotaExceeded,
+                               _qos.DeadlineExceeded)):
+                raise
             self._error(repr(ex), 500)
+
+
+def starts_job(fn):
+    """Marks a handler that starts a background Job. The REST layer
+    prepays the concurrent-job quota for marked handlers BEFORE the
+    replay broadcast (qos.prepay_job_slot) — a registration-site flag,
+    so new job routes can't silently miss the pre-broadcast charge the
+    way a hand-kept path list would."""
+    fn._starts_job = True
+    return fn
+
+
+def scores(fn):
+    """Marks a scoring handler. The REST layer runs QoS admission
+    (deadline shed + token charge) for marked handlers at the edge,
+    BEFORE the replay broadcast (qos.edge_admit) — a 429 raised after
+    the broadcast would leave every worker dispatching a collective
+    scoring program the coordinator refused."""
+    fn._scores = True
+    return fn
+
+
+def _match_route(method: str, path: str):
+    """One ROUTES scan per request: (pattern, handler, match groups) for
+    (method, path), or (None, None, None). The pre-broadcast QoS marks
+    (`_starts_job` / `_scores`), the route label and the dispatch all
+    reuse this single result."""
+    for pat, m, fn in ROUTES:
+        if m != method:
+            continue
+        mm = pat.fullmatch(path)
+        if mm:
+            return pat, fn, mm.groups()
+    return None, None, None
 
 
 def _is_static_path(path: str) -> bool:
@@ -394,6 +531,7 @@ def _canon_col_types(ct: dict) -> dict:
     return {k: alias.get(str(v).lower(), v) for k, v in ct.items()}
 
 
+@starts_job
 def _h_parse(h: _Handler):
     p = h._params()
     src = p.get("source_frames")
@@ -438,6 +576,7 @@ def _h_parse(h: _Handler):
              "job": job.to_dict(), "destination_frame": {"name": dest}})
 
 
+@starts_job
 def _h_parse_distributed(h: _Handler):
     """POST /3/ParseDistributed — the cloud-wide chunked parse: the
     coordinator plans byte ranges and fans shares out over the replay
@@ -504,6 +643,7 @@ def _h_model_builders(h: _Handler):
                                 for k in ESTIMATORS}})
 
 
+@starts_job
 def _h_build_model(h: _Handler, algo):
     from h2o3_tpu.models import ESTIMATORS
     cls = ESTIMATORS.get(algo)
@@ -575,6 +715,7 @@ def _h_model_delete(h: _Handler, mid):
     h._send({"__meta": {"schema_type": "ModelsV3"}})
 
 
+@scores
 def _h_predict(h: _Handler, mid, fid):
     m = DKV.get(mid)
     f = DKV.get(fid)
@@ -612,6 +753,7 @@ def _h_predict(h: _Handler, mid, fid):
              "model_metrics": mm_json})
 
 
+@scores
 def _h_predict_rows(h: _Handler, mid):
     """POST /3/Predictions/models/{m} — lightweight row-payload scoring:
     JSON rows in, per-row predictions out, no DKV frame round-trip.
@@ -737,6 +879,7 @@ def _h_grid(h: _Handler, gid):
              "hyper_names": list(getattr(g, "hyper_params", {}).keys())})
 
 
+@starts_job
 def _h_automl_build(h: _Handler):
     """POST /99/AutoMLBuilder — AutoMLBuilderHandler analog."""
     from h2o3_tpu.automl.automl import H2OAutoML

@@ -60,14 +60,29 @@ class Job:
 
     # ---- lifecycle ------------------------------------------------------
     def start(self, work: Callable[["Job"], object], background: bool = True) -> "Job":
-        """Run `work(job)`; its return value is DKV-put under self.dest."""
+        """Run `work(job)`; its return value is DKV-put under self.dest.
+
+        Multi-tenant QoS: starting a job charges the launching request's
+        principal against its concurrent-job quota (H2O3_QOS_MAX_JOBS →
+        QuotaExceeded → REST 429) BEFORE the job transitions to RUNNING,
+        and the worker thread re-enters that principal so the job's own
+        device dispatches ride the batch lane (and nested jobs it spawns
+        are not double-counted)."""
+        from h2o3_tpu.obs import tracing as _tracing
+        from h2o3_tpu.serving import qos as _qos
+        # a REST job-route request pre-paid its quota charge BEFORE the
+        # replay broadcast (see qos.prepay_job_slot); adopt it — only
+        # job starts outside that flow charge here
+        qos_slot = _qos.adopt_prepaid_job_slot()
+        if qos_slot is None:
+            qos_slot = _qos.acquire_job_slot()
+        parent_principal = _tracing.principal()
         self.status = RUNNING
         # h2o3-ok: R016 wall-clock progress stamp for /3/Jobs display; no control flow or DKV key derivation reads it, so per-host divergence is cosmetic
         self.start_time = time.time()
         # jobs inherit the starting thread's trace (the REST request that
         # launched the build), so job.run/job.<phase> spans stitch into
         # GET /3/Trace/{id} even though the work runs on its own thread
-        from h2o3_tpu.obs import tracing as _tracing
         parent_trace = _tracing.current()
 
         def _run():
@@ -75,6 +90,7 @@ class Job:
             from h2o3_tpu.obs.timeline import span
             try:
                 with _tr.trace(parent_trace), \
+                        _qos.job_context(parent_principal), \
                         span("job.run", job=self.key,
                              description=self.description) as _sp:
                     try:
@@ -99,6 +115,7 @@ class Job:
                 self.traceback = traceback.format_exc()
                 self.status = FAILED
             finally:
+                _qos.release_job_slot(qos_slot)
                 # h2o3-ok: R016 wall-clock progress stamp (see start_time): display-only, never replicated into decisions
                 self.end_time = time.time()
                 self._done.set()

@@ -83,6 +83,11 @@ class SLOSpec:
         self.name = str(d["name"])
         self.metric = str(d.get("metric") or "h2o3_rest_request_seconds")
         self.route = str(d.get("route") or "")
+        # per-tenant SLOs (multi-tenant QoS): a `principal` regex scopes
+        # the SLI to series whose principal label matches — point the
+        # spec at h2o3_qos_request_seconds{principal,status} and the
+        # burn-rate engine answers "is THIS tenant inside its SLO"
+        self.principal = str(d.get("principal") or "")
         self.objective = float(d["objective"])
         if not 0.0 < self.objective < 1.0:
             raise ValueError(f"slo {self.name}: objective must be in "
@@ -94,6 +99,8 @@ class SLOSpec:
             (float(w[0]), float(w[1]), float(w[2]))
             for w in (d.get("windows") or DEFAULT_WINDOWS))
         self._route_re = re.compile(self.route) if self.route else None
+        self._principal_re = re.compile(self.principal) \
+            if self.principal else None
 
     @property
     def budget(self) -> float:
@@ -101,7 +108,8 @@ class SLOSpec:
 
     def to_dict(self) -> dict:
         return {"name": self.name, "metric": self.metric,
-                "route": self.route, "objective": self.objective,
+                "route": self.route, "principal": self.principal,
+                "objective": self.objective,
                 "threshold_ms": self.threshold_ms,
                 "windows": [list(w) for w in self.windows],
                 "kind": "latency" if self.threshold_ms is not None
@@ -293,6 +301,10 @@ class SLOEngine:
         for labels, snap in h.series_snapshots():
             if spec._route_re is not None and \
                     not spec._route_re.search(labels.get("route", "")):
+                continue
+            if spec._principal_re is not None and \
+                    not spec._principal_re.search(
+                        labels.get("principal", "")):
                 continue
             c = snap["count"]
             total += c

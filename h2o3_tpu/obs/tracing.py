@@ -72,3 +72,55 @@ def sanitize(trace_id):
         return None
     tid = str(trace_id).strip()
     return tid if _SAFE_ID.fullmatch(tid) else None
+
+
+# ---------------------------------------------------------------------------
+# Request context beyond the trace id (multi-tenant QoS, serving/qos.py):
+# the REST layer resolves every request to a PRINCIPAL (authenticated
+# user, else the stable "anonymous" bucket) and an optional DEADLINE
+# (X-H2O3-Deadline-Ms, stored as an absolute time.monotonic() instant),
+# and stamps both here alongside the trace id — the micro-batcher, the
+# job system and the QoS admission layer all read them from the same TLS
+# the spans already use. Kept in this module so the context stays
+# dependency-free (core/jobs and parallel/mrtask must not import the
+# serving package just to read who is asking).
+
+def principal():
+    """The calling thread's resolved principal, or None (no request
+    context — internal work, tests, library use)."""
+    return getattr(_TLS, "principal", None)
+
+
+def set_principal(name):
+    """Set the thread's principal; returns the previous value."""
+    prev = getattr(_TLS, "principal", None)
+    _TLS.principal = name
+    return prev
+
+
+def deadline():
+    """The request's absolute deadline (time.monotonic() seconds), or
+    None when the caller sent no X-H2O3-Deadline-Ms."""
+    return getattr(_TLS, "deadline", None)
+
+
+def set_deadline(when):
+    """Set the thread's deadline instant; returns the previous value."""
+    prev = getattr(_TLS, "deadline", None)
+    _TLS.deadline = when
+    return prev
+
+
+@contextlib.contextmanager
+def request_context(principal_name, deadline_at=None):
+    """Run a block as `principal_name` with an optional absolute
+    deadline — the REST dispatch wraps every handler in this; Job.start
+    re-enters it on the worker thread (principal only: a build outlives
+    its launching request's deadline)."""
+    prev_p = set_principal(principal_name)
+    prev_d = set_deadline(deadline_at)
+    try:
+        yield
+    finally:
+        set_principal(prev_p)
+        set_deadline(prev_d)

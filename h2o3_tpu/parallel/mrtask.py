@@ -151,6 +151,21 @@ def _dispatch_retrying(jfn, arrays, retryable: bool):
     return _dispatch_once(jfn, arrays)
 
 
+_qos_mod = None
+
+
+def _qos():
+    """Lazy, cached serving/qos handle: the serving package imports this
+    module at load time, so a module-level import here would cycle; by
+    the first device dispatch the import graph is settled and the cost
+    is one `is None` check per call."""
+    global _qos_mod
+    if _qos_mod is None:
+        from h2o3_tpu.serving import qos
+        _qos_mod = qos
+    return _qos_mod
+
+
 def _traced_dispatch(name: str, jfn, arrays, fn, retryable=True):
     """Dispatch `jfn(*arrays)`, recording an mrtask phase span when the
     calling thread is inside an active trace (obs/tracing). Untraced
@@ -158,11 +173,19 @@ def _traced_dispatch(name: str, jfn, arrays, fn, retryable=True):
     one watchdog registration (a slotted dict insert/remove under a
     leaf lock, a few microseconds).
 
+    Priority lanes (serving/qos): a dispatch issued from a Job thread is
+    BATCH work — it defers (bounded by H2O3_QOS_BATCH_YIELD_S) while
+    interactive scoring requests are pending in the micro-batch queue,
+    so training never steals device slots out from under a waiting
+    user. Preemption happens here, at the scheduler; an in-flight
+    device program always runs to completion.
+
     Every dispatch is watchdog-watched: a device program blocked past
     H2O3_WATCHDOG_STALL_S (the XLA:CPU collective-rendezvous deadlock —
     two in-flight multi-replica executions starving each other's
     thread-pool slots) trips a pinned diagnostic trace with a cluster
     JStack instead of hanging the process silently."""
+    _qos().batch_yield()
     fname = getattr(fn, "__name__", "<fn>")
     with _wd.watch("device", desc=f"{name}:{fname}"):
         if _tracing.current() is not None:

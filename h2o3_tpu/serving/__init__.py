@@ -21,6 +21,9 @@ from h2o3_tpu.serving.scorer_cache import (     # noqa: F401
 from h2o3_tpu.serving.params import PARAMS      # noqa: F401
 from h2o3_tpu.serving.microbatch import (   # noqa: F401
     BATCHER, MicroBatcher, QueueFull)
+from h2o3_tpu.serving import qos as _qos
+from h2o3_tpu.serving.qos import (          # noqa: F401
+    DeadlineExceeded, QuotaExceeded, RateLimited)
 
 
 def _microbatch_eligible(model, nrows: int) -> bool:
@@ -44,6 +47,11 @@ def predict_via_rest(model, frame):
     which itself prefers the scorer cache."""
     from h2o3_tpu.serving import scorer_cache as _sc
     if not _microbatch_eligible(model, frame.nrows):
+        # the HEAVY requests (oversized frames, custom-predict models,
+        # multihost fallbacks) are exactly the ones a flooding tenant
+        # leans on: QoS admission (deadline shed + token charge) applies
+        # here too — only the queue-share cap is micro-batch-specific
+        _qos.admit()
         return model.predict(frame)
     # shed BEFORE staging: a 503-bound request must not pay the
     # per-column decode + device_put only to be rejected at enqueue
@@ -57,6 +65,11 @@ def predict_via_rest(model, frame):
         # backpressure is NOT degradation: falling back to model.predict
         # here would put the shed load right back on the stalled device.
         # Propagate so the REST layer answers 503 + Retry-After.
+        raise
+    except (RateLimited, QuotaExceeded, DeadlineExceeded):
+        # QoS rejections likewise: a deadline-shed request scored on the
+        # legacy path would pay the device for an answer nobody is
+        # waiting for (and strike the model as broken on top)
         raise
     except Exception:   # noqa: BLE001 — serving must degrade, not 500
         _sc._note_failure((model.key, model_token(model)))
@@ -165,6 +178,10 @@ def score_payload(model, rows, columns=None) -> list:
     if use_fast:
         # shed before decoding the payload into a staging buffer
         BATCHER.check_capacity()
+    else:
+        # ineligible payloads still pay QoS admission (rate limit +
+        # deadline shed) before any decode work — see predict_via_rest
+        _qos.admit()
     raw = payload_to_raw(model, rows, columns)
     n = raw.shape[0]
     if n == 0:
@@ -174,6 +191,8 @@ def score_payload(model, rows, columns=None) -> list:
             out = BATCHER.score(model, raw, n)
         except QueueFull:
             raise       # shed load at the REST edge (503), don't reroute
+        except (RateLimited, QuotaExceeded, DeadlineExceeded):
+            raise       # QoS rejections: 429/504, never a legacy re-score
         except Exception:   # noqa: BLE001 — degrade to the frame path
             _sc._note_failure((model.key, model_token(model)))
             FALLBACKS.inc(reason="trace-error")

@@ -11,6 +11,15 @@ generations of the same key) never mix.
 This converts serving throughput from O(dispatches == requests) to
 O(dispatches == buckets): at high concurrency the accelerator sees a few
 large padded batches instead of a stream of tiny ones.
+
+Multi-tenant QoS (serving/qos.py): the single FIFO became per-principal
+weighted-fair queues — requests coalesce only within their principal
+(group key carries it), each tenant's occupancy of the global depth
+bound is capped at its share, device slots are granted to ready
+dispatches by deficit round-robin over configured weights, and a
+request whose X-H2O3-Deadline-Ms budget elapsed is shed before staging
+(entry) or skipped by its coalesced dispatch (a dead follower) — never
+paid for on the device.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ from h2o3_tpu.deploy import membership as _mb
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs.timeline import span as _span
+from h2o3_tpu.serving import qos as _qos
 from h2o3_tpu.serving import scorer_cache as _sc
 from h2o3_tpu.utils.env import env_float, env_int
 
@@ -85,7 +95,8 @@ def _queue_depth_limit() -> int:
 
 
 class _Request:
-    __slots__ = ("raw", "n", "event", "result", "error", "trace")
+    __slots__ = ("raw", "n", "event", "result", "error", "trace",
+                 "principal", "deadline")
 
     def __init__(self, raw: np.ndarray, n: int):
         self.raw = raw
@@ -96,6 +107,12 @@ class _Request:
         # submitting request's trace id: the coalesced dispatch span
         # links every parent trace it served
         self.trace = _tracing.current()
+        # QoS context, captured on the submitting thread: the principal
+        # keys the weighted-fair queue, and the deadline rides the
+        # micro-batch so the coalesced dispatch can skip a follower
+        # whose caller already gave up
+        self.principal = _tracing.principal()
+        self.deadline = _tracing.deadline()
 
 
 class MicroBatcher:
@@ -103,44 +120,105 @@ class MicroBatcher:
         self._lock = make_lock("microbatch")
         self._pending: dict = {}
         self._depth = 0       # in-flight requests (entered, not yet woken)
+        self._queued: dict = {}   # principal -> in-flight request count
 
     def check_capacity(self):
         """Raise QueueFull when the in-flight bound is already hit — for
         callers to shed load BEFORE paying frame adaptation + staging.
+        Also the QoS admission point (deadline shed → 504, token-bucket
+        rate limit → 429, per-tenant queue share → 503): everything that
+        can reject a request does so before the per-column decode.
         Advisory (no reservation): score() re-checks authoritatively."""
+        _qos.admit()
         limit = _queue_depth_limit()
+        principal = _tracing.principal()
+        share_cap = _qos.tenant_share_cap(limit)
         with self._lock:
             if limit > 0 and self._depth >= limit:
                 REJECTED.inc()
                 raise QueueFull(self._depth, limit)
+            held = self._share_held_locked(principal, limit, share_cap)
+        if held is not None:
+            self._share_rejected(principal, held, share_cap)
+
+    def _share_held_locked(self, principal, limit, share_cap):
+        """This principal's in-flight count when it is at/over its queue
+        share (caller holds self._lock), else None. The one owner of the
+        share-cap comparison for both admission sites."""
+        if limit <= 0 or not principal:
+            return None
+        held = self._queued.get(principal, 0)   # h2o3-ok: R003 _locked helper — both callers hold self._lock
+        return held if held >= share_cap else None
+
+    @staticmethod
+    def _share_rejected(principal, held, share_cap):
+        """Share-cap rejection (→ 503): counters + raise, called OUTSIDE
+        self._lock so the reject path never nests the metrics-registry
+        lock inside the micro-batch lock in a new order."""
+        REJECTED.inc()
+        _qos.note_share_reject(principal)
+        raise QueueFull(held, share_cap)
+
+    def queued_by_principal(self) -> dict:
+        """Snapshot of per-principal in-flight counts (the
+        h2o3_qos_queue_depth{principal} gauge callback). LOCK-FREE
+        (GIL-atomic dict copy), like the depth gauge: the callback runs
+        under the metrics-registry lock while admission emits counters
+        under the micro-batch lock — taking self._lock here would be
+        the reverse order edge (lockdep inversion)."""
+        return dict(self._queued)
 
     def score(self, model, raw: np.ndarray, n: int) -> np.ndarray:
         """Submit (n, C) staged raw rows; returns the (n, ...) host result
         for exactly these rows. Blocks until the coalesced dispatch lands.
-        Raises QueueFull (→ HTTP 503) when the in-flight bound is hit.
+        Raises QueueFull (→ HTTP 503) when the in-flight bound — or the
+        submitting tenant's share of it — is hit.
         """
         REQUESTS.inc()
+        req = _Request(np.asarray(raw[:n], np.float32), n)
         # token (not DKV version): requests only coalesce when they hold
         # the SAME model object, so a mid-stream overwrite can never mix
-        # two generations in one dispatch
-        key = (model.key, _sc.model_token(model), raw.shape[1])
-        req = _Request(np.asarray(raw[:n], np.float32), n)
+        # two generations in one dispatch. The PRINCIPAL is part of the
+        # key: tenants never share a coalesced dispatch, so each group
+        # charges exactly one tenant at the fair gate.
+        key = (model.key, _sc.model_token(model), raw.shape[1],
+               req.principal)
         limit = _queue_depth_limit()
+        share_cap = _qos.tenant_share_cap(limit)
+        share_held = None
         with self._lock:
             if limit > 0 and self._depth >= limit:
                 REJECTED.inc()
                 raise QueueFull(self._depth, limit)
-            self._depth += 1
-            group = self._pending.get(key)
-            leader = group is None
-            if leader:
-                group = self._pending[key] = []
-            group.append(req)
+            share_held = self._share_held_locked(req.principal, limit,
+                                                 share_cap)
+            if share_held is None:
+                self._depth += 1
+                if req.principal:
+                    self._queued[req.principal] = \
+                        self._queued.get(req.principal, 0) + 1
+                group = self._pending.get(key)
+                leader = group is None
+                if leader:
+                    group = self._pending[key] = []
+                group.append(req)
+        if share_held is not None:
+            # deferred out of the lock: enqueue must be atomic with the
+            # check, but the rejection counters must not emit under it
+            self._share_rejected(req.principal, share_held, share_cap)
+        _qos.note_interactive_start()
         try:
             return self._await_result(model, key, req, leader)
         finally:
+            _qos.note_interactive_end()
             with self._lock:
                 self._depth -= 1
+                if req.principal:
+                    left = self._queued.get(req.principal, 0) - 1
+                    if left <= 0:
+                        self._queued.pop(req.principal, None)
+                    else:
+                        self._queued[req.principal] = left
 
     def _await_result(self, model, key, req, leader) -> np.ndarray:
         if leader:
@@ -210,6 +288,27 @@ class MicroBatcher:
 
     @staticmethod
     def _dispatch_chunk(model, batch):
+        # deadline-aware shedding BEFORE staging or device dispatch: a
+        # follower whose X-H2O3-Deadline-Ms budget elapsed while the
+        # batch formed is answered 504 here — it contributes no rows, no
+        # staging copy, and (when the whole chunk is dead) no dispatch
+        # and no scorer compile at all. Gated off on multi-controller
+        # runtimes: the workers replayed the broadcast and will join the
+        # collective dispatch regardless, so the coordinator must too
+        # (see qos.single_controller).
+        now = time.monotonic()
+        dead = [r for r in batch
+                if _qos.deadline_dead(r.deadline, now)] \
+            if _qos.single_controller() else []
+        if dead:
+            batch = [r for r in batch if not _qos.deadline_dead(r.deadline,
+                                                                now)]
+            for r in dead:
+                r.error = _qos.DeadlineExceeded(now - r.deadline)
+                r.event.set()
+                _qos.SHED.inc(reason="batch")
+        if not batch:
+            return
         try:
             total = sum(r.n for r in batch)
             bucket = _sc.row_bucket(total)
@@ -224,24 +323,35 @@ class MicroBatcher:
                         requests=len(batch), links=links) \
                 if links or _tracing.current() is not None \
                 else contextlib.nullcontext()
+            # weighted-fair gate: groups are single-principal (the key
+            # carries it), so the whole chunk charges one tenant; under
+            # device-slot contention grants follow deficit round-robin
+            # over the configured weights
+            took = _qos.GATE.acquire(batch[0].principal or _qos.ANONYMOUS,
+                                     total)
             t0 = time.perf_counter()
-            with ctx:
-                raw = np.full((bucket, C), np.nan, np.float32)
-                off = 0
-                for r in batch:
-                    raw[off:off + r.n] = r.raw
-                    off += r.n
-                # membership-aware dispatch: a scoring batch straddling a
-                # cloud-epoch bump (a worker excised mid-request) retries
-                # once with jittered backoff against the new epoch instead
-                # of failing all N coalesced requests. The chaos hook lets
-                # the fault harness fail a seeded dispatch deterministically.
-                def _score():
-                    _chaos.maybe_raise("microbatch.dispatch",
-                                       exc=_mb.EpochChanged)
-                    return _sc.score_rows(model, raw, total, links=links)
+            try:
+                with ctx:
+                    raw = np.full((bucket, C), np.nan, np.float32)
+                    off = 0
+                    for r in batch:
+                        raw[off:off + r.n] = r.raw
+                        off += r.n
+                    # membership-aware dispatch: a scoring batch straddling
+                    # a cloud-epoch bump (a worker excised mid-request)
+                    # retries once with jittered backoff against the new
+                    # epoch instead of failing all N coalesced requests.
+                    # The chaos hook lets the fault harness fail a seeded
+                    # dispatch deterministically.
+                    def _score():
+                        _chaos.maybe_raise("microbatch.dispatch",
+                                           exc=_mb.EpochChanged)
+                        return _sc.score_rows(model, raw, total,
+                                              links=links)
 
-                out = _mb.retry_once(_score, op="microbatch")
+                    out = _mb.retry_once(_score, op="microbatch")
+            finally:
+                _qos.GATE.release(took)
             DISPATCHES.inc()
             # one served trace id rides each histogram as an OpenMetrics
             # exemplar, so a dispatch-latency spike resolves to a trace
