@@ -90,6 +90,12 @@ def blocked_record(stage: str, detail: str, backend: str = "none") -> dict:
         "blocked_stage": stage,
         "blocked_detail": (_short_cause(detail)
                            if "Traceback" in detail else detail[-2000:]),
+        # attribution fields ride every record (ISSUE 16): present-but-
+        # null on a chip-less/blocked round, with blocked_stage above
+        # naming the cause — never silently absent
+        "device_seconds": None,
+        "utilization_pct": None,
+        "attribution_overhead_pct": None,
     }
 
 
@@ -389,12 +395,40 @@ def scoring_bench() -> dict:
         dt, out = timed_loop_logged()
         dt_log = min(dt_log, dt)
         _ulog.flush()            # drain NOW, outside the timed windows
+    # usage-attribution overhead (ISSUE 16): the SAME warm traced loop
+    # with the device-time ledger forced OFF vs ON (usage.set_enabled),
+    # alternating best-of-5 like the pairs above. The ledger's warm-path
+    # cost is one perf_counter pair + a counter inc + a dict update per
+    # dispatch, so the bound is tight: <1% on >=2 cores. The ON pass
+    # also yields the record's device_seconds (ledger delta across the
+    # best loop) and utilization_pct — charged device seconds over wall
+    # seconds x local device count.
+    from h2o3_tpu.obs import usage as _usage
+    import jax as _jax
+    dt_led_off = dt_led_on = float("inf")
+    device_seconds = 0.0
+    for _ in range(5):
+        tracing.set_current(tracing.new_trace_id())
+        _usage.set_enabled(False)
+        dt, out = timed_loop()
+        dt_led_off = min(dt_led_off, dt)
+        _usage.set_enabled(True)
+        d0 = _usage.device_seconds_total()
+        dt, out = timed_loop()
+        if dt < dt_led_on:
+            dt_led_on = dt
+            device_seconds = _usage.device_seconds_total() - d0
+    _usage.set_enabled(None)             # back to the env default
     tracing.set_current(prev_trace)
     assert out is not None and len(out) >= batch
     warm_compiles = om.xla_compile_count() - c0
     rows_per_sec = batch * iters / dt_on
     overhead_pct = 100.0 * (dt_on - dt_off) / dt_off
     logging_overhead_pct = 100.0 * (dt_log - dt_on) / dt_on
+    attribution_overhead_pct = 100.0 * (dt_led_on - dt_led_off) / dt_led_off
+    devices = _jax.local_device_count()
+    utilization_pct = (100.0 * device_seconds / (dt_led_on * devices)
+                       if dt_led_on > 0 else 0.0)
     om.REGISTRY.gauge("h2o3_bench_scoring_rows_per_sec",
                       "warm-cache bucketed serving throughput"
                       ).set(rows_per_sec)
@@ -419,8 +453,15 @@ def scoring_bench() -> dict:
            "fast_path_hits": fast_hits,
            "fallbacks": fallbacks,
            "param_hbm_bytes": param_bytes,
-           "params_shared": bool(_scc._shares_params(m))}
-    if (overhead_pct > 5.0 or logging_overhead_pct > 1.0) and cores < 2:
+           "params_shared": bool(_scc._shares_params(m)),
+           # capacity attribution (ISSUE 16): what the usage ledger
+           # charged for the best traced loop, and that charge as a
+           # share of wall time across the local devices
+           "device_seconds": round(device_seconds, 4),
+           "utilization_pct": round(utilization_pct, 2),
+           "attribution_overhead_pct": round(attribution_overhead_pct, 2)}
+    if (overhead_pct > 5.0 or logging_overhead_pct > 1.0
+            or attribution_overhead_pct > 1.0) and cores < 2:
         # structured bound-waiver (ISSUE 14 satellite): with one physical
         # core the instrumented and baseline loops time-slice against
         # every background thread in the process, so the <5%/<1% bounds
@@ -429,7 +470,8 @@ def scoring_bench() -> dict:
             "cause": f"{cores}-core container: measured loop time-slices "
                      "against drain/GC threads; bounds need >=2 cores "
                      "(r06/r07 measured 0.09%/0.47% on 2 cores)",
-            "bounds": {"tracing_pct": 5.0, "logging_pct": 1.0}}
+            "bounds": {"tracing_pct": 5.0, "logging_pct": 1.0,
+                       "attribution_pct": 1.0}}
     for k in (fr.key, sf.key, m.key):
         DKV.remove(k)
     return rec
@@ -1041,6 +1083,10 @@ def main():
         "param_hbm_bytes": (scoring or {}).get("param_hbm_bytes"),
         "tracing_overhead_pct": (scoring or {}).get("tracing_overhead_pct"),
         "logging_overhead_pct": (scoring or {}).get("logging_overhead_pct"),
+        "device_seconds": (scoring or {}).get("device_seconds"),
+        "utilization_pct": (scoring or {}).get("utilization_pct"),
+        "attribution_overhead_pct":
+            (scoring or {}).get("attribution_overhead_pct"),
         "trace_id": bench_trace,
         "paths": paths,
         "ingest_mb_per_sec": (ingest or {}).get("mb_per_sec"),

@@ -21,6 +21,11 @@ client half of the server's backpressure, QoS and elasticity contracts:
     into a deadline nobody can meet.
   * Transient transport drops (connection reset/refused mid-restart) are
     retried the same way when `retry_connect=True`.
+  * **Latency decomposition**: the server answers with a standard
+    ``Server-Timing`` header (edge/queue/gate/decode/device/readback/app
+    stage waterfall); the client parses it into ``last_timings`` — a
+    {stage: seconds} dict for the LAST SUCCESSFUL attempt, so it
+    survives retries as the breakdown of the response actually returned.
 
 Stdlib-only (urllib), like the server. Usage:
 
@@ -43,6 +48,26 @@ import urllib.request
 __all__ = ["H2OClient", "H2ORetryError"]
 
 _RETRY_CODES = (429, 503)
+
+
+def _parse_server_timing(value: str) -> dict:
+    """Server-Timing header → {stage: seconds}. The wire format is
+    comma-separated ``name;dur=<milliseconds>`` entries (RFC 8673 shape);
+    entries without a parseable dur are skipped, never fatal."""
+    out = {}
+    for part in value.split(","):
+        fields = part.strip().split(";")
+        name = fields[0].strip()
+        if not name:
+            continue
+        for f in fields[1:]:
+            k, _, v = f.strip().partition("=")
+            if k.strip().lower() == "dur":
+                try:
+                    out[name] = float(v) / 1e3
+                except ValueError:
+                    pass
+    return out
 
 
 class H2ORetryError(RuntimeError):
@@ -86,6 +111,9 @@ class H2OClient:
         self.headers = dict(headers or {})
         self._rng = rng if rng is not None else random.Random()
         self.retries_performed = 0     # observability for tests/tools
+        # {stage: seconds} from the last successful response's
+        # Server-Timing header (empty until a response carries one)
+        self.last_timings: dict = {}
 
     # ---- public verbs ----------------------------------------------------
     def get(self, path: str, deadline_ms=None, **params):
@@ -152,6 +180,12 @@ class H2OClient:
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as r:
                     raw = r.read()
+                    st = r.headers.get("Server-Timing")
+                    if st:
+                        # only the SUCCESSFUL attempt updates the stage
+                        # breakdown — a retried 503's timings would
+                        # describe a response the caller never saw
+                        self.last_timings = _parse_server_timing(st)
                     return json.loads(raw) if raw else None
             except urllib.error.HTTPError as ex:
                 if ex.code not in _RETRY_CODES:

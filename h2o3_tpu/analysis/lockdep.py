@@ -98,6 +98,10 @@ def enable(mode: str = "raise"):
     if mode not in ("raise", "log"):
         raise ValueError(f"lockdep mode {mode!r} (want 'raise' or 'log')")
     _STATE.mode = mode
+    try:
+        _metrics()      # counters visible at zero before the first edge
+    except ImportError:     # metrics registry mid-import: stays lazy
+        pass
 
 
 def disable():

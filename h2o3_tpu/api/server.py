@@ -32,6 +32,7 @@ from h2o3_tpu.core.kvstore import DKV
 from h2o3_tpu.io import parser as io_parser
 from h2o3_tpu.obs import metrics as _obs_metrics
 from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs import usage as _usage
 from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.rapids import rapids_exec, Session
 from h2o3_tpu.utils import env as _env
@@ -120,6 +121,17 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.dumps(obj, default=_json_default).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        # per-request latency decomposition: close the stage recorder
+        # against the route's wall clock (the remainder becomes `app`,
+        # so the emitted stages always sum to the measured wall) and
+        # hand the waterfall back as a standard Server-Timing header
+        t0 = getattr(self, "_route_t0", None)
+        timings = _usage.finish_request(
+            _time_mod.perf_counter() - t0 if t0 is not None else None)
+        if timings:
+            self._timings = timings     # → rest.request span attrs
+            self.send_header("Server-Timing",
+                             _usage.server_timing(timings))
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
@@ -202,6 +214,12 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = _time_mod.perf_counter()
         self._status = 0
         self._route_label = "unmatched"
+        # latency decomposition: open the per-thread stage recorder (the
+        # serving path feeds it; _send closes it into Server-Timing).
+        # The route t0 anchors the `app` remainder computation.
+        self._route_t0 = t0
+        self._timings = None
+        _usage.begin_request()
         # distributed tracing: honor the caller's X-H2O3-Trace-Id, mint
         # one otherwise; current for the whole dispatch so every span the
         # request opens (and every job/broadcast it starts) carries it
@@ -236,10 +254,18 @@ class _Handler(BaseHTTPRequestHandler):
                     self._route_inner(method)
                     sp.attrs["route"] = self._route_label
                     sp.attrs["status"] = self._status or 0
+                    # the response's Server-Timing breakdown rides the
+                    # root span too, so a stored trace explains its
+                    # own latency without the caller keeping the header
+                    if getattr(self, "_timings", None):
+                        sp.attrs["stages"] = {
+                            k: round(v, 6)
+                            for k, v in self._timings.items()}
             else:
                 self._route_inner(method)
         finally:
-            _tracing.set_current(prev_trace)
+            _usage.clear_request()   # 401s/handler crashes: no leak into
+            _tracing.set_current(prev_trace)  # the next keep-alive request
             # the trace id rides the histogram as an OpenMetrics exemplar:
             # a Grafana latency spike clicks through to GET /3/Trace/{id}
             dt = _time_mod.perf_counter() - t0
@@ -265,6 +291,7 @@ class _Handler(BaseHTTPRequestHandler):
         # ORDER MATTERS: authentication runs before any QoS admission or
         # queue accounting, so an unauthenticated flood is rejected at
         # 401 without consuming queue depth, tokens or principal state.
+        edge_t0 = _time_mod.perf_counter()
         user = self._check_auth()
         if user is None:
             self._route_label = "auth"
@@ -316,6 +343,11 @@ class _Handler(BaseHTTPRequestHandler):
                             _qos.prepay_job_slot()
                         if getattr(fn, "_scores", False):
                             _qos.edge_admit()
+                # everything up to here — auth, principal resolve, route
+                # match, deadline parse, pre-broadcast QoS admission —
+                # is the request's edge-admission stage
+                _usage.add_stage(
+                    "edge", _time_mod.perf_counter() - edge_t0)
                 self._dispatch_routed(method, path, pat, fn, groups)
             except _qos.RateLimited as ex:
                 self._rate_limited(ex)
@@ -427,7 +459,7 @@ def _is_obs_path(path: str) -> bool:
     replay barrier must not serialize behind."""
     return path in ("/metrics", "/3/Timeline", "/3/WaterMeter",
                     "/3/Profiler", "/3/Traces", "/3/Alerts",
-                    "/3/JStack") \
+                    "/3/JStack", "/3/Usage", "/3/CloudHealth") \
         or path.startswith("/3/Logs") or path.startswith("/3/Trace/") \
         or path.startswith("/3/Cloud/")
 
@@ -1171,6 +1203,52 @@ def _h_alerts(h: _Handler):
              "firing": [a["slo"] for a in alerts if a.get("firing")]})
 
 
+def _h_usage(h: _Handler):
+    """GET /3/Usage — the per-tenant/per-model cost table: device-second
+    attribution from the dispatch-funnel ledger plus HBM occupancy
+    (ParamStore placements, tier-pager budgets), merged cluster-wide over
+    the `usage` collect op with the same lagging-host absorption as the
+    federated /metrics scrape."""
+    from h2o3_tpu.obs import usage as _us
+    snaps = [_us.usage_snapshot()]
+    lagging = []
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        for i, remote in enumerate(bc.collect("usage",
+                                              timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                snaps.append(remote)
+            else:
+                lagging.append(i + 1)
+    body = _us.merge_usage(snaps)
+    body["__meta"] = {"schema_type": "UsageV3"}
+    body["lagging_hosts"] = lagging
+    h._send(body)
+
+
+def _h_cloudhealth(h: _Handler):
+    """GET /3/CloudHealth — one synthesized pressure document for the
+    cloud (HPA external-metric shape: every dimension normalized so 1.0
+    means saturated, merged as a max across hosts). A fresh evaluation
+    runs on every call — the response never trails a background period —
+    and refreshes the h2o3_pressure{dimension} gauges as a side effect."""
+    from h2o3_tpu.obs import usage as _us
+    snaps = [_us.evaluate_pressure()]
+    lagging = []
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        for i, remote in enumerate(
+                bc.collect("cloudhealth", timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                snaps.append(remote)
+            else:
+                lagging.append(i + 1)
+    body = _us.merge_cloudhealth(snaps)
+    body["__meta"] = {"schema_type": "CloudHealthV3"}
+    body["lagging_hosts"] = lagging
+    h._send(body)
+
+
 def _cluster_metric_snapshots(h: _Handler):
     """[(host, registry-snapshot)] for every answering host, local first.
     A lagging worker is absorbed within the collect deadline: its slot is
@@ -1382,6 +1460,8 @@ ROUTES = [
     (re.compile(r"/3/Trace/([^/]+)"), "GET", _h_trace),
     (re.compile(r"/3/Traces"), "GET", _h_traces),
     (re.compile(r"/3/Alerts"), "GET", _h_alerts),
+    (re.compile(r"/3/Usage"), "GET", _h_usage),
+    (re.compile(r"/3/CloudHealth"), "GET", _h_cloudhealth),
     (re.compile(r"/metrics"), "GET", _h_metrics),
     (re.compile(r"/3/WaterMeter"), "GET", _h_watermeter),
     (re.compile(r"/3/Profiler"), "POST", _h_profiler),

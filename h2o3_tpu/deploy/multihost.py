@@ -600,6 +600,17 @@ def _collect_local(op: str):
             from h2o3_tpu.obs import timeline as _tl
             return {"host": _tl.host_id(),
                     "metrics": _m.REGISTRY.to_dict()}
+        if op == "usage":
+            # GET /3/Usage cluster merge: this host's attribution ledger
+            # + HBM occupancy (the snapshot carries its own host id)
+            from h2o3_tpu.obs import usage as _us
+            return _us.usage_snapshot()
+        if op == "cloudhealth":
+            # GET /3/CloudHealth cluster merge: a FRESH local pressure
+            # evaluation per collect, so the merged document never
+            # reports a stale worker dimension
+            from h2o3_tpu.obs import usage as _us
+            return _us.evaluate_pressure()
         if op.startswith("trace:"):
             # GET /3/Trace/{id} read-through: this host's ring spans for
             # ONE trace plus whatever its flight recorder retained, plus

@@ -582,6 +582,27 @@ def xla_compile_count() -> float:
     return m.value() if m is not None else 0.0
 
 
+_BUILD_INFO = None
+
+
+def _build_info_series():
+    """h2o3_build_info callback: the identity labels are immutable for
+    the process lifetime, so they resolve once (lazily — at the first
+    scrape, never at import, where jax may still be initializing)."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        import h2o3_tpu as _pkg
+        try:
+            import jax as _jax
+            backend = str(_jax.default_backend())
+            jaxv = str(getattr(_jax, "__version__", "unknown"))
+        except Exception:   # noqa: BLE001 — chip-less container: still expose
+            backend, jaxv = "none", "none"
+        _BUILD_INFO = ({"version": str(getattr(_pkg, "__version__", "0")),
+                        "backend": backend, "jax": jaxv}, 1.0)
+    return [_BUILD_INFO]
+
+
 def install_runtime_gauges():
     """Register the default runtime gauges (idempotent; called by the API
     server at start and by /metrics scrapes)."""
@@ -591,6 +612,18 @@ def install_runtime_gauges():
     gauge("h2o3_dkv_objects",
           "DKV registry census: live keys, frames, frame bytes",
           fn=_dkv_series)
+    gauge("h2o3_build_info",
+          "build/runtime identity info-gauge (value always 1): package "
+          "version, JAX backend and jax version — correlates dashboards "
+          "and bench trajectories across container/backend changes",
+          fn=_build_info_series)
+    # the usage ledger's pressure/attribution metrics register at its
+    # import; pulling it in here makes them scrapeable even when the
+    # serving path was never touched (bench, notebooks)
+    try:
+        from h2o3_tpu.obs import usage  # noqa: F401
+    except ImportError:
+        pass
     _install_jax_listeners()
 
 

@@ -102,16 +102,37 @@ def run_host_serialized(fn):
         return _block_concrete(fn())
 
 
+_usage_mod = None
+
+
+def _usage():
+    """Lazy obs/usage handle (same shape as mrtask's lazy qos import):
+    the metrics registry pulls usage in at its own import, so a
+    module-level import here would cycle through obs during bootstrap;
+    by the first guarded launch the graph is settled."""
+    global _usage_mod
+    if _usage_mod is None:
+        from h2o3_tpu.obs import usage
+        _usage_mod = usage
+    return _usage_mod
+
+
 def guard_collective(jfn):
     """Wrap an already-jitted callable so every invocation runs under
     the host-mesh collective guard. The decorator spelling of
     run_host_serialized, for module-level jits the dispatch layer cannot
-    see (the tree engine's level programs, GLM's gram passes)."""
+    see (the tree engine's level programs, GLM's gram passes).
+
+    Also the bottom of the usage-attribution funnel: every guarded
+    launch meters its wall seconds to the ambient principal (kind
+    `jit`) unless an outer meter — mrtask's traced dispatch, the scorer
+    cache — already owns the charge."""
     import functools
 
     @functools.wraps(jfn)
     def _guarded(*a, **k):
-        return run_host_serialized(lambda: jfn(*a, **k))
+        with _usage().meter("jit"):
+            return run_host_serialized(lambda: jfn(*a, **k))
 
     _guarded.__wrapped__ = jfn
     return _guarded

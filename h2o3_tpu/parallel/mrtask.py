@@ -152,6 +152,7 @@ def _dispatch_retrying(jfn, arrays, retryable: bool):
 
 
 _qos_mod = None
+_usage_mod = None
 
 
 def _qos():
@@ -164,6 +165,15 @@ def _qos():
         from h2o3_tpu.serving import qos
         _qos_mod = qos
     return _qos_mod
+
+
+def _usage():
+    """Lazy obs/usage handle — same cycle-avoidance shape as _qos()."""
+    global _usage_mod
+    if _usage_mod is None:
+        from h2o3_tpu.obs import usage
+        _usage_mod = usage
+    return _usage_mod
 
 
 def _traced_dispatch(name: str, jfn, arrays, fn, retryable=True):
@@ -187,7 +197,11 @@ def _traced_dispatch(name: str, jfn, arrays, fn, retryable=True):
     JStack instead of hanging the process silently."""
     _qos().batch_yield()
     fname = getattr(fn, "__name__", "<fn>")
-    with _wd.watch("device", desc=f"{name}:{fname}"):
+    # usage attribution: the dispatch wall charges the ambient principal
+    # under this op's kind; the guarded jit's own meter inside jfn is
+    # suppressed (outermost meter wins), so the seconds charge once
+    with _wd.watch("device", desc=f"{name}:{fname}"), \
+            _usage().meter(name):
         if _tracing.current() is not None:
             with _span(name, fn=fname):
                 return _dispatch_retrying(jfn, arrays, retryable)

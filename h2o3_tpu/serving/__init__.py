@@ -24,6 +24,7 @@ from h2o3_tpu.serving.microbatch import (   # noqa: F401
 from h2o3_tpu.serving import qos as _qos
 from h2o3_tpu.serving.qos import (          # noqa: F401
     DeadlineExceeded, QuotaExceeded, RateLimited)
+from h2o3_tpu.obs import usage as _usage
 
 
 def _microbatch_eligible(model, nrows: int) -> bool:
@@ -57,9 +58,11 @@ def predict_via_rest(model, frame):
     # per-column decode + device_put only to be rejected at enqueue
     BATCHER.check_capacity()
     try:
-        di = model._dinfo
-        af = di.adapt(frame)
-        raw = stage_frame(di, af, frame.nrows)
+        # frame adaptation + staging is the request's decode stage
+        with _usage.stage("decode"):
+            di = model._dinfo
+            af = di.adapt(frame)
+            raw = stage_frame(di, af, frame.nrows)
         out = BATCHER.score(model, raw, frame.nrows)
     except QueueFull:
         # backpressure is NOT degradation: falling back to model.predict
@@ -182,7 +185,8 @@ def score_payload(model, rows, columns=None) -> list:
         # ineligible payloads still pay QoS admission (rate limit +
         # deadline shed) before any decode work — see predict_via_rest
         _qos.admit()
-    raw = payload_to_raw(model, rows, columns)
+    with _usage.stage("decode"):
+        raw = payload_to_raw(model, rows, columns)
     n = raw.shape[0]
     if n == 0:
         return []

@@ -35,6 +35,7 @@ from h2o3_tpu.deploy import chaos as _chaos
 from h2o3_tpu.deploy import membership as _mb
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs import usage as _usage
 from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.serving import qos as _qos
 from h2o3_tpu.serving import scorer_cache as _sc
@@ -96,7 +97,7 @@ def _queue_depth_limit() -> int:
 
 class _Request:
     __slots__ = ("raw", "n", "event", "result", "error", "trace",
-                 "principal", "deadline")
+                 "principal", "deadline", "t_enqueue", "stages")
 
     def __init__(self, raw: np.ndarray, n: int):
         self.raw = raw
@@ -104,6 +105,12 @@ class _Request:
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # latency decomposition: enqueue time anchors the per-request
+        # queue-wait stage; the coalesced dispatch stamps its shared
+        # stage timings (gate/decode/device/readback) here so the
+        # submitting thread can merge them into its own waterfall
+        self.t_enqueue = time.perf_counter()
+        self.stages = None
         # submitting request's trace id: the coalesced dispatch span
         # links every parent trace it served
         self.trace = _tracing.current()
@@ -208,7 +215,13 @@ class MicroBatcher:
             self._share_rejected(req.principal, share_held, share_cap)
         _qos.note_interactive_start()
         try:
-            return self._await_result(model, key, req, leader)
+            out = self._await_result(model, key, req, leader)
+            # fold the dispatch's stamped stage timings (queue/gate/
+            # device/readback) into THIS thread's request waterfall —
+            # followers inherit the breakdown the leader measured
+            if req.stages:
+                _usage.merge_stages(req.stages)
+            return out
         finally:
             _qos.note_interactive_end()
             with self._lock:
@@ -326,17 +339,22 @@ class MicroBatcher:
             # weighted-fair gate: groups are single-principal (the key
             # carries it), so the whole chunk charges one tenant; under
             # device-slot contention grants follow deficit round-robin
-            # over the configured weights
+            # over the configured weights. The queue-wait stage for every
+            # request ends HERE (batch formed, dispatch starting); the
+            # gate wait is its own stage.
+            t_gate = time.perf_counter()
             took = _qos.GATE.acquire(batch[0].principal or _qos.ANONYMOUS,
                                      total)
             t0 = time.perf_counter()
+            gate_s = t0 - t_gate
             try:
-                with ctx:
-                    raw = np.full((bucket, C), np.nan, np.float32)
-                    off = 0
-                    for r in batch:
-                        raw[off:off + r.n] = r.raw
-                        off += r.n
+                with ctx as sp, _usage.capture_stages() as shared:
+                    with _usage.stage("decode"):
+                        raw = np.full((bucket, C), np.nan, np.float32)
+                        off = 0
+                        for r in batch:
+                            raw[off:off + r.n] = r.raw
+                            off += r.n
                     # membership-aware dispatch: a scoring batch straddling
                     # a cloud-epoch bump (a worker excised mid-request)
                     # retries once with jittered backoff against the new
@@ -350,6 +368,14 @@ class MicroBatcher:
                                               links=links)
 
                     out = _mb.retry_once(_score, op="microbatch")
+                    # gate wait joins the captured decode/device/readback
+                    # splits; the breakdown rides the dispatch span too
+                    # (stamped before the span closes — the flight
+                    # recorder snapshots at end)
+                    shared["gate"] = shared.get("gate", 0.0) + gate_s
+                    if sp is not None:
+                        sp.attrs["stages"] = {k: round(v, 6)
+                                              for k, v in shared.items()}
             finally:
                 _qos.GATE.release(took)
             DISPATCHES.inc()
@@ -358,8 +384,15 @@ class MicroBatcher:
             ex = links[0] if links else _tracing.current()
             BATCH_ROWS.observe(total, exemplar=ex)
             BATCH_SECONDS.observe(time.perf_counter() - t0, exemplar=ex)
+            # stamp the waterfall onto every served request: queue wait
+            # is per-request (enqueue → dispatch start); the gate wait
+            # and captured decode/device/readback are chunk-shared —
+            # each coalesced caller experienced that same wall time
             off = 0
             for r in batch:
+                st = {"queue": max(0.0, t_gate - r.t_enqueue)}
+                st.update(shared)
+                r.stages = st
                 r.result = out[off:off + r.n]
                 off += r.n
         except Exception as ex:   # noqa: BLE001 — every waiter must wake

@@ -195,6 +195,15 @@ class ParamStore:
         with self._lock:
             return sum(p.nbytes for p in self._placements.values())
 
+    def by_model(self) -> dict:
+        """{model_key: placement bytes} across resident generations —
+        the /3/Usage HBM-attribution feed."""
+        with self._lock:
+            out: dict = {}
+            for (mk, _tok), p in self._placements.items():
+                out[mk] = out.get(mk, 0) + p.nbytes
+            return out
+
     def resident(self) -> int:
         with self._lock:
             return len(self._placements)

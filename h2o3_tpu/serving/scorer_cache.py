@@ -70,6 +70,7 @@ import numpy as np
 from h2o3_tpu.analysis.lockdep import make_lock, make_rlock
 from h2o3_tpu.obs import metrics as _om
 from h2o3_tpu.obs import tracing as _tracing
+from h2o3_tpu.obs import usage as _usage
 from h2o3_tpu.obs.timeline import span as _span
 from h2o3_tpu.parallel import compat as _compat
 from h2o3_tpu.parallel import mesh as _mesh
@@ -458,17 +459,24 @@ def score_rows(model, raw: np.ndarray, n: int, links=()) -> np.ndarray:
                     **attrs)
     else:
         ctx = contextlib.nullcontext()
-    with ctx:
-        out = fn(_mrt.device_put_rows(raw))
-    ROWS_SCORED.inc(n)
-    # device_get, not np.asarray: the result fetch is the one intended
-    # device→host transfer on this path — keep it explicit so the
-    # transfer-guard sanitizer admits it. A multi-controller result whose
-    # shards live on other processes' devices gathers first (the MRTask
-    # result-collection hop) — host_fetch owns that allgather.
-    if isinstance(out, jax.Array) and not out.is_fully_addressable:
-        return np.asarray(_mrt.host_fetch(out))
-    return np.asarray(jax.device_get(out))
+    # usage attribution: the scorer is the funnel layer that knows the
+    # MODEL and row count, so its meter owns the charge (kind `score`);
+    # the guarded jit's inner meter is suppressed. The device/readback
+    # stage splits feed the request waterfall (micro-batch capture or
+    # the caller's own recorder).
+    with ctx, _usage.meter("score", model=model.key, rows=n):
+        with _usage.stage("device"):
+            out = fn(_mrt.device_put_rows(raw))
+        ROWS_SCORED.inc(n)
+        # device_get, not np.asarray: the result fetch is the one intended
+        # device→host transfer on this path — keep it explicit so the
+        # transfer-guard sanitizer admits it. A multi-controller result
+        # whose shards live on other processes' devices gathers first (the
+        # MRTask result-collection hop) — host_fetch owns that allgather.
+        with _usage.stage("readback"):
+            if isinstance(out, jax.Array) and not out.is_fully_addressable:
+                return np.asarray(_mrt.host_fetch(out))
+            return np.asarray(jax.device_get(out))
 
 
 def _fast_scored(model, frame, with_response: bool):

@@ -397,7 +397,18 @@ def test_trace_view_includes_worker_logs(cloud_server, ice_root):
     _get(cloud_server, "/3/Frames", headers={"X-H2O3-Trace-Id": tid})
     with tracing.trace(tid):
         ulog.info("coordinator correlated")
-    out = _get(cloud_server, f"/3/Trace/{tid}")
+    # The rest.request root span lands in the ring only AFTER the
+    # response bytes are on the socket (the span covers the send), so a
+    # trace view fetched on a fresh connection can beat the coordinator's
+    # own span by microseconds — trace views are eventually consistent,
+    # exactly like production tracing backends. Re-poll briefly.
+    deadline = time.monotonic() + 5.0
+    while True:
+        out = _get(cloud_server, f"/3/Trace/{tid}")
+        if {s["host"] for s in out["spans"]} == {0, 1} \
+                or time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
     hosts_in_logs = {r["host"] for r in out["logs"]}
     assert hosts_in_logs == {0, 1}, out["logs"]
     assert any(r["msg"].startswith("replay POST") for r in out["logs"])

@@ -185,13 +185,19 @@ def test_race_harness_dkv_scoring_scrapes_under_lockdep(glm, lockdep_raise,
         return _loop
 
     def dkv_churn(i):
-        key = f"race_obj_{i % 3}"
+        # asserted key is thread-private: with TWO churn workers a shared
+        # key's remove can land between the other's put and its assert
+        key = f"race_obj_{threading.get_ident()}_{i % 3}"
         DKV.put(key, {"gen": i})                      # put / overwrite
         assert key in DKV
         DKV.atomic(key, lambda old: {"gen": i + 1} if old else None)
         DKV.get(key)
+        shared = f"race_obj_shared_{i % 3}"           # cross-worker lock
+        DKV.put(shared, {"gen": i})                   # contention, no
+        DKV.get(shared)                               # asserts
         if i % 3 == 2:
             DKV.remove(key)                           # delete
+            DKV.remove(shared)
         DKV.stats()
 
     def score_rows(i):

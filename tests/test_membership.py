@@ -127,9 +127,7 @@ class FakeWorker:
             self.expect += 1
             if self.muted:
                 continue
-            data = None
-            if msg.get("op") == "ping":
-                data = {"host": self.pid, "ok": True}
+            data = self._answer(msg) if "op" in msg else None
             try:
                 if "op" in msg:
                     MH._send_frame(self.sock, self.key,
@@ -139,6 +137,14 @@ class FakeWorker:
                                    {"ack": msg["seq"]})
             except OSError:
                 return
+
+    def _answer(self, msg):
+        """Collect-op payload hook — what a live worker's _collect_local
+        would return. Subclasses (test_usage's snapshot-carrying workers)
+        override to answer other ops."""
+        if msg.get("op") == "ping":
+            return {"host": self.pid, "ok": True}
+        return None
 
     def kill(self):
         try:
