@@ -52,8 +52,9 @@ _RETRY_CODES = (429, 503)
 
 def _parse_server_timing(value: str) -> dict:
     """Server-Timing header → {stage: seconds}. The wire format is
-    comma-separated ``name;dur=<milliseconds>`` entries (RFC 8673 shape);
-    entries without a parseable dur are skipped, never fatal."""
+    comma-separated ``name;dur=<milliseconds>`` entries (the W3C
+    Server-Timing specification); entries without a parseable dur are
+    skipped, never fatal."""
     out = {}
     for part in value.split(","):
         fields = part.strip().split(";")

@@ -85,6 +85,7 @@ _RATE: deque = deque(maxlen=4096)   # (monotonic, cumulative) rate samples
 _KNOWN_MODELS: set = set()
 _OVERRIDE: list = [None]          # set_enabled() override (None = env)
 _TIER_PREV = [None]               # (monotonic, faults) for the fault rate
+_TIER_RATE = [0.0]                # last fault rate over a full interval
 _LAST_PRESSURE: dict = {}         # last evaluate_pressure() doc (gauge feed)
 
 # burn rate at which the fast-burn multi-window alert pages (obs/slo.py
@@ -92,6 +93,9 @@ _LAST_PRESSURE: dict = {}         # last evaluate_pressure() doc (gauge feed)
 _SLO_PAGE_BURN = 14.4
 # tier faults/second treated as saturation on the tier_faults dimension
 _TIER_FAULT_SATURATION = 100.0
+# floor on the fault-rate interval: concurrent evaluations (a client GET
+# racing a cluster collect) must not amplify a few faults over near-zero dt
+_TIER_MIN_INTERVAL_S = 0.25
 
 
 def _env_enabled() -> bool:
@@ -167,9 +171,11 @@ def charge(kind: str, seconds: float, model=None, rows: int = 0,
         ent[2] += int(rows)
         _TOTAL[0] += s
         # rate samples keep a minimum spacing so a hot dispatch loop
-        # updates the newest sample in place instead of churning the ring
+        # updates the newest sample in place instead of churning the ring;
+        # the retained timestamp must NOT advance, or sustained load pins
+        # the ring to one ever-fresh sample and device_rate reads 0
         if _RATE and now - _RATE[-1][0] < 0.05:
-            _RATE[-1] = (now, _TOTAL[0])
+            _RATE[-1] = (_RATE[-1][0], _TOTAL[0])
         else:
             _RATE.append((now, _TOTAL[0]))
 
@@ -492,11 +498,16 @@ def evaluate_pressure(window_s=None) -> dict:
             round(hbm_bytes / hbm_budget, 4) if hbm_budget else 0.0
         now_m = time.monotonic()
         faults = float(stats.get("faults") or 0)
-        prev = _TIER_PREV[0]
-        _TIER_PREV[0] = (now_m, faults)
-        fault_rate = 0.0
-        if prev is not None and now_m > prev[0]:
-            fault_rate = max(0.0, (faults - prev[1]) / (now_m - prev[0]))
+        with _LOCK:
+            prev = _TIER_PREV[0]
+            if prev is None:
+                _TIER_PREV[0] = (now_m, faults)
+            elif now_m - prev[0] >= _TIER_MIN_INTERVAL_S:
+                _TIER_RATE[0] = max(0.0, (faults - prev[1])
+                                    / (now_m - prev[0]))
+                _TIER_PREV[0] = (now_m, faults)
+            # a sub-floor re-evaluation reuses the last full-interval rate
+            fault_rate = _TIER_RATE[0]
         dims["tier_faults"] = round(fault_rate / _TIER_FAULT_SATURATION, 4)
         detail["tier"] = {"stats": stats,
                           "fault_rate": round(fault_rate, 4)}
@@ -558,6 +569,7 @@ def reset():
         _RATE.clear()
         _KNOWN_MODELS.clear()
     _TIER_PREV[0] = None
+    _TIER_RATE[0] = 0.0
     _LAST_PRESSURE = {}
     _TLS.stages = None
     _TLS.capture = None

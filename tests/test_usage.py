@@ -86,6 +86,19 @@ def test_meter_charges_principal_model_kind():
     assert principals == {"alice", "anonymous"}
 
 
+def test_device_rate_nonzero_under_sustained_charging():
+    """Regression: charges arriving <50ms apart coalesce into the newest
+    rate sample in place; the retained sample's timestamp must not
+    advance, or the ring degenerates to one ever-fresh sample and
+    device_rate reads 0 exactly when the host is busiest."""
+    t_end = time.monotonic() + 0.2
+    while time.monotonic() < t_end:
+        usage.charge("score", 0.001)
+        time.sleep(0.002)
+    assert usage.device_seconds_total() > 0.0
+    assert usage.device_rate(window_s=1.0) > 0.0
+
+
 def test_ledger_disabled_is_free():
     usage.set_enabled(False)
     with usage.meter("score", model="m", rows=1):
