@@ -592,6 +592,87 @@ def qos_overload_bench(duration_s: float = 3.0) -> dict:
             DKV.remove(k)
 
 
+def fleet_serving_bench(n_models: int | None = None) -> dict:
+    """Fleet-scale serving sample (ISSUE 17): BENCH_FLEET_MODELS (default
+    1024) registered stub models — 8 KB of f32 params each — against a
+    deliberately single-chip-sized 1 MB HBM budget, through a PRIVATE
+    ParamStore so the process's real serving placements are untouched.
+    Reports resident models, warm p99 (hot set, HBM-resident dispatch
+    lookup), cold-fault p99 (a demoted model promoted back through
+    reserved admission), and the peak params-byte gauge against the
+    budget — the '1000+ models on one chip' acceptance numbers. A
+    failure yields a structured blocked record."""
+    try:
+        from h2o3_tpu.serving import params as _sp
+
+        n = int(n_models or os.environ.get("BENCH_FLEET_MODELS", 1024))
+        budget_mb = 1
+        old = os.environ.get("H2O3_SERVE_HBM_BUDGET_MB")
+        os.environ["H2O3_SERVE_HBM_BUDGET_MB"] = str(budget_mb)
+        store = _sp.ParamStore()
+        rng = np.random.default_rng(17)
+
+        class _Stub:
+            _partition_rules = ()
+
+            def __init__(self, key, arr):
+                self.key, self._arr = key, arr
+
+            def _serving_params(self):
+                return {"w": self._arr}
+
+        try:
+            models = [_Stub(f"bench/fleet{i}",
+                            rng.normal(size=2048).astype(np.float32))
+                      for i in range(n)]
+            t0 = time.perf_counter()
+            for m in models:
+                store.acquire(m, 0)
+            register_s = time.perf_counter() - t0
+            hot = models[:16]              # warm path: HBM-resident
+            for m in hot:
+                store.placed(m, 0)
+            warm = []
+            for _ in range(30):
+                for m in hot:
+                    t0 = time.perf_counter()
+                    store.placed(m, 0)
+                    warm.append(time.perf_counter() - t0)
+            cold = []                      # cold path: demote → promote
+            for m in models[16:80]:
+                store.demote_key(m.key, to_tier=_sp.TIER_HOST)
+                t0 = time.perf_counter()
+                store.placed(m, 0)
+                cold.append(time.perf_counter() - t0)
+            warm.sort()
+            cold.sort()
+            stats = store.stats()
+            budget = budget_mb << 20
+            peak = store.peak_hbm_bytes()
+            return {
+                "resident_models": store.resident(),
+                "hbm_budget_bytes": budget,
+                "params_hbm_peak_bytes": peak,
+                "budget_respected": peak <= budget,
+                "warm_p99_ms": round(
+                    warm[int(0.99 * (len(warm) - 1))] * 1e3, 3),
+                "cold_fault_p99_ms": round(
+                    cold[int(0.99 * (len(cold) - 1))] * 1e3, 3),
+                "register_models_per_sec": round(n / register_s, 1),
+                "faults": stats["faults"],
+                "evictions": sum(stats["evictions_by_tenant"].values()),
+            }
+        finally:
+            store.clear()
+            if old is None:
+                os.environ.pop("H2O3_SERVE_HBM_BUDGET_MB", None)
+            else:
+                os.environ["H2O3_SERVE_HBM_BUDGET_MB"] = old
+    except Exception:
+        return {"blocked": True, "blocked_stage": "fleet-serving-run",
+                "blocked_detail": _short_cause(traceback.format_exc())}
+
+
 def multihost_scoring_bench(timeout_s: int = 240) -> dict:
     """2-process-cloud scaling sample (ISSUE 11): form the real
     jax.distributed CPU cloud (tests/multiproc_runner.py), train a GBM
@@ -722,6 +803,9 @@ def main():
     # --gbm-only (ISSUE 14 CI fast mode): train + AUC-gate the headline
     # GBM stage only, skipping the ingest / scoring / multihost stages
     gbm_only = "--gbm-only" in sys.argv
+    # --serving-only (ISSUE 17 CI fast mode): the fleet-serving sample
+    # alone — no data gen, no training — seconds instead of minutes
+    serving_only = "--serving-only" in sys.argv
     rec = probe_backend()
     if rec is not None:
         print(json.dumps(rec))
@@ -749,6 +833,28 @@ def main():
     from h2o3_tpu.obs import tracing as _tracing
     bench_trace = _tracing.new_trace_id()
     _tracing.set_current(bench_trace)
+
+    if serving_only:
+        fleet_serving = fleet_serving_bench()
+        if fleet_serving.get("blocked"):
+            print("fleet serving sample blocked: "
+                  f"{fleet_serving['blocked_stage']}", file=sys.stderr)
+        else:
+            print(f"fleet serving: {fleet_serving['resident_models']} "
+                  f"models on {fleet_serving['hbm_budget_bytes'] >> 20}MB "
+                  f"HBM, warm p99 {fleet_serving['warm_p99_ms']}ms, "
+                  f"cold-fault p99 {fleet_serving['cold_fault_p99_ms']}ms",
+                  file=sys.stderr)
+        print(json.dumps({
+            "metric": "fleet_serving_resident_models",
+            "value": fleet_serving.get("resident_models"),
+            "unit": "models",
+            "serving_only": True,
+            "backend": jax.default_backend(),
+            "trace_id": bench_trace,
+            "fleet_serving": fleet_serving,
+        }))
+        return
 
     from h2o3_tpu.models.tree import binned as BN
 
@@ -1022,6 +1128,24 @@ def main():
         except Exception:
             traceback.print_exc()
 
+    fleet_serving = None
+    if not gbm_only:
+        try:
+            fleet_serving = fleet_serving_bench()
+            if fleet_serving.get("blocked"):
+                print("fleet serving sample blocked: "
+                      f"{fleet_serving['blocked_stage']}", file=sys.stderr)
+            else:
+                print(f"fleet serving: {fleet_serving['resident_models']} "
+                      f"models on "
+                      f"{fleet_serving['hbm_budget_bytes'] >> 20}MB HBM, "
+                      f"warm p99 {fleet_serving['warm_p99_ms']}ms, "
+                      f"cold-fault p99 "
+                      f"{fleet_serving['cold_fault_p99_ms']}ms",
+                      file=sys.stderr)
+        except Exception:
+            traceback.print_exc()
+
     multihost_scoring = None
     if not gbm_only:
         try:
@@ -1094,6 +1218,7 @@ def main():
         "distributed_ingest": distributed_ingest,
         "scoring": scoring,
         "qos_overload": qos_overload,
+        "fleet_serving": fleet_serving,
         "multihost_scoring": multihost_scoring,
     }))
 

@@ -402,10 +402,23 @@ class StrVec(Vec):
     The legacy n-sized host object array materializes ONLY if a consumer
     explicitly asks (`to_numpy`/`host_data`)."""
 
-    def __init__(self, codes_dev, levels, nrows: int):
-        self.codes = codes_dev                 # (padded,) i32, -1 = NA
+    def __init__(self, codes_dev, levels, nrows: int, host_codes=None):
+        # the (padded,) i32 code plane (-1 = NA) lives behind its own
+        # TierChunk, so string-heavy frames demote exactly like numeric
+        # planes: HBM → host i32 bytes → disk spill file. `codes_dev`
+        # may be None for a chunk born cold with `host_codes` (budgeted
+        # ingest); passing BOTH gives the pager a free demote (the host
+        # mirror is already canonical).
+        host = (host_codes, None) if host_codes is not None else None
+        self._codes_chunk = _tiering.PAGER.new_chunk(
+            codes_dev, None, host=host, label="strcodes")
         self._levels = np.asarray(levels, dtype=object)
         super().__init__(None, Codec("const"), None, nrows, T_STR)
+
+    @property
+    def codes(self):
+        """(padded,) i32 device codes — faults the plane to HBM."""
+        return self._codes_chunk.device()[0]
 
     @staticmethod
     def encode(col: np.ndarray) -> "StrVec":
@@ -422,12 +435,16 @@ class StrVec(Vec):
         pad = c.padded_rows(n)
         cp = np.full(pad, -1, np.int32)
         cp[:n] = codes
-        return StrVec(_mr.device_put_rows(cp), levels, n)
+        if _tiering.PAGER.ingest_cold:
+            # budgeted/cold ingest: park the codes in the host tier and
+            # fault on first access (same contract as Vec._from_floats)
+            return StrVec(None, levels, n, host_codes=cp)
+        return StrVec(_mr.device_put_rows(cp), levels, n, host_codes=cp)
 
     # ---- Vec surface -----------------------------------------------------
     @property
     def padded_len(self) -> int:
-        return int(self.codes.shape[0])
+        return int(self._codes_chunk.rows)   # shape read must not fault
 
     @property
     def levels_arr(self) -> np.ndarray:
@@ -873,11 +890,16 @@ class Frame:
         return out
 
     def _tier_on_get(self):
-        """DKV.get hook: LRU-touch this frame's chunks; a whole-frame
-        spill (every chunk on disk) promotes its codec bytes back to host
-        RAM, HBM faults stay lazy (raw_get never calls this)."""
-        _tiering.PAGER.on_frame_get(
-            [v._chunk for v in self.vecs])
+        """DKV.get hook: LRU-touch this frame's chunks — numeric planes
+        AND StrVec dictionary code planes; a whole-frame spill (every
+        chunk on disk) promotes its codec bytes back to host RAM, HBM
+        faults stay lazy (raw_get never calls this). UuidVec word planes
+        and SparseVec triplets stay untiered (documented out: their
+        layouts bypass the packed-plane codecs the pager ships)."""
+        chunks = [v._chunk for v in self.vecs]
+        chunks += [v._codes_chunk for v in self.vecs
+                   if getattr(v, "_codes_chunk", None) is not None]
+        _tiering.PAGER.on_frame_get(chunks)
 
     def _on_remove(self):
         # Vecs may be shared with other frames (column slices, adapted test

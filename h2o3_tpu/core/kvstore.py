@@ -120,6 +120,7 @@ class _DKV:
     # ---- basic ops (DKV.put/get/remove) ---------------------------------
     def put(self, key: str, value: Any) -> str:
         with self._mutex:
+            old = self._store.get(key)
             self._store[key] = value
             # preserve an existing home: overwriting a key mid-migration
             # must not flip home_of to the new ring assignment before the
@@ -127,6 +128,13 @@ class _DKV:
             # take the ring's current answer
             if key not in self._homes:
                 self._homes[key] = self._ring.node_for(key)
+        # a retrain overwriting a model key frees the OLD generation's
+        # serving residency on every tier exactly once; outside the
+        # mutex like _on_remove, so cache/pager locks never nest under
+        # `dkv`
+        if old is not None and old is not value \
+                and hasattr(old, "_on_replace"):
+            old._on_replace()
         return key
 
     def get(self, key: str, default=None):
@@ -364,6 +372,9 @@ class _DKV:
             ch = getattr(vec, "_chunk", None)
             if ch is not None:
                 out.append(ch)
+            codes = getattr(vec, "_codes_chunk", None)
+            if codes is not None:   # StrVec dictionary code plane
+                out.append(codes)
         return out
 
     def rehome_status(self) -> dict:

@@ -22,8 +22,13 @@ from h2o3_tpu.io import spill as _spill
 
 
 def _frame_chunks(frame):
-    return [c for c in (getattr(v, "_chunk", None) for v in frame.vecs)
-            if c is not None]
+    out = []
+    for v in frame.vecs:
+        for attr in ("_chunk", "_codes_chunk"):   # StrVec code planes tier too
+            c = getattr(v, attr, None)
+            if c is not None:
+                out.append(c)
+    return out
 
 
 class MemoryManager:

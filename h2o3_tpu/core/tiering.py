@@ -322,8 +322,11 @@ class ChunkPager:
 
     def stats(self) -> dict:
         tb = self.tier_bytes()
+        with self._lock:
+            reserved = self._reserved
         return {"tier_bytes": tb, "hbm_budget": self.hbm_budget,
                 "host_budget": self.host_budget,
+                "reserved": reserved,
                 "peak_hbm_bytes": self._peak_hbm,
                 "faults": self._fault_count,
                 "prefetch_requests": self._prefetch_requests,

@@ -78,3 +78,37 @@ def delete_chunk(path: str):
         os.unlink(path)
     except OSError:
         pass
+
+
+# -- model-param artifacts (the disk rung of the serving param ladder) ----
+
+def params_dir() -> str:
+    return os.path.join(_ICE_ROOT, "params", _PROC_TAG)
+
+
+def write_params(key: str, leaves) -> str:
+    """Persist a param pytree's leaves (canonical host arrays, in
+    tree-flatten order) as one npz artifact; returns the spill path.
+    Same atomic tmp+rename discipline as chunk spill files."""
+    d = params_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{_SAFE.sub('_', key)}.npz")
+    arrays = {f"l{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def read_params(path: str) -> list:
+    """The leaves back, in the order write_params received them."""
+    with np.load(path, allow_pickle=False) as npz:
+        return [npz[f"l{i}"] for i in range(len(npz.files))]
+
+
+def delete_params(path: str):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass

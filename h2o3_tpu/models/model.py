@@ -519,6 +519,26 @@ class ModelBase:
         p = {a: v for a, v in p.items() if v is not None}
         return p or None
 
+    # ---- DKV lifecycle hooks ---------------------------------------------
+    def _on_remove(self):
+        """DKV.remove(model key): drop the model's serving residency —
+        compiled programs, shared param placements on EVERY tier (HBM,
+        host mirror, ice_root npz) — exactly once. Runs outside the
+        `dkv` mutex (kvstore contract), so cache/param locks never nest
+        under it. Idempotent: the REST DELETE handler calls
+        CACHE.invalidate_key in the same breath."""
+        if not self.key:
+            return
+        try:
+            from h2o3_tpu import serving
+            serving.CACHE.invalidate_key(self.key)
+        except Exception:   # noqa: BLE001 — removal must not fail the DKV op
+            pass
+
+    # a retrain overwriting this key is the same lifecycle event: the
+    # old generation's tiers are freed once (kvstore put's replace hook)
+    _on_replace = _on_remove
+
     def _score_with_params(self, params, X):
         """_score_matrix with `params` (a `_serving_params()`-shaped
         pytree, possibly of tracers) standing in for the exported

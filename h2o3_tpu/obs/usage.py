@@ -360,6 +360,8 @@ def usage_snapshot() -> dict:
         from h2o3_tpu.serving.params import PARAMS
         hbm["params_by_model"] = PARAMS.by_model()
         hbm["params_total_bytes"] = PARAMS.total_bytes()
+        hbm["params_tier_bytes"] = PARAMS.tier_bytes()
+        hbm["params_serving"] = PARAMS.stats()
     except Exception:   # noqa: BLE001 — a probe error must not kill the snapshot
         pass
     try:
@@ -379,6 +381,7 @@ def merge_usage(snaps) -> dict:
     total = 0.0
     params_by_model: dict = {}
     params_total = 0
+    params_tier: dict = {}
     for s in snaps:
         if not isinstance(s, dict):
             continue
@@ -394,6 +397,8 @@ def merge_usage(snaps) -> dict:
         for m, b in (hb.get("params_by_model") or {}).items():
             params_by_model[m] = params_by_model.get(m, 0) + int(b)
         params_total += int(hb.get("params_total_bytes") or 0)
+        for t, b in (hb.get("params_tier_bytes") or {}).items():
+            params_tier[t] = params_tier.get(t, 0) + int(b)
         if hb.get("tier") is not None:
             tier_by_host[str(s.get("host"))] = hb["tier"]
     ledger = [{"principal": p, "model": m, "kind": k,
@@ -405,6 +410,7 @@ def merge_usage(snaps) -> dict:
             "ledger": ledger,
             "hbm": {"params_by_model": params_by_model,
                     "params_total_bytes": params_total,
+                    "params_tier_bytes": params_tier,
                     "tier_by_host": tier_by_host}}
 
 

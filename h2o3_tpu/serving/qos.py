@@ -455,6 +455,35 @@ def note_share_reject(principal: str):
     REJECTS.inc(principal=principal, reason="share")
 
 
+def eviction_standing(principal: str) -> float:
+    """A [0, 1] standing score for cross-tenant param eviction
+    (serving/params.py victim ordering): token-bucket headroom × queue
+    -share headroom. Lower = heavier consumer right now = that tenant's
+    cold placements are demoted first when ANOTHER tenant faults and
+    no same-tenant victim exists. A tenant with no QoS state (idle, or
+    rate 0 = unlimited with an empty queue) scores 1.0 — last to lose
+    its models to someone else's churn."""
+    tok = 1.0
+    rate = _rate_for(principal)
+    if rate > 0:
+        now = time.monotonic()
+        with _BUCKET_LOCK:
+            b = _buckets.get(principal)
+            if b is not None and b.burst > 0:
+                tok = min(b.burst,
+                          b.tokens + (now - b.stamp) * b.rate) / b.burst
+    share = 1.0
+    try:
+        from h2o3_tpu.serving import microbatch as _mb
+        cap = tenant_share_cap(_mb._queue_depth_limit())
+        if cap > 0:
+            held = _mb.BATCHER.queued_by_principal().get(principal, 0)
+            share = max(0.0, 1.0 - held / cap)
+    except Exception:   # noqa: BLE001 — standing is advisory ordering only
+        pass
+    return max(0.0, min(1.0, tok * share))
+
+
 # ---------------------------------------------------------------------------
 # weighted-fair dispatch gate (deficit round-robin over principals)
 class _Ticket:

@@ -46,6 +46,14 @@ Design (hex/Model.java:1764 BigScore, re-keyed for XLA):
     an unguarded concurrent launch re-opens the ISSUE-10 XLA:CPU
     rendezvous hang (analyzer rule R014 rejects raw jit/pjit here).
 
+  * With `H2O3_SERVE_HBM_BUDGET_MB` set, a cache entry's param
+    REFERENCE no longer implies device RESIDENCY: placements ride the
+    serving three-tier ladder (HBM ⇄ host ⇄ ice_root npz, see
+    serving/params.py) and each dispatch's `PARAMS.placed()` faults a
+    demoted model back in through reserved admission — the compiled
+    program is byte-cheap and stays cached while its params page, so a
+    cold model costs one device_put, never a recompile.
+
 Env knobs:
   H2O3_SCORER_CACHE_SIZE      max resident programs (LRU; default 64)
   H2O3_SCORE_MIN_BUCKET       smallest row bucket (default 128)
@@ -55,6 +63,11 @@ Env knobs:
   H2O3_SCORER_PREWARM         1 → compile the smallest bucket (and place
                               params) on model publish AND on replacement
                               -worker join, so first requests warm-hit
+  H2O3_SERVE_HBM_BUDGET_MB    byte budget for device-resident model
+                              params (serving/params.py; 0 = eager,
+                              unbudgeted placement)
+  H2O3_SERVE_HOST_BUDGET_MB   byte budget for the host tier of demoted
+                              params; overflow spills to ice_root
 """
 
 from __future__ import annotations

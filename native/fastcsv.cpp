@@ -31,6 +31,8 @@
 #include <cstdlib>
 #include <cstring>
 #include <cmath>
+#include <mutex>
+#include <new>
 #include <string>
 #include <vector>
 
@@ -40,18 +42,134 @@
 
 namespace {
 
+// ---- thread-local slab arena for column plane growth ---------------------
+// The reserve(est) heuristic in finish_row kills the log2(n) growth
+// reallocations, but each chunk parse still pays ONE giant malloc per
+// column plane — and on Linux a fresh multi-MB malloc is mmap-backed, so
+// the first write to every 4 KB page takes a soft page fault. Across a
+// parse pool thread's lifetime that is the same pages faulted in again
+// for every chunk. This arena keeps freed blocks on a per-thread
+// freelist (power-of-two size classes, 4 KB … 32 MB), so chunk N+1's
+// planes land in chunk N's already-faulted memory: the steady-state cost
+// of a column plane drops from mmap + N page faults to a freelist pop.
+//
+// Cross-thread safety: a ParseResult is routinely freed on a DIFFERENT
+// thread than the one that parsed it (Python GC / pool handoff), so each
+// block carries its owning arena in a 16-byte header and frees push back
+// to the OWNER's mutex-protected freelist. Arenas are heap-allocated and
+// intentionally never destroyed: a block freed after its parse thread
+// exited must still find a live owner (the leak is bounded by the thread
+// count, and pool threads are reused).
+constexpr int kArenaClasses = 14;                 // 4 KB << 0 … 32 MB
+constexpr size_t kArenaMinBytes = 4096;
+constexpr size_t kArenaMaxBytes = kArenaMinBytes << (kArenaClasses - 1);
+constexpr size_t kArenaHoldCap = 256u << 20;      // freelist cap per thread
+
+struct Arena {
+    std::mutex mu;
+    std::vector<void*> free_lists[kArenaClasses];
+    size_t held = 0;                              // bytes parked in lists
+};
+
+struct ArenaHeader {                              // 16 bytes: user data
+    Arena* owner;                                 // stays 16-aligned
+    size_t bytes;                                 // block size incl. header
+};
+
+Arena* my_arena() {
+    static thread_local Arena* a = new Arena();
+    return a;
+}
+
+int arena_class_for(size_t want) {
+    size_t sz = kArenaMinBytes;
+    int cls = 0;
+    while (sz < want) { sz <<= 1; ++cls; }
+    return cls;
+}
+
+void* arena_alloc(size_t n) {
+    size_t want = n + sizeof(ArenaHeader);
+    if (want > kArenaMaxBytes) {                  // outsize: plain malloc
+        void* raw = malloc(want);
+        if (!raw) throw std::bad_alloc();
+        auto* h = static_cast<ArenaHeader*>(raw);
+        h->owner = nullptr;
+        h->bytes = want;
+        return h + 1;
+    }
+    int cls = arena_class_for(want);
+    size_t block = kArenaMinBytes << cls;
+    Arena* a = my_arena();
+    void* raw = nullptr;
+    {
+        std::lock_guard<std::mutex> g(a->mu);
+        auto& fl = a->free_lists[cls];
+        if (!fl.empty()) {
+            raw = fl.back();
+            fl.pop_back();
+            a->held -= block;
+        }
+    }
+    if (!raw) {
+        raw = malloc(block);
+        if (!raw) throw std::bad_alloc();
+    }
+    auto* h = static_cast<ArenaHeader*>(raw);
+    h->owner = a;
+    h->bytes = block;
+    return h + 1;
+}
+
+void arena_free(void* p) {
+    if (!p) return;
+    auto* h = static_cast<ArenaHeader*>(p) - 1;
+    Arena* a = h->owner;
+    if (!a) { free(h); return; }
+    size_t block = h->bytes;
+    int cls = arena_class_for(block);
+    {
+        std::lock_guard<std::mutex> g(a->mu);
+        if (a->held + block <= kArenaHoldCap) {
+            a->free_lists[cls].push_back(h);
+            a->held += block;
+            return;
+        }
+    }
+    free(h);
+}
+
+template <class T>
+struct ArenaAlloc {
+    using value_type = T;
+    ArenaAlloc() = default;
+    template <class U> ArenaAlloc(const ArenaAlloc<U>&) {}
+    T* allocate(size_t n) {
+        return static_cast<T*>(arena_alloc(n * sizeof(T)));
+    }
+    void deallocate(T* p, size_t) { arena_free(p); }
+    template <class U> bool operator==(const ArenaAlloc<U>&) const {
+        return true;
+    }
+    template <class U> bool operator!=(const ArenaAlloc<U>&) const {
+        return false;
+    }
+};
+
 struct StrCell {
     int64_t row;
     std::string val;
 };
 
 struct Column {
-    std::vector<double> num;       // numeric value or NaN
+    // the hot, plane-sized vectors grow through the arena; data() still
+    // hands contiguous T* across the C ABI, valid until fastcsv_free
+    std::vector<double, ArenaAlloc<double>> num;   // numeric value or NaN
     std::vector<StrCell> strs;     // cells that failed numeric parse
     int64_t na_count = 0;
     // bulk string-table export, built lazily on first request
-    std::vector<int64_t> bulk_rows;
-    std::vector<int32_t> bulk_lens;
+    std::vector<int64_t, ArenaAlloc<int64_t>> bulk_rows;
+    std::vector<int32_t, ArenaAlloc<int32_t>> bulk_lens;
     std::string bulk_bytes;
     bool bulk_built = false;
 };

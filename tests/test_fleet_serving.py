@@ -1,0 +1,459 @@
+"""Fleet-scale model serving (ISSUE 17): model params under the tier
+pager, byte-budgeted HBM admission, and QoS-driven eviction.
+
+Covers the tentpole contract end to end: 1000+ registered models score
+bit-exactly on a single-chip-sized HBM budget with the byte gauge NEVER
+exceeding the budget at any sample (in-flight reservations included);
+every param-exporting family survives a full demote→promote round trip
+(HBM → host → ice_root npz → HBM) bit-exactly; a model-churn race
+harness (register/score/demote/retrain/release from concurrent tenants
+under lockdep raise mode) finds zero lock inversions and never
+overshoots the budget mid-flight; and one tenant's model churn cannot
+evict another tenant's hot set — evictions are charged to the tenant
+whose faults forced them (the ISSUE-15 flood-victim pattern, extended
+from queue admission to HBM residency)."""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from h2o3_tpu.analysis import lockdep
+from h2o3_tpu.io import spill
+from h2o3_tpu.models.coxph import H2OCoxProportionalHazardsEstimator
+from h2o3_tpu.models.deeplearning import H2ODeepLearningEstimator
+from h2o3_tpu.models.extended_isofor import (
+    H2OExtendedIsolationForestEstimator)
+from h2o3_tpu.models.glm import H2OGeneralizedLinearEstimator, _GLMState
+from h2o3_tpu.models.kmeans import H2OKMeansEstimator
+from h2o3_tpu.models.naive_bayes import H2ONaiveBayesEstimator
+from h2o3_tpu.models.pca import H2OPrincipalComponentAnalysisEstimator
+from h2o3_tpu.models.psvm import H2OSupportVectorMachineEstimator
+from h2o3_tpu.models.svd import H2OSingularValueDecompositionEstimator
+from h2o3_tpu.models.tree.shared_tree import H2OGradientBoostingEstimator
+from h2o3_tpu.obs import tracing
+from h2o3_tpu.serving import params as sp
+from h2o3_tpu.serving import qos
+
+RNG = np.random.default_rng(17)
+
+MB = 1 << 20
+
+
+class _StubModel:
+    """The minimal param-exporting surface the store needs: a DKV key,
+    a param pytree, partition rules. Everything else about a model is
+    irrelevant to residency."""
+    _partition_rules = ()
+
+    def __init__(self, key, arr):
+        self.key = key
+        self._arr = arr
+
+    def _serving_params(self):
+        return {"w": self._arr}
+
+
+def _stub(key, kb=8):
+    # kb KB of f32 — canonicalization-stable, so round trips compare
+    # with plain array_equal
+    arr = RNG.normal(size=(kb * 256,)).astype(np.float32)
+    return _StubModel(key, arr)
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    """A private ParamStore over a tmp ice root — hermetic residency
+    state; the global PARAMS singleton (other suites' placements) is
+    untouched."""
+    old_ice = spill.get_ice_root()
+    spill.set_ice_root(str(tmp_path))
+    store = sp.ParamStore()
+    yield store
+    store.clear()
+    spill.set_ice_root(old_ice)
+
+
+def _placement(store, key, token=0):
+    with store._lock:
+        return store._placements.get((key, token))
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(jax.device_get(tree))]
+
+
+# ---------------------------------------------------------------------------
+# 1. the headline: 1000+ models, single-chip-sized budget, gauge capped
+def test_thousand_models_on_capped_budget(fleet, monkeypatch):
+    monkeypatch.setenv("H2O3_SERVE_HBM_BUDGET_MB", "1")
+    budget = 1 * MB
+    n_models = 1056                       # 8.25 MB of params vs 1 MB HBM
+    models = [_stub(f"fleet/m{i}") for i in range(n_models)]
+
+    stop = threading.Event()
+    samples: list = []
+
+    def sampler():
+        while not stop.is_set():
+            # resident + in-flight reservations, read atomically:
+            # the admission invariant
+            samples.append(fleet.admitted_bytes())
+            time.sleep(0.0002)
+
+    errs: list = []
+
+    def worker(chunk):
+        try:
+            for m in chunk:
+                fleet.acquire(m, 0)
+                out = fleet.placed(m, 0)
+                got = np.asarray(jax.device_get(out["w"]))
+                assert np.array_equal(got, m._arr), m.key
+        except Exception as e:            # noqa: BLE001 — surface in main thread
+            errs.append(e)
+
+    st = threading.Thread(target=sampler, daemon=True)
+    st.start()
+    workers = [threading.Thread(target=worker, args=(models[i::8],))
+               for i in range(8)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    stop.set()
+    st.join()
+
+    assert not errs, errs[:3]
+    assert len(samples) > 50
+    assert max(samples) <= budget, \
+        f"budget exceeded mid-flight: {max(samples)} > {budget}"
+    assert fleet.peak_hbm_bytes() <= budget
+    assert fleet.resident() == n_models   # every model stays REGISTERED
+    stats = fleet.stats()
+    assert stats["faults"] >= n_models
+    assert sum(stats["evictions_by_tenant"].values()) > 0
+
+    # cold models re-fault bit-exactly after living on the lower tiers
+    for m in models[::97]:
+        out = fleet.placed(m, 0)
+        assert np.array_equal(np.asarray(jax.device_get(out["w"])), m._arr)
+    assert fleet.admitted_bytes() <= budget
+
+
+# ---------------------------------------------------------------------------
+# 2. demote→promote bit-exactness for EVERY param-exporting family
+def _nb():
+    m = object.__new__(H2ONaiveBayesEstimator)
+    m.key = "fleet/rt-naivebayes"
+    m._priors = np.ones(2)
+    m._score_tab = {
+        "prior": RNG.normal(size=(2,)).astype(np.float32),
+        "num_mu": RNG.normal(size=(2, 3)).astype(np.float32),
+        "num_sd": np.abs(RNG.normal(size=(2, 3))).astype(np.float32),
+    }
+    return m
+
+
+def _glm():
+    m = object.__new__(H2OGeneralizedLinearEstimator)
+    m.key = "fleet/rt-glm"
+    m._state = _GLMState(
+        beta=RNG.normal(size=(5,)).astype(np.float32),
+        link="identity", family="gaussian")
+    m._ord_beta = None
+    m._ord_thr = None
+    return m
+
+
+def _gbm():
+    m = object.__new__(H2OGradientBoostingEstimator)
+    m.key = "fleet/rt-gbm"
+    m._trees = RNG.normal(size=(4, 7, 8)).astype(np.float32)
+    m._trees_k = None
+    return m
+
+
+def _eif():
+    m = object.__new__(H2OExtendedIsolationForestEstimator)
+    m.key = "fleet/rt-eif"
+    m._norms = RNG.normal(size=(3, 15, 4)).astype(np.float32)
+    m._points = RNG.normal(size=(3, 15, 4)).astype(np.float32)
+    m._dids = RNG.integers(0, 15, size=(3, 15, 2)).astype(np.int32)
+    m._vals = RNG.normal(size=(3, 15)).astype(np.float32)
+    return m
+
+
+def _kmeans():
+    m = object.__new__(H2OKMeansEstimator)
+    m.key = "fleet/rt-kmeans"
+    m._centroids = RNG.normal(size=(3, 4)).astype(np.float32)
+    return m
+
+
+def _pca():
+    m = object.__new__(H2OPrincipalComponentAnalysisEstimator)
+    m.key = "fleet/rt-pca"
+    m._rotation = RNG.normal(size=(4, 2)).astype(np.float32)
+    m._mean = RNG.normal(size=(4,)).astype(np.float32)
+    m._sd = np.abs(RNG.normal(size=(4,))).astype(np.float32)
+    return m
+
+
+def _svd():
+    m = object.__new__(H2OSingularValueDecompositionEstimator)
+    m.key = "fleet/rt-svd"
+    m._v = RNG.normal(size=(4, 3)).astype(np.float32)
+    m._mean = RNG.normal(size=(4,)).astype(np.float32)
+    m._sd = np.abs(RNG.normal(size=(4,))).astype(np.float32)
+    return m
+
+
+def _coxph():
+    m = object.__new__(H2OCoxProportionalHazardsEstimator)
+    m.key = "fleet/rt-coxph"
+    m._beta = RNG.normal(size=(6,)).astype(np.float32)
+    return m
+
+
+def _dl():
+    m = object.__new__(H2ODeepLearningEstimator)
+    m.key = "fleet/rt-deeplearning"
+    m._params_net = [
+        (RNG.normal(size=(4, 8)).astype(np.float32),
+         RNG.normal(size=(8,)).astype(np.float32)),
+        (RNG.normal(size=(8, 2)).astype(np.float32),
+         RNG.normal(size=(2,)).astype(np.float32)),
+    ]
+    return m
+
+
+def _svm():
+    m = object.__new__(H2OSupportVectorMachineEstimator)
+    m.key = "fleet/rt-svm"
+    m._params_svm = {
+        "alpha": RNG.normal(size=(12,)).astype(np.float32),
+        "sv": RNG.normal(size=(12, 4)).astype(np.float32),
+        "rho": np.float32(0.25),
+    }
+    return m
+
+
+_FAMILIES = {
+    "naivebayes": _nb, "glm": _glm, "gbm": _gbm, "eif": _eif,
+    "kmeans": _kmeans, "pca": _pca, "svd": _svd, "coxph": _coxph,
+    "deeplearning": _dl, "svm": _svm,
+}
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+def test_family_demote_promote_bit_exact(fleet, monkeypatch, family):
+    """HBM → host → npz → HBM returns the exact bits the family
+    exported, through its real `_serving_params` pytree (including the
+    registered `_GLMState` node and the model-axis tree rules)."""
+    monkeypatch.setenv("H2O3_SERVE_HBM_BUDGET_MB", "8")
+    m = _FAMILIES[family]()
+    p = fleet.acquire(m, 0)
+    assert p is not None and p.tier == sp.TIER_HBM
+    before = _leaves(p.placed)
+
+    fleet.demote_key(m.key, to_tier=sp.TIER_HOST)
+    assert _placement(fleet, m.key).tier == sp.TIER_HOST
+    fleet.demote_key(m.key, to_tier=sp.TIER_DISK)
+    pp = _placement(fleet, m.key)
+    assert pp.tier == sp.TIER_DISK
+    assert pp.path is not None and os.path.exists(pp.path)
+
+    out = fleet.placed(m, 0)              # cold fault off the npz rung
+    after = _leaves(out)
+    assert len(before) == len(after) and before
+    for b, a in zip(before, after):
+        assert b.dtype == a.dtype
+        assert np.array_equal(b, a, equal_nan=True)
+
+    fleet.release(m.key, 0)               # last ref frees every tier
+    assert _placement(fleet, m.key) is None
+    assert not os.path.exists(pp.path or "")
+
+
+# ---------------------------------------------------------------------------
+# 3. the model-churn race harness (lockdep raise mode)
+def test_model_churn_race_harness(fleet, monkeypatch):
+    """4 tenants register/score/demote/retrain/release hundreds of
+    models against a tiny budget: zero lock inversions, the budget is
+    never exceeded mid-flight, and nobody's PINNED hot model ever
+    leaves HBM."""
+    monkeypatch.setenv("H2O3_SERVE_HBM_BUDGET_MB", "1")
+    budget = 1 * MB
+    lockdep.reset()
+    lockdep.enable("raise")
+    try:
+        stop = threading.Event()
+        over: list = []
+
+        def sampler():
+            while not stop.is_set():
+                used = fleet.admitted_bytes()
+                if used > budget:
+                    over.append(used)
+                time.sleep(0.0002)
+
+        errs: list = []
+
+        def tenant(i):
+            tracing.set_principal(f"fleet-tenant-{i}")
+            try:
+                pin = _stub(f"fleet/t{i}-pin")
+                fleet.acquire(pin, 0)
+                fleet.pin(pin.key)
+                rng = np.random.default_rng(100 + i)
+                held: dict = {}
+                for _ in range(150):
+                    j = int(rng.integers(0, 24))
+                    key = f"fleet/t{i}-m{j}"
+                    r = int(rng.integers(0, 10))
+                    if key not in held:
+                        m = _stub(key)
+                        fleet.acquire(m, 0)
+                        held[key] = m
+                        fleet.placed(m, 0)
+                    elif r < 4:           # score (fault when cold)
+                        out = fleet.placed(held[key], 0)
+                        got = np.asarray(jax.device_get(out["w"]))
+                        assert np.array_equal(got, held[key]._arr), key
+                    elif r < 6:           # operator demote, both rungs
+                        fleet.demote_key(key, to_tier=(
+                            sp.TIER_DISK if r == 5 else sp.TIER_HOST))
+                    elif r < 8:           # retrain: purge + new generation
+                        fleet.invalidate_key(key)
+                        m = _stub(key)
+                        fleet.acquire(m, 0)
+                        held[key] = m
+                    else:                 # model DELETE
+                        fleet.release(key, 0)
+                        del held[key]
+                # the pinned hot model never became a victim
+                assert _placement(fleet, pin.key).tier == sp.TIER_HBM
+                out = fleet.placed(pin, 0)
+                assert np.array_equal(
+                    np.asarray(jax.device_get(out["w"])), pin._arr)
+            except Exception as e:        # noqa: BLE001 — surface in main thread
+                errs.append(e)
+
+        st = threading.Thread(target=sampler, daemon=True)
+        st.start()
+        threads = [threading.Thread(target=tenant, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        st.join()
+
+        assert not errs, errs[:3]
+        assert not over, f"budget exceeded mid-flight: {max(over)}"
+        assert lockdep.counts()["inversions"] == 0
+    finally:
+        lockdep.disable()
+        lockdep.reset()
+
+
+# ---------------------------------------------------------------------------
+# 4. cross-tenant isolation: A's churn cannot evict B's hot set
+def test_flood_tenant_cannot_evict_victims_hot_set(fleet, monkeypatch):
+    """Two tenants, one shared budget. Tenant A cold-faults 300 models;
+    tenant B keeps scoring its 8-model hot set. Same-tenant-first victim
+    selection keeps B's set HBM-resident the whole time, B's warm p99
+    stays in SLO, and every eviction is charged to A."""
+    monkeypatch.setenv("H2O3_SERVE_HBM_BUDGET_MB", "1")
+    hot = [_stub(f"fleet/b-hot{i}") for i in range(8)]
+    with tracing.request_context("victimb"):
+        for m in hot:
+            fleet.acquire(m, 0)
+            fleet.placed(m, 0)
+
+    stop = threading.Event()
+    lat: list = []
+    errs: list = []
+
+    def victim():
+        tracing.set_principal("victimb")
+        try:
+            while not stop.is_set():
+                for m in hot:
+                    t0 = time.perf_counter()
+                    out = fleet.placed(m, 0)
+                    lat.append(time.perf_counter() - t0)
+                    assert out is not None
+                time.sleep(0.001)
+        except Exception as e:            # noqa: BLE001 — surface in main thread
+            errs.append(e)
+
+    def flood():
+        tracing.set_principal("flooda")
+        try:
+            for i in range(300):          # 2.4 MB of params vs 1 MB HBM
+                m = _stub(f"fleet/a-cold{i}")
+                fleet.acquire(m, 0)
+                fleet.placed(m, 0)
+        except Exception as e:            # noqa: BLE001 — surface in main thread
+            errs.append(e)
+
+    vt = threading.Thread(target=victim, daemon=True)
+    ft = threading.Thread(target=flood)
+    vt.start()
+    ft.start()
+    ft.join()
+    stop.set()
+    vt.join()
+
+    assert not errs, errs[:3]
+    for m in hot:                         # B's hot set never left HBM
+        assert _placement(fleet, m.key).tier == sp.TIER_HBM, m.key
+    stats = fleet.stats()
+    assert stats["evictions_by_tenant"].get("flooda", 0) > 0
+    assert stats["evictions_by_tenant"].get("victimb", 0) == 0
+    lat.sort()
+    assert len(lat) > 100
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    assert p99 < 0.05, f"victim warm p99 {p99 * 1e3:.1f}ms out of SLO"
+    assert fleet.admitted_bytes() <= 1 * MB
+
+
+# ---------------------------------------------------------------------------
+# 5. QoS standing and observability plumbing
+def test_eviction_standing_orders_heavy_consumers_first(monkeypatch):
+    monkeypatch.setenv("H2O3_QOS_RATES", "heavytenant:5")
+    assert qos.eviction_standing("some-idle-tenant") == 1.0
+    for _ in range(6):                    # drain the 2×rate burst
+        try:
+            qos.charge_token("heavytenant")
+        except qos.RateLimited:
+            break
+    s = qos.eviction_standing("heavytenant")
+    assert 0.0 <= s < 1.0                 # heavier consumer, lower standing
+
+
+def test_tier_gauge_and_usage_feed(fleet, monkeypatch):
+    monkeypatch.setenv("H2O3_SERVE_HBM_BUDGET_MB", "8")
+    m = _stub("fleet/gauge-probe", kb=16)
+    fleet.acquire(m, 0)
+    tb = fleet.tier_bytes()
+    assert tb[sp.TIER_HBM] == m._arr.nbytes and tb[sp.TIER_DISK] == 0
+    fleet.demote_key(m.key, to_tier=sp.TIER_DISK)
+    tb = fleet.tier_bytes()
+    assert tb[sp.TIER_HBM] == 0 and tb[sp.TIER_DISK] == m._arr.nbytes
+    assert fleet.by_model_tier()[m.key][sp.TIER_DISK] == m._arr.nbytes
+
+    # the global store feeds the prometheus fn-gauge and /3/Usage
+    series = sp._param_tier_series()
+    assert {lbl["tier"] for lbl, _v in series} == set(sp._TIERS)
+    from h2o3_tpu.obs import usage
+    snap = usage.usage_snapshot()
+    assert set(snap["hbm"]["params_tier_bytes"]) == set(sp._TIERS)
+    assert "evictions_by_tenant" in snap["hbm"]["params_serving"]

@@ -127,3 +127,39 @@ def test_sharded_codes_layout():
     if cl.n_devices > 1:
         shardings = {tuple(s.index) for s in v.codes.addressable_shards}
         assert len(shardings) == cl.n_devices  # genuinely distributed
+
+
+def test_strvec_codes_tier_roundtrip_bit_exact(tmp_path):
+    """The dictionary code plane rides the chunk pager like any numeric
+    plane: HBM → host i32 bytes → spill file → back, with the decoded
+    strings AND the packed codes bit-identical after the full ladder."""
+    from h2o3_tpu.core import tiering
+    from h2o3_tpu.core.memory import MANAGER
+
+    old_ice = MANAGER.ice_root
+    MANAGER.ice_root = str(tmp_path)
+    try:
+        col = np.asarray([None if i % 13 == 0 else f"lvl{i % 9}"
+                          for i in range(700)], dtype=object)
+        v = Vec.from_numpy(col, type="str")
+        assert isinstance(v, StrVec)
+        base = v.host_data.copy()
+        codes0 = np.asarray(v._codes_chunk.staging_view()[0]).copy()
+
+        tiering.PAGER.demote(v._codes_chunk, tiering.TIER_HOST)
+        assert v._codes_chunk.tier == "host"
+        assert np.array_equal(v.host_data, base)     # faults back
+
+        tiering.PAGER.demote(v._codes_chunk, tiering.TIER_DISK)
+        assert v._codes_chunk.tier == "disk"
+        got = v.host_data                            # cold fault off disk
+        assert np.array_equal(got, base)
+        codes1 = np.asarray(v._codes_chunk.staging_view()[0])
+        assert codes0.dtype == codes1.dtype
+        assert np.array_equal(codes0, codes1)
+
+        # transforms still run dictionary-side on the refaulted plane
+        up = v.map_values(str.upper)
+        assert up.host_data[1] == base[1].upper()
+    finally:
+        MANAGER.ice_root = old_ice
