@@ -49,17 +49,38 @@ Interprocedural concurrency rules (callgraph.py: project-wide call graph
                          in broadcast-replayed code — divergent per-host
                          values fork the SPMD-replicated state
 
+Replicated-state integrity rules (effects.py + rules_protocol.py: the
+effect-lattice pass classifying every function's effect on replicated
+vs host-local state, closed to a fixpoint over the same call graph):
+
+  R018 coordinator-only mutation  a replay-EXEMPT route's handler
+                         (static/obs/non-broadcast paths) transitively
+                         mutates replicated state — the write lands on
+                         the coordinator only
+  R019 host-divergence taint  broadcast-replayed code feeding a host
+                         identity (pid/hostname/platform/raw env read)
+                         into replicated state, interprocedurally —
+                         generalizes R016 to the full call graph
+  R020 protocol drift    replay-channel collect/control op names sent
+                         without a worker-side handler arm, or handler
+                         arms nothing sends (census: deploy/PROTOCOL.md)
+  R021 wire-format drift npz writer/reader sites in one module that
+                         disagree on the plane/key set
+
 The call graph models DYNAMIC DISPATCH (class-hierarchy analysis):
 cross-module base classes, self.m()/receiver-typed calls widened to
 every subclass override, and duck-typed seams resolved by distinctive
-method name under a one-hierarchy guard — so all six interprocedural
+method name under a one-hierarchy guard — so all the interprocedural
 rules see through polymorphism.
 
 Run `python -m h2o3_tpu.analysis --baseline analysis_baseline.json`; the
 tier-1 suite enforces zero unsuppressed findings over BOTH the package
 and tests/ (tests run the relaxed profile: R001/R004 waived). Runtime
 sanitizers (transfer_guard / debug_nans) live in .sanitizers; the
-runtime lock-order checker (H2O3_LOCKDEP) in .lockdep.
+runtime lock-order checker (H2O3_LOCKDEP) in .lockdep; the replay
+divergence sanitizer (H2O3_DIVERGENCE — per-request digests of
+replicated-state mutations compared coordinator vs worker) in
+.divergence.
 """
 
 from h2o3_tpu.analysis.engine import (   # noqa: F401
@@ -71,4 +92,5 @@ from h2o3_tpu.analysis.sanitizers import (   # noqa: F401
 
 ALL_RULES = ("R001", "R002", "R003", "R004", "R005", "R006",
              "R007", "R008", "R009", "R010", "R011", "R012", "R013",
-             "R014", "R015", "R016", "R017")
+             "R014", "R015", "R016", "R017", "R018", "R019", "R020",
+             "R021")

@@ -49,7 +49,7 @@ def _git_changed_files(root: str):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m h2o3_tpu.analysis",
-        description="JAX-aware static analyzer (rules R001-R017)")
+        description="JAX-aware static analyzer (rules R001-R021)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the h2o3_tpu "
                          "package)")
@@ -61,6 +61,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout "
                          "(includes elapsed_s wall-time)")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 to PATH "
+                         "(CI/editor annotation format)")
     ap.add_argument("--all", action="store_true",
                     help="also print suppressed/baselined findings")
     ap.add_argument("--changed-only", action="store_true",
@@ -73,10 +76,11 @@ def main(argv=None) -> int:
                     const="__default__", default=None,
                     help="write the census markdown files (default: "
                          "h2o3_tpu/obs/METRICS.md + SPANS.md + "
-                         "h2o3_tpu/analysis/ENV.md)")
+                         "h2o3_tpu/analysis/ENV.md + "
+                         "h2o3_tpu/deploy/PROTOCOL.md)")
     ap.add_argument("--check-census", action="store_true",
                     help="exit 1 when a committed census (METRICS.md / "
-                         "SPANS.md / ENV.md) is stale "
+                         "SPANS.md / ENV.md / PROTOCOL.md) is stale "
                          "(pre-commit freshness gate)")
     args = ap.parse_args(argv)
 
@@ -102,7 +106,8 @@ def main(argv=None) -> int:
 
     census_rc = 0
     if args.write_census is not None or args.check_census:
-        from h2o3_tpu.analysis import rules_env, rules_metrics, rules_spans
+        from h2o3_tpu.analysis import rules_env, rules_metrics, \
+            rules_protocol, rules_spans
         # the censuses are PACKAGE-wide by definition — independent of
         # which paths this invocation analyzes (the hook passes tests/
         # too, which must not leak fixture names into a census; a
@@ -123,6 +128,8 @@ def main(argv=None) -> int:
              os.path.join(engine.package_root(), "obs", "SPANS.md")),
             (rules_env.census_markdown(pkg_mods), "env-var",
              os.path.join(engine.package_root(), "analysis", "ENV.md")),
+            (rules_protocol.census_markdown(pkg_mods), "protocol",
+             os.path.join(engine.package_root(), "deploy", "PROTOCOL.md")),
         ]
         if args.write_census is not None:
             targets = censuses
@@ -161,6 +168,13 @@ def main(argv=None) -> int:
     elapsed = time.monotonic() - t0
     bad = engine.unsuppressed(findings)
     shown = findings if args.all else bad
+    if args.sarif:
+        from h2o3_tpu.analysis import sarif
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(sarif.to_sarif(findings), fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"sarif written: {args.sarif}", file=sys.stderr)
     if args.as_json:
         print(json.dumps({"findings": [f.to_dict() for f in shown],
                           "unsuppressed": len(bad),
@@ -170,7 +184,11 @@ def main(argv=None) -> int:
                           "scoped_files": (len(only_files)
                                            if only_files is not None
                                            else None),
-                          "elapsed_s": round(elapsed, 3)}, indent=2))
+                          "elapsed_s": round(elapsed, 3),
+                          "rule_timings_s": {
+                              k: round(v, 4) for k, v in
+                              sorted(engine.RULE_TIMINGS.items())}},
+                         indent=2))
     else:
         for f in shown:
             tag = ""

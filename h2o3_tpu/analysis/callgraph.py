@@ -751,14 +751,25 @@ def _is_setish(expr: ast.AST, set_locals: set) -> bool:
     return False
 
 
-def _nondet_mutations(fi: _FnInfo, nodes: list = None) -> list:
+def _nondet_mutations(fi: _FnInfo, nodes: list = None, desc_fn=None,
+                      call_desc=None,
+                      include_set_iteration: bool = True) -> list:
     """(desc, line) sites in this function where a nondeterministic value
     (or unordered-set iteration) feeds replicated-state mutation:
     self-attribute writes, module-global container stores
     (SESSIONS[sid] = ..., OBJ.attr = ...), DKV.put, `global` rebinding.
     Local use of nondeterminism (jitter before a sleep, metrics timings
     passed to observe()) does not count — only values that LAND in
-    state."""
+    state.
+
+    The taint machinery is shared with R019 (effects.py), which swaps
+    the source vocabulary: `desc_fn(call)` replaces _nondet_desc for the
+    direct-source check, `call_desc(call)` (if given) additionally marks
+    calls to interprocedurally-known divergent functions, and
+    `include_set_iteration=False` drops the set-order pattern (R016
+    already owns it — one site, one rule)."""
+    if desc_fn is None:
+        desc_fn = _nondet_desc
     node = fi.node
     if nodes is None:
         nodes = list(ast.walk(node))
@@ -803,7 +814,9 @@ def _nondet_mutations(fi: _FnInfo, nodes: list = None) -> list:
     def expr_taint(e: ast.AST):
         for sub in ast.walk(e):
             if isinstance(sub, ast.Call):
-                d = _nondet_desc(sub)
+                d = desc_fn(sub)
+                if d is None and call_desc is not None:
+                    d = call_desc(sub)
                 if d is not None:
                     return d
             elif isinstance(sub, ast.Name) and \
@@ -902,10 +915,11 @@ def _nondet_mutations(fi: _FnInfo, nodes: list = None) -> list:
                         return True
         return False
 
-    for n in nodes:
-        if isinstance(n, ast.For) and _is_setish(n.iter, set_locals) \
-                and _mutates_state(n.body):
-            out.append(("iteration over an unordered set", n.lineno))
+    if include_set_iteration:
+        for n in nodes:
+            if isinstance(n, ast.For) and _is_setish(n.iter, set_locals) \
+                    and _mutates_state(n.body):
+                out.append(("iteration over an unordered set", n.lineno))
     return out
 
 
@@ -1503,16 +1517,41 @@ def _check_r016(proj: _Project) -> list:
 
 # ---------------------------------------------------------------------------
 def check(mods: list) -> list:
+    """R007–R010/R015/R016 plus the effect-lattice rules (R018/R019/
+    R021, effects.py) — all off ONE build_project() index: the
+    interprocedural passes share the analyzer's single biggest cost.
+    Per-rule wall time lands in engine.RULE_TIMINGS (SELF_TIMED: the
+    engine's per-check timer can't see inside this shared pass)."""
+    import time as _time
+
+    from h2o3_tpu.analysis import effects as _effects
+    from h2o3_tpu.analysis import engine as _engine
+    timings = _engine.RULE_TIMINGS
+
+    def _timed(key, fn, *a):
+        t0 = _time.perf_counter()
+        out = fn(*a)
+        timings[key] = timings.get(key, 0.0) + (_time.perf_counter() - t0)
+        return out
+
+    t0 = _time.perf_counter()
     proj = build_project(mods)
+    timings["callgraph:index"] = timings.get(
+        "callgraph:index", 0.0) + (_time.perf_counter() - t0)
     findings = []
-    findings.extend(_check_r007(proj))
-    findings.extend(_check_r008(proj))
-    findings.extend(_check_r009(proj))
+    findings.extend(_timed("R007", _check_r007, proj))
+    findings.extend(_timed("R008", _check_r008, proj))
+    findings.extend(_timed("R009", _check_r009, proj))
+    t0 = _time.perf_counter()
     for mi in proj.mods:
         findings.extend(_check_r010_module(mi.mod))
-    findings.extend(_check_r015(proj))
-    findings.extend(_check_r016(proj))
+    timings["R010"] = timings.get("R010", 0.0) + \
+        (_time.perf_counter() - t0)
+    findings.extend(_timed("R015", _check_r015, proj))
+    findings.extend(_timed("R016", _check_r016, proj))
+    findings.extend(_effects.check_project(proj, mods, timings))
     return findings
 
 
-check.RULES = RULES
+check.RULES = RULES | {"R018", "R019", "R021"}
+check.SELF_TIMED = True

@@ -175,13 +175,27 @@ def load_modules(paths) -> list:
 # Everything else (locks, metrics, routes, R007-R010 concurrency)
 # applies to tests too: a racy test harness or a leaked test thread
 # flakes the suite.
+# R018–R021 (replicated-state integrity) are likewise production-
+# invariant rules: test fixtures register throwaway routes that mutate
+# fixture DKVs, seed host-divergent values to prove the runtime
+# sanitizer fires, and spin one-sided protocol stubs (a FakeWorker with
+# no _collect_local) on purpose.
 TEST_RELAXED = {"R001", "R004", "R011", "R012", "R013",
-                "R015", "R016", "R017"}
+                "R015", "R016", "R017",
+                "R018", "R019", "R020", "R021"}
 
 
 def _is_test_file(rel: str) -> bool:
     r = rel.replace("\\", "/")
     return r.startswith("tests/") or "/tests/" in r
+
+
+# {rule-or-pass: seconds} for the LAST analyze_modules call — the
+# analyzer runs in pre-commit under a wall-time budget, so --json
+# reports where the time went. Keys are "+"-joined rule ids per check
+# function; functions marked SELF_TIMED (the shared callgraph pass)
+# record their own finer-grained entries instead.
+RULE_TIMINGS: dict = {}
 
 
 def analyze_modules(mods: list, rules=None, only_files=None) -> list:
@@ -193,28 +207,41 @@ def analyze_modules(mods: list, rules=None, only_files=None) -> list:
     modules entirely, project rules still see the whole module set (a
     call graph over a partial project would miss cross-file edges) but
     report only into the scoped files."""
+    import time as _time
+
     from h2o3_tpu.analysis import callgraph, rules_env, rules_jax, \
         rules_locks, rules_logging, rules_metrics, rules_pjit, \
-        rules_routes, rules_sockets, rules_spans
+        rules_protocol, rules_routes, rules_sockets, rules_spans
     findings: list = []
+    RULE_TIMINGS.clear()
     if only_files is not None and not only_files:
         return []    # nothing in scope changed: every finding would be
         #              filtered out below — skip the analysis entirely
     per_file = [rules_jax.check, rules_locks.check, rules_logging.check,
                 rules_sockets.check, rules_pjit.check]
     project = [rules_metrics.check, rules_routes.check, rules_spans.check,
-               rules_env.check, callgraph.check]
+               rules_env.check, rules_protocol.check, callgraph.check]
     if rules:
         wanted = set(rules)
         per_file = [f for f in per_file if f.RULES & wanted]
         project = [f for f in project if f.RULES & wanted]
+
+    def _timed(rule_fn, arg):
+        key = "+".join(sorted(rule_fn.RULES))
+        t0 = _time.perf_counter()
+        out = rule_fn(arg)
+        if not getattr(rule_fn, "SELF_TIMED", False):
+            RULE_TIMINGS[key] = RULE_TIMINGS.get(key, 0.0) + \
+                (_time.perf_counter() - t0)
+        return out
+
     for m in mods:
         if only_files is not None and m.rel not in only_files:
             continue
         for rule_fn in per_file:
-            findings.extend(rule_fn(m))
+            findings.extend(_timed(rule_fn, m))
     for rule_fn in project:
-        findings.extend(rule_fn(mods))
+        findings.extend(_timed(rule_fn, mods))
     if rules:
         findings = [f for f in findings if f.rule in set(rules)]
     if only_files is not None:

@@ -262,9 +262,11 @@ def census_markdown(mods: list) -> str:
     ]
     for name, entries in sorted(decls.items()):
         e = entries[0]
+        # file only, no line: line-shift edits must leave the committed
+        # census byte-identical
         lines.append(
             f"| `{name}` | {e['kind']} | `{e['default']}` | "
-            f"{e['file']}:{e['line']} | "
+            f"{e['file']} | "
             f"{'✓' if name in readme else '—'} |")
     lines.append("")
     lines.append(f"{len(decls)} variables.")

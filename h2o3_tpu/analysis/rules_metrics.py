@@ -237,10 +237,12 @@ def census_markdown(mods: list) -> str:
         labels = sorted({lb for en in entries
                          for _, _, ls in en.get("emissions", [])
                          for lb in ls})
+        # file only, no line: a pure line-shift edit upstream of a
+        # declaration must leave the committed census byte-identical
         lines.append(
             f"| `{name}` | {e['kind']} | "
             f"{', '.join(f'`{l}`' for l in labels) or '—'} | "
-            f"{e['file']}:{e['line']} | {e['help'] or '—'} |")
+            f"{e['file']} | {e['help'] or '—'} |")
     lines.append("")
     lines.append(f"{len(decls)} metrics.")
     return "\n".join(lines) + "\n"

@@ -169,7 +169,9 @@ def census_markdown(mods: list) -> str:
         "|---|---|",
     ]
     for name, sites in sorted(decls.items()):
-        where = ", ".join(f"{f}:{ln}" for f, ln in sites)
+        # distinct files only, no line numbers: line-shift edits must
+        # leave the committed census byte-identical
+        where = ", ".join(sorted({f for f, _ln in sites}))
         lines.append(f"| `{name}` | {where} |")
     lines.append("")
     lines.append(f"{len(decls)} span names.")

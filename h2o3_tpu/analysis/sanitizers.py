@@ -23,6 +23,14 @@ Env gates (read by install_from_env, called at server start):
                              inversion into LockOrderInversion at the
                              acquisition that proves it, "log" only
                              counts h2o3_lockdep_inversions_total
+  H2O3_DIVERGENCE=1|log      replay-divergence checking (see
+                             analysis/divergence.py) — replicated-state
+                             mutations digest per broadcast request,
+                             coordinator vs worker digests compared on
+                             the ack stream; "1"/"raise" surfaces the
+                             first mismatch as DivergenceError on the
+                             next dispatch, "log" only counts
+                             h2o3_divergence_mismatches_total
 """
 
 from __future__ import annotations
@@ -66,6 +74,13 @@ def install_from_env() -> dict:
     if lockdep_mode:
         lockdep.enable(lockdep_mode)
         enabled["lockdep"] = lockdep_mode
+    # divergence joins lockdep ABOVE the jax gate: both sanitize pure
+    # host-side state machines and must arm even where jax is absent
+    from h2o3_tpu.analysis import divergence
+    divergence_mode = divergence.env_mode()
+    if divergence_mode:
+        divergence.enable(divergence_mode)
+        enabled["divergence"] = divergence_mode
     try:
         import jax
     except Exception:   # noqa: BLE001 — no jax, nothing else to sanitize

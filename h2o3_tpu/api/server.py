@@ -26,6 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 import h2o3_tpu
+from h2o3_tpu.analysis import divergence as _dvg
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.core.jobs import Job, jobs_list
 from h2o3_tpu.core.kvstore import DKV
@@ -389,13 +390,28 @@ class _Handler(BaseHTTPRequestHandler):
                 # the trace id rides the replay channel so every worker
                 # tags its replayed spans with the ORIGINATING request's
                 # trace
-                bc.broadcast(method, path, params,
-                             trace=getattr(self, "_trace_id", None),
-                             sampled=self.headers.get(
-                                 "X-H2O3-Sample") == "1")
+                seq = bc.broadcast(method, path, params,
+                                   trace=getattr(self, "_trace_id", None),
+                                   sampled=self.headers.get(
+                                       "X-H2O3-Sample") == "1")
+            else:
+                seq = None
             if fn is not None:
                 self._route_label = pat.pattern
-                fn(self, *groups)
+                if seq is not None and _dvg.active():
+                    # divergence sanitizer: surface any mismatch a prior
+                    # request's ack riders proved (deferred out of the
+                    # broadcaster's loops), then digest this handler's
+                    # replicated-state mutations under the broadcast seq
+                    # for comparison against each worker's replay
+                    _dvg.raise_if_pending()
+                    _dvg.local_begin(seq, path)
+                    try:
+                        fn(self, *groups)
+                    finally:
+                        _dvg.local_end()
+                else:
+                    fn(self, *groups)
                 return
             self._error(f"no route {method} {path}", 404)
         except Exception as ex:  # noqa: BLE001 — handler errors → H2OError
@@ -507,6 +523,10 @@ def _h_cloud(h: _Handler):
                        for d in info["devices"]]})
 
 
+# membership is coordinator-owned: drain IS the protocol that tells
+# workers about the epoch change (leave + bump), not a replicated write
+# that needed replaying
+# h2o3-ok: R018 coordinator-owned membership control surface
 def _h_cloud_drain(h: _Handler):
     """POST /3/Cloud/drain?node=N — graceful worker departure: finish
     in-flight jobs and micro-batches (bounded by H2O3_DRAIN_TIMEOUT_S),
@@ -609,6 +629,10 @@ def _h_parse(h: _Handler):
 
 
 @starts_job
+# the job record is coordinator-owned control state; the FRAME planes
+# workers produce ship back over the parse: fan-out and land under the
+# coordinator's dest key, not via replay
+# h2o3-ok: R018 coordinator-owned job record; frames ship via fan-out
 def _h_parse_distributed(h: _Handler):
     """POST /3/ParseDistributed — the cloud-wide chunked parse: the
     coordinator plans byte ranges and fans shares out over the replay

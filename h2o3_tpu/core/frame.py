@@ -640,16 +640,42 @@ class SparseVec(Vec):
 
     def __init__(self, nz_rows, nz_vals, nrows: int, type: str = T_NUM):
         c = _mesh.cloud()
-        self.nz_rows = jnp.asarray(nz_rows, jnp.int32)
-        self.nz_vals = jnp.asarray(nz_vals, jnp.float32)
+        # both nz planes live behind TierChunks (the StrVec code-plane
+        # pattern), so wide-sparse frames demote HBM → host i32/f32
+        # bytes → disk exactly like dense planes. Construction sites
+        # pass host arrays (npz import, parser CSC split), so the host
+        # mirror is canonical for free and demote never re-fetches.
+        rows_host = np.ascontiguousarray(np.asarray(nz_rows, np.int32))
+        vals_host = np.ascontiguousarray(np.asarray(nz_vals, np.float32))
+        if _tiering.PAGER.ingest_cold:
+            rows_dev = vals_dev = None    # born cold: fault on first use
+        else:
+            rows_dev = jnp.asarray(rows_host)
+            vals_dev = jnp.asarray(vals_host)
+        self._nzr_chunk = _tiering.PAGER.new_chunk(
+            rows_dev, None, host=(rows_host, None), label="sparse_rows",
+            put="flat")
+        self._nzv_chunk = _tiering.PAGER.new_chunk(
+            vals_dev, None, host=(vals_host, None), label="sparse_vals",
+            put="flat")
         self._pad = c.padded_rows(nrows)
         super().__init__(None, Codec("const", const_val=0.0), None,
                          nrows, type)
 
     # ---- Vec surface -----------------------------------------------------
     @property
+    def nz_rows(self):
+        """(nnz,) i32 device row indices — faults the plane to HBM."""
+        return self._nzr_chunk.device()[0]
+
+    @property
+    def nz_vals(self):
+        """(nnz,) f32 device values — faults the plane to HBM."""
+        return self._nzv_chunk.device()[0]
+
+    @property
     def nnz(self) -> int:
-        return int(self.nz_rows.shape[0])
+        return int(self._nzr_chunk.rows)   # shape read must not fault
 
     @property
     def padded_len(self) -> int:
@@ -660,7 +686,8 @@ class SparseVec(Vec):
                                pad=self._pad, n=self.nrows)
 
     def _compute_rollups(self) -> Rollups:
-        v = np.asarray(self.nz_vals)
+        # staging_view: rollups on a demoted column must not promote it
+        v = np.asarray(self._nzv_chunk.staging_view()[0])
         ok = v[~np.isnan(v)]
         n = self.nrows
         nas = int(np.isnan(v).sum())

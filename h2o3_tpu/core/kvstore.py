@@ -92,6 +92,13 @@ def _plane_restore(payload: bytes):
         return z["data"], (z["mask"] if "mask" in z.files else None)
 
 
+# replay-divergence sanitizer seam (analysis/divergence.py): when
+# H2O3_DIVERGENCE is enabled this is its _record function and every
+# replicated-state mutation reports (op, key, value) into the active
+# request scope. None (the default) costs one global load per mutation.
+_div_hook = None
+
+
 class _DKV:
     def __init__(self):
         self._store: dict[str, Any] = {}
@@ -135,6 +142,9 @@ class _DKV:
         if old is not None and old is not value \
                 and hasattr(old, "_on_replace"):
             old._on_replace()
+        hk = _div_hook
+        if hk is not None:
+            hk("put", key, value)
         return key
 
     def get(self, key: str, default=None):
@@ -168,6 +178,9 @@ class _DKV:
             self._migrating.discard(key)
         if v is not None and hasattr(v, "_on_remove"):
             v._on_remove()
+        hk = _div_hook
+        if hk is not None:
+            hk("remove", key, None)
 
     def keys(self) -> list[str]:
         with self._mutex:
@@ -190,7 +203,10 @@ class _DKV:
                 self._store.pop(key, None)
             else:
                 self._store[key] = nv
-            return nv
+        hk = _div_hook
+        if hk is not None:
+            hk("atomic", key, nv)
+        return nv
 
     # ---- write locks (water/Lockable.java) ------------------------------
     def write_lock(self, key: str, owner: str):
@@ -375,6 +391,10 @@ class _DKV:
             codes = getattr(vec, "_codes_chunk", None)
             if codes is not None:   # StrVec dictionary code plane
                 out.append(codes)
+            for attr in ("_nzr_chunk", "_nzv_chunk"):
+                nz = getattr(vec, attr, None)
+                if nz is not None:  # SparseVec nz row/value planes
+                    out.append(nz)
         return out
 
     def rehome_status(self) -> dict:
@@ -388,9 +408,13 @@ class _DKV:
 
     # ---- key minting (water/Key.make) -----------------------------------
     def make_key(self, prefix: str = "obj") -> str:
+        # deterministic: broadcast replay re-mints keys on EVERY host,
+        # and the serialized replay stream bumps the counter in the same
+        # order everywhere — a wall-clock component here forked the key
+        # namespace across the cloud (the R019 divergence class)
         with self._mutex:
             self._counter += 1
-            return f"{prefix}_{self._counter:04d}_{int(time.time()) % 100000}"
+            return f"{prefix}_{self._counter:04d}"
 
 
 DKV = _DKV()

@@ -101,11 +101,17 @@ class TierChunk:
     values and any of them can be dropped once a colder copy exists.
     """
 
-    __slots__ = ("key", "nbytes", "rows", "pinned", "_dev", "_host",
-                 "_path", "_io", "_last", "_prefetched", "__weakref__")
+    __slots__ = ("key", "nbytes", "rows", "pinned", "put", "_dev",
+                 "_host", "_path", "_io", "_last", "_prefetched",
+                 "__weakref__")
 
-    def __init__(self, key: str, dev=None, host=None):
+    def __init__(self, key: str, dev=None, host=None, put: str = "rows"):
         self.key = key
+        # promotion placement: "rows" row-shards dim 0 over the mesh
+        # (dense planes, padded to the device count); "flat" places on
+        # the default device (SparseVec nz planes — their length is the
+        # nnz count, not row-aligned, and consumers concatenate them)
+        self.put = put
         data, mask = dev if dev is not None else host
         self.rows = int(data.shape[0])
         self.nbytes = int(np.prod(data.shape)) * data.dtype.itemsize
@@ -226,7 +232,7 @@ class ChunkPager:
 
     # ---- registration ----------------------------------------------------
     def new_chunk(self, data, mask, host=None, label: str = "",
-                  pinned: int = 0) -> TierChunk:
+                  pinned: int = 0, put: str = "rows") -> TierChunk:
         """Wrap freshly-ingested planes and register with the pager.
         `data` may be None when only packed host bytes exist (budgeted
         ingest parks new chunks in the host tier — an eager device_put
@@ -238,7 +244,7 @@ class ChunkPager:
         dev = (data, mask) if data is not None else None
         ch = TierChunk(key, dev,
                        host=host if (self.enabled or dev is None)
-                       else None)
+                       else None, put=put)
         ch.pinned = pinned
         ch._last = self.tick()
 
@@ -385,10 +391,16 @@ class ChunkPager:
                 if self._try_reserve(ch.nbytes, force=forced):
                     try:
                         data, mask = self._host_planes(ch)
-                        from h2o3_tpu.parallel import mrtask as _mr
-                        ddev = _mr.device_put_rows(data)
-                        dmask = None if mask is None \
-                            else _mr.device_put_rows(mask)
+                        if ch.put == "flat":
+                            import jax.numpy as jnp
+                            ddev = jnp.asarray(data)
+                            dmask = None if mask is None \
+                                else jnp.asarray(mask)
+                        else:
+                            from h2o3_tpu.parallel import mrtask as _mr
+                            ddev = _mr.device_put_rows(data)
+                            dmask = None if mask is None \
+                                else _mr.device_put_rows(mask)
                         dev = (ddev, dmask)
                         with self._lock:
                             ch._dev = dev

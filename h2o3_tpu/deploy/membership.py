@@ -487,6 +487,11 @@ class ElasticBroadcaster(_mh.Broadcaster):
                     failed.append((i, "recv_error"))
             for i, reason in failed:
                 self._excise_locked(i, reason)
+            # seq hands the divergence sanitizer this request's identity
+            # (mismatches must never raise in here: an exception in the
+            # send/ack loops above reads as a broken peer and excises it
+            # — the dispatcher's raise_if_pending owns surfacing them)
+            return self._seq
 
     def collect(self, op: str, timeout: float = 2.0) -> list:
         """Base collect, then lift peers it found broken into proper

@@ -411,6 +411,18 @@ class Broadcaster:
                 out = _decode_frame(self._bufs[i], key)
                 if out is not None:
                     msg, self._bufs[i] = out
+                    if isinstance(msg, dict) and "div" in msg:
+                        # divergence-sanitizer digests riding the ack
+                        # (analysis/divergence): peel off and compare —
+                        # never let a sanitizer fault break the channel
+                        try:
+                            from h2o3_tpu.analysis import \
+                                divergence as _dvg
+                            pid = self._pids[i] \
+                                if i < len(self._pids) else i
+                            _dvg.note_remote(pid, msg.get("div"))
+                        except Exception:   # noqa: BLE001
+                            pass
                     return msg
                 if deadline is not None:
                     remaining = deadline - _time.monotonic()
@@ -512,6 +524,10 @@ class Broadcaster:
                     f"acked within {_ack_timeout():g}s — SPMD replay is "
                     "wedged (H2O3_REPLAY_ACK_TIMEOUT_S bounds this "
                     "wait)") from None
+            # the seq identifies this request to the divergence
+            # sanitizer: the dispatcher scopes the local execution under
+            # it and workers stamp their replay digests with it
+            return self._seq
 
     def collect(self, op: str, timeout: float = 2.0) -> list:
         """Gather per-worker observability state (TimelineSnapshot's
@@ -845,9 +861,11 @@ def _replay_session(sock, key, welcome) -> str:
             if act is not None and act["action"] == "drop":
                 continue
             try:
+                from h2o3_tpu.analysis import divergence as _dvg
                 _send_frame(sock, key,    # rides the ack, no route replay
-                            {"ack": msg["seq"],
-                             "data": _collect_local(msg["op"])})
+                            _dvg.attach_riders(
+                                {"ack": msg["seq"],
+                                 "data": _collect_local(msg["op"])}))
             except OSError:
                 return "eof"
             continue
@@ -855,9 +873,15 @@ def _replay_session(sock, key, welcome) -> str:
         # "lost pod" the membership layer must excise and replace
         _chaos.maybe_raise("worker.replay")
         try:
-            _send_frame(sock, key, {"ack": msg["seq"]})  # ack, then execute
+            # ack, then execute; digests from ALREADY-replayed requests
+            # ride out here (this request's own digest rides the next
+            # frame — the sanitizer stashes whichever side arrives first)
+            from h2o3_tpu.analysis import divergence as _dvg
+            _send_frame(sock, key,
+                        _dvg.attach_riders({"ack": msg["seq"]}))
         except OSError:
             return "eof"
+        _dvg.replay_begin(msg["seq"], msg["path"])
         try:
             # replay under the ORIGINATING request's trace id (when the
             # coordinator attached one): every span this replay opens —
@@ -896,6 +920,8 @@ def _replay_session(sock, key, welcome) -> str:
         except Exception:                 # keep replaying; process 0 owns
             import traceback              # error reporting to the client
             traceback.print_exc()
+        finally:
+            _dvg.replay_end()             # queue this replay's digest
 
 
 def serve(port: int = 54321, n_rows_shards=None, n_model_shards: int = 1):

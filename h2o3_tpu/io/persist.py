@@ -69,9 +69,10 @@ def export_frame(frame: Frame, path: str) -> str:
              "is_str": v.type == "str", "is_sparse": is_sparse}
         header["cols"].append(c)
         if is_sparse:
-            # CXI-style persist: only the nonzero (row, value) pairs
-            arrays[f"zr{j}"] = np.asarray(v.nz_rows)
-            arrays[f"zv{j}"] = np.asarray(v.nz_vals)
+            # CXI-style persist: only the nonzero (row, value) pairs —
+            # staging_view so exporting a demoted frame stays tier-cheap
+            arrays[f"zr{j}"] = np.asarray(v._nzr_chunk.staging_view()[0])
+            arrays[f"zv{j}"] = np.asarray(v._nzv_chunk.staging_view()[0])
         elif v.type == "str":
             data = v.host_data    # one device fetch+decode, not two
             arrays[f"s{j}"] = np.array([x if x is not None else ""

@@ -1,0 +1,298 @@
+"""Runtime divergence sanitizer (analysis/divergence.py): replicated-
+state mutation digests ride the replay channel's ack frames; the
+coordinator compares its own per-request digest against each worker's.
+
+The end-to-end tests drive a REAL stack in one process: an
+ElasticBroadcaster, a real `worker_loop` replaying through the live
+route table, and an H2OServer whose dispatcher wraps every broadcast
+request in `local_begin`/`local_end`. Deterministic handlers must fold
+to identical digests under 8 racing client threads (zero mismatches);
+a handler seeded with a host-divergent value (the thread id — the
+coordinator's handler thread and the worker's replay loop differ even
+in-process) must trip the mismatch counter and fail the NEXT broadcast
+request with an error naming the diverged key."""
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from h2o3_tpu.analysis import divergence
+from h2o3_tpu.core.kvstore import DKV
+from h2o3_tpu.deploy import chaos
+from h2o3_tpu.deploy import membership as MB
+from h2o3_tpu.deploy import multihost as MH
+
+
+# ---------------------------------------------------------------------------
+# unit layer
+def test_env_mode_mapping(monkeypatch):
+    for raw, want in [("", ""), ("0", ""), ("off", ""), ("False", ""),
+                      ("log", "log"), ("1", "raise"),
+                      ("raise", "raise"), ("on", "raise")]:
+        monkeypatch.setenv("H2O3_DIVERGENCE", raw)
+        assert divergence.env_mode() == want, raw
+    monkeypatch.delenv("H2O3_DIVERGENCE")
+    assert divergence.env_mode() == ""
+
+
+def test_enable_hooks_kvstore_and_disable_unhooks():
+    from h2o3_tpu.core import kvstore
+    assert kvstore._div_hook is None
+    divergence.enable("raise")
+    try:
+        assert kvstore._div_hook is divergence._record
+        assert divergence.active()
+    finally:
+        divergence.disable()
+    assert kvstore._div_hook is None and not divergence.active()
+
+
+def test_value_digest_is_order_insensitive_for_dicts():
+    d = divergence._value_digest
+    assert d({"a": 1, "b": "x"}) == d({"b": "x", "a": 1})
+    assert d({"a": 1}) != d({"a": 2})
+    import numpy as np
+    arr = np.arange(8, dtype=np.int32)
+    assert d(arr) == d(arr.copy())
+    assert d(arr) != d(arr + 1)
+    # device payloads digest by TYPE — never a host sync on the put path
+    class Opaque:                                      # noqa: E306
+        pass
+    assert d(Opaque()) == "t:Opaque"
+
+
+def test_record_outside_request_scope_is_noop():
+    divergence.enable("raise")
+    try:
+        divergence._record("put", "k", 1)     # no active scope: ignored
+        divergence.local_begin(7, "/3/X")
+        DKV.put("_div_unit_k", 3.0)
+        scope = divergence._tls.scope
+        assert scope["n"] == 1 and scope["e"][0].startswith(
+            "put|_div_unit_k|")
+        divergence.local_end()
+        assert divergence._tls.scope is None
+    finally:
+        divergence.disable()
+        DKV.remove("_div_unit_k")
+
+
+def test_riders_attach_to_ack_frames_and_compare():
+    divergence.enable("raise")
+    try:
+        # worker side: digest a replayed mutation, queue the rider
+        divergence.replay_begin(3, "/3/Seeded")
+        DKV.put("_div_unit_r", {"v": 1})
+        divergence.replay_end()
+        frame = divergence.attach_riders({"ack": 3})
+        assert frame["div"][0]["seq"] == 3
+        # coordinator side: identical local digest → check, no mismatch
+        checks, mism = divergence._counters()
+        c0, m0 = checks.value(), mism.value()
+        divergence.local_begin(3, "/3/Seeded")
+        DKV.put("_div_unit_r", {"v": 1})
+        divergence.local_end()
+        divergence.note_remote(1, frame["div"])
+        assert checks.value() == c0 + 1 and mism.value() == m0
+        divergence.raise_if_pending()         # nothing pending
+    finally:
+        divergence.disable()
+        DKV.remove("_div_unit_r")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end layer: real broadcaster + real replaying worker + H2OServer
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture()
+def div_cloud(monkeypatch):
+    from h2o3_tpu.api.server import H2OServer
+    monkeypatch.setenv("H2O3_CLUSTER_SECRET", "divergence-test-secret")
+    monkeypatch.setenv("H2O3_HEARTBEAT_S", "0")
+    monkeypatch.setenv("H2O3_REPLAY_ACK_TIMEOUT_S", "5")
+    monkeypatch.setenv("H2O3_REPLAY_RECONNECT_S", "0")
+    monkeypatch.setenv("H2O3_DIVERGENCE", "1")
+    MB.MEMBERSHIP.reset()
+    chaos.reset()
+    port = _free_port()
+    out = {}
+
+    def _mk():
+        out["bc"] = MB.ElasticBroadcaster(1, port)
+
+    t = threading.Thread(target=_mk, daemon=True)
+    t.start()
+    # a REAL worker loop — replays every broadcast through the route
+    # table, so its DKV mutations are digested by the sanitizer
+    wt = threading.Thread(target=MH.worker_loop,
+                          args=("127.0.0.1", port),
+                          kwargs={"pid": 1}, daemon=True)
+    wt.start()
+    t.join(timeout=15)
+    assert not t.is_alive() and "bc" in out
+    srv = H2OServer(port=0).start()   # install_from_env → enable("raise")
+    assert divergence.active()
+    srv.httpd.broadcaster = out["bc"]
+    yield srv, out["bc"]
+    srv.stop()
+    out["bc"].close()
+    wt.join(timeout=5)
+    divergence.disable()
+    MB.MEMBERSHIP.reset()
+    chaos.reset()
+    DKV.set_membership([0], epoch=1)
+    deadline = time.monotonic() + 5
+    while DKV.rehome_status()["pending"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+
+
+def _post(srv, path, params):
+    body = urllib.parse.urlencode(params).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _temp_route(pattern, method, fn):
+    from h2o3_tpu.api import server as _srv
+    row = (re.compile(pattern), method, fn)
+    _srv.ROUTES.append(row)
+    return row
+
+
+def _drop_route(row):
+    from h2o3_tpu.api import server as _srv
+    _srv.ROUTES.remove(row)
+
+
+def test_deterministic_handlers_race_with_zero_mismatches(div_cloud):
+    srv, bc = div_cloud
+
+    def _h_divput(h):
+        p = h._params()
+        DKV.put("div_" + p["tag"], {"v": int(p["v"])})
+        h._send({"ok": True})
+
+    row = _temp_route(r"/3/DivPut", "POST", _h_divput)
+    checks, mism = divergence._counters()
+    c0, m0 = checks.value(), mism.value()
+    errors = []
+    try:
+        def _client(t):
+            try:
+                for i in range(6):
+                    out = _post(srv, "/3/DivPut",
+                                {"tag": f"{t}_{i}", "v": t * 100 + i})
+                    assert out.get("ok") is True
+            except Exception as ex:        # noqa: BLE001
+                errors.append(ex)
+
+        threads = [threading.Thread(target=_client, args=(t,))
+                   for t in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        # riders for the final requests are still queued worker-side —
+        # any subsequent frame's ack carries them home
+        bc.collect("ping")
+        deadline = time.monotonic() + 10
+        while checks.value() < c0 + 48 and time.monotonic() < deadline:
+            bc.collect("ping")
+            time.sleep(0.05)
+        assert checks.value() >= c0 + 48, \
+            (checks.value(), c0)           # every request was compared
+        assert mism.value() == m0          # and none diverged
+        for t in range(8):
+            for i in range(6):
+                assert DKV.get(f"div_{t}_{i}")["v"] == t * 100 + i
+    finally:
+        _drop_route(row)
+        for t in range(8):
+            for i in range(6):
+                DKV.remove(f"div_{t}_{i}")
+
+
+def test_seeded_host_divergent_write_is_caught_and_named(div_cloud):
+    srv, bc = div_cloud
+
+    def _h_seed(h):
+        # threading.get_ident(): differs between the coordinator's
+        # handler thread and the worker's replay loop even in-process —
+        # the minimal stand-in for pid/hostname/time leaking into DKV
+        DKV.put("div_seed", {"tid": threading.get_ident()})
+        h._send({"ok": True})
+
+    row = _temp_route(r"/3/DivSeed", "POST", _h_seed)
+    checks, mism = divergence._counters()
+    m0 = mism.value()
+    try:
+        out = _post(srv, "/3/DivSeed", {})
+        assert out.get("ok") is True
+        deadline = time.monotonic() + 10
+        while mism.value() == m0 and time.monotonic() < deadline:
+            bc.collect("ping")             # flush the rider home
+            time.sleep(0.05)
+        assert mism.value() >= m0 + 1
+        # raise mode: the NEXT broadcast request surfaces the mismatch
+        # as a server error naming the diverged key
+        row2 = _temp_route(r"/3/DivPut2", "POST",
+                           lambda h: h._send({"ok": True}))
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(srv, "/3/DivPut2", {})
+            assert ei.value.code == 500
+            body = ei.value.read().decode()
+            assert "divergence" in body.lower()
+            assert "div_seed" in body
+            # pending state is consumed: the cloud recovers
+            out = _post(srv, "/3/DivPut2", {})
+            assert out.get("ok") is True
+        finally:
+            _drop_route(row2)
+    finally:
+        _drop_route(row)
+        DKV.remove("div_seed")
+
+
+def test_log_mode_counts_but_does_not_fail_requests(div_cloud,
+                                                    monkeypatch):
+    srv, bc = div_cloud
+    divergence.disable()
+    divergence.enable("log")
+
+    def _h_seed(h):
+        DKV.put("div_seed_log", {"tid": threading.get_ident()})
+        h._send({"ok": True})
+
+    row = _temp_route(r"/3/DivSeedLog", "POST", _h_seed)
+    checks, mism = divergence._counters()
+    m0 = mism.value()
+    try:
+        assert _post(srv, "/3/DivSeedLog", {}).get("ok") is True
+        deadline = time.monotonic() + 10
+        while mism.value() == m0 and time.monotonic() < deadline:
+            bc.collect("ping")
+            time.sleep(0.05)
+        assert mism.value() >= m0 + 1
+        # log mode: counted + logged, never raised — the next request
+        # (another seeded one, even) still succeeds
+        assert _post(srv, "/3/DivSeedLog", {}).get("ok") is True
+    finally:
+        _drop_route(row)
+        DKV.remove("div_seed_log")

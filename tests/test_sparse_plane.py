@@ -119,3 +119,48 @@ def test_sparse_frame_persist_roundtrip(tmp_path):
     np.testing.assert_allclose(v.to_numpy(), f.vec("s").to_numpy())
     np.testing.assert_allclose(g.vec("d").to_numpy(), np.arange(8.0))
     DKV.remove("sp_back")
+
+
+def test_sparse_nz_planes_tier_roundtrip_bit_exact(tmp_path):
+    """Both nz planes ride the chunk pager like dense planes: HBM → host
+    i32/f32 bytes → spill file → back, with row indices AND values
+    bit-identical after the full ladder (no re-sort, no dtype drift)."""
+    from h2o3_tpu.core import tiering
+    from h2o3_tpu.core.memory import MANAGER
+
+    old_ice = MANAGER.ice_root
+    MANAGER.ice_root = str(tmp_path)
+    try:
+        rng = np.random.default_rng(7)
+        idx = np.sort(rng.choice(5000, size=321, replace=False)
+                      ).astype(np.int32)
+        vals = rng.normal(0, 3, 321).astype(np.float32)
+        vals[5] = np.nan                       # explicit NA survives too
+        v = SparseVec(idx, vals, nrows=5000)
+        rows0 = np.asarray(v._nzr_chunk.staging_view()[0]).copy()
+        vals0 = np.asarray(v._nzv_chunk.staging_view()[0]).copy()
+        dense0 = v.to_numpy().copy()
+
+        for ch in (v._nzr_chunk, v._nzv_chunk):
+            tiering.PAGER.demote(ch, tiering.TIER_HOST)
+            assert ch.tier == "host"
+            tiering.PAGER.demote(ch, tiering.TIER_DISK)
+            assert ch.tier == "disk"
+
+        # nnz is a shape read — it must answer without faulting
+        assert v.nnz == 321
+        assert v._nzr_chunk.tier == "disk"
+
+        rows1 = np.asarray(v._nzr_chunk.staging_view()[0])
+        vals1 = np.asarray(v._nzv_chunk.staging_view()[0])
+        assert rows1.dtype == rows0.dtype and vals1.dtype == vals0.dtype
+        assert rows1.tobytes() == rows0.tobytes()
+        assert vals1.tobytes() == vals0.tobytes()
+
+        # device access faults the planes back and densifies identically
+        dense1 = v.to_numpy()
+        np.testing.assert_array_equal(
+            np.asarray(dense1), np.asarray(dense0))
+        assert v._nzr_chunk.tier == tiering.TIER_HBM
+    finally:
+        MANAGER.ice_root = old_ice

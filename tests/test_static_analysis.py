@@ -468,6 +468,7 @@ def test_install_from_env_is_gated(monkeypatch):
     monkeypatch.delenv("H2O3_DEBUG_NANS", raising=False)
     monkeypatch.delenv("H2O3_TRANSFER_GUARD", raising=False)
     monkeypatch.delenv("H2O3_LOCKDEP", raising=False)
+    monkeypatch.delenv("H2O3_DIVERGENCE", raising=False)
     assert sanitizers.install_from_env() == {}
     # explicit "off" spellings must DISABLE, not fall through to raise
     from h2o3_tpu.analysis import lockdep
