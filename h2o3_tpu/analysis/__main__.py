@@ -49,7 +49,7 @@ def _git_changed_files(root: str):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m h2o3_tpu.analysis",
-        description="JAX-aware static analyzer (rules R001-R021)")
+        description="JAX-aware static analyzer (rules R001-R025)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to analyze (default: the h2o3_tpu "
                          "package)")
@@ -176,9 +176,13 @@ def main(argv=None) -> int:
             fh.write("\n")
         print(f"sarif written: {args.sarif}", file=sys.stderr)
     if args.as_json:
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         print(json.dumps({"findings": [f.to_dict() for f in shown],
                           "unsuppressed": len(bad),
                           "total": len(findings),
+                          "by_rule": dict(sorted(by_rule.items())),
                           "files_analyzed": len(mods),
                           "changed_only": bool(args.changed_only),
                           "scoped_files": (len(only_files)

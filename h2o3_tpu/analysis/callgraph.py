@@ -1518,7 +1518,8 @@ def _check_r016(proj: _Project) -> list:
 # ---------------------------------------------------------------------------
 def check(mods: list) -> list:
     """R007–R010/R015/R016 plus the effect-lattice rules (R018/R019/
-    R021, effects.py) — all off ONE build_project() index: the
+    R021, effects.py) and the flow-sensitive lifecycle rules (R022–
+    R025, lifecycle.py) — all off ONE build_project() index: the
     interprocedural passes share the analyzer's single biggest cost.
     Per-rule wall time lands in engine.RULE_TIMINGS (SELF_TIMED: the
     engine's per-check timer can't see inside this shared pass)."""
@@ -1550,8 +1551,11 @@ def check(mods: list) -> list:
     findings.extend(_timed("R015", _check_r015, proj))
     findings.extend(_timed("R016", _check_r016, proj))
     findings.extend(_effects.check_project(proj, mods, timings))
+    from h2o3_tpu.analysis import lifecycle as _lifecycle
+    findings.extend(_lifecycle.check_project(proj, mods, timings))
     return findings
 
 
-check.RULES = RULES | {"R018", "R019", "R021"}
+check.RULES = RULES | {"R018", "R019", "R021"} \
+    | {"R022", "R023", "R024", "R025"}
 check.SELF_TIMED = True

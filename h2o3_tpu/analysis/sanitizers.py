@@ -30,6 +30,14 @@ Env gates (read by install_from_env, called at server start):
                              the ack stream; "1"/"raise" surfaces the
                              first mismatch as DivergenceError on the
                              next dispatch, "log" only counts
+  H2O3_LEAKTRACK=1|log       paired-protocol leak tracking (see
+                             analysis/leaktrack.py) — registered openers
+                             hand out tokens recording their acquisition
+                             site; a token dying unreleased (or a
+                             request-scoped pair surviving its request)
+                             is a proven leak; "1"/"raise" fails the
+                             next dispatch with LeakError, "log" only
+                             counts h2o3_leaktrack_leaks_total
                              h2o3_divergence_mismatches_total
 """
 
@@ -81,6 +89,13 @@ def install_from_env() -> dict:
     if divergence_mode:
         divergence.enable(divergence_mode)
         enabled["divergence"] = divergence_mode
+    # leaktrack too: the paired protocols it tracks (QoS slots, usage
+    # records, watchdog entries) are host-side state machines
+    from h2o3_tpu.analysis import leaktrack
+    leaktrack_mode = leaktrack.env_mode()
+    if leaktrack_mode:
+        leaktrack.enable(leaktrack_mode)
+        enabled["leaktrack"] = leaktrack_mode
     try:
         import jax
     except Exception:   # noqa: BLE001 — no jax, nothing else to sanitize

@@ -53,6 +53,14 @@ RULE_SUMMARIES = {
             "census (unhandled sends / dead handler arms)",
     "R021": "npz wire-format drift: writer and reader disagree on the "
             "plane/key set",
+    "R022": "paired-protocol leak: an acquire whose release is not "
+            "proven on every path, exception edges included",
+    "R023": "control-flow exception swallowed by a broad handler on a "
+            "dispatch/serving/replay path",
+    "R024": "paired-protocol token discarded or leaked through a "
+            "returning wrapper no caller closes",
+    "R025": "traced-value control flow or callback in an exported "
+            "scorer (portable-artifact contract)",
 }
 
 

@@ -27,6 +27,7 @@ import numpy as np
 
 import h2o3_tpu
 from h2o3_tpu.analysis import divergence as _dvg
+from h2o3_tpu.analysis import leaktrack as _ltk
 from h2o3_tpu.core.frame import Frame
 from h2o3_tpu.core.jobs import Job, jobs_list
 from h2o3_tpu.core.kvstore import DKV
@@ -235,8 +236,18 @@ class _Handler(BaseHTTPRequestHandler):
         # barrier that never acks) trips a pinned diagnostic trace with
         # a cluster JStack instead of hanging silently
         from h2o3_tpu.obs import watchdog as _wd
-        with _wd.watch("rest", desc=f"{method} {self.path}", trace=tid):
-            self._route_traced(method, tid, prev_trace, t0)
+        try:
+            with _wd.watch("rest", desc=f"{method} {self.path}", trace=tid):
+                self._route_traced(method, tid, prev_trace, t0)
+        finally:
+            # leaktrack sweep: the one instant every request-scoped pair
+            # this thread opened MUST be closed again. It has to sit
+            # OUTSIDE the watchdog watch — the watch is itself a tracked
+            # scoped pair and is legitimately still open anywhere inside
+            # the with block, so an inner sweep reports a false leak on
+            # every request
+            if _ltk.active():
+                _ltk.sweep_request()
 
     def _route_traced(self, method, tid, prev_trace, t0):
         try:
@@ -325,6 +336,11 @@ class _Handler(BaseHTTPRequestHandler):
             getattr(fn, "_scores", False)
         with _tracing.request_context(principal, deadline):
             try:
+                # leaktrack (raise mode): a token that died unreleased
+                # since the last dispatch fails THIS request — loud and
+                # attributable, where the GC-thread finalizer is neither
+                if _ltk.active():
+                    _ltk.raise_if_pending()
                 # a budget that arrived already spent is shed at the
                 # edge — before params parse, broadcast or handler work
                 if _qos.enabled():
@@ -358,7 +374,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._deadline_exceeded(ex)
             finally:
                 # clear the edge-admission flag and return a prepaid
-                # charge no Job adopted (the handler 4xx'd first)
+                # charge no Job adopted (the handler 4xx'd first); the
+                # leaktrack sweep runs further out, in _route, once the
+                # watchdog watch (itself a tracked pair) has closed
                 _qos.end_request()
 
     def _dispatch_routed(self, method, path, pat, fn, groups):

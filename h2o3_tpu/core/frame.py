@@ -537,10 +537,35 @@ class UuidVec(Vec):
     NA predicates (device-side lane compares) and pass-through storage;
     arithmetic intentionally raises, as in water.fvec.Vec."""
 
-    def __init__(self, words_dev, na_dev, nrows: int):
-        self.words = words_dev              # (padded, 4) i32
-        self.na = na_dev                    # (padded,) i32 1 = NA/padding
+    def __init__(self, words, na, nrows: int):
+        # both lanes ride ONE TierChunk (data=(padded,4) word lanes,
+        # mask=(padded,) NA lane) so a UUID column demotes HBM → host
+        # i32 bytes → disk as a unit, like dense planes. "flat"
+        # placement: the (padded, 4) word matrix is not a 1-D packed
+        # plane, so the row-shard put does not apply; consumers compare
+        # whole rows and a default-device placement keeps the four
+        # lanes of each row colocated.
+        words_host = np.ascontiguousarray(np.asarray(words, np.int32))
+        na_host = np.ascontiguousarray(np.asarray(na, np.int32))
+        if _tiering.PAGER.ingest_cold:
+            words_dev = na_dev = None    # born cold: fault on first use
+        else:
+            words_dev = jnp.asarray(words_host)
+            na_dev = jnp.asarray(na_host)
+        self._uuid_chunk = _tiering.PAGER.new_chunk(
+            words_dev, na_dev, host=(words_host, na_host),
+            label="uuid_words", put="flat")
         super().__init__(None, Codec("const"), None, nrows, T_UUID)
+
+    @property
+    def words(self):
+        """(padded, 4) i32 device word lanes — faults the chunk to HBM."""
+        return self._uuid_chunk.device()[0]
+
+    @property
+    def na(self):
+        """(padded,) i32 NA lane (1 = NA/padding) — faults with words."""
+        return self._uuid_chunk.device()[1]
 
     @staticmethod
     def encode(col: np.ndarray) -> "UuidVec":
@@ -565,20 +590,21 @@ class UuidVec(Vec):
                 words[i, w] = np.int64(u - (1 << 32) if u >= (1 << 31)
                                        else u)
             na[i] = 0
-        return UuidVec(_mr.device_put_rows(words),
-                       _mr.device_put_rows(na), n)
+        return UuidVec(words, na, n)
 
     # ---- Vec surface -----------------------------------------------------
     @property
     def padded_len(self) -> int:
-        return int(self.words.shape[0])
+        return int(self._uuid_chunk.rows)   # shape read must not fault
 
     @property
     def host_data(self):
-        """Decode to an object array of uuid.UUID (on demand only)."""
+        """Decode to an object array of uuid.UUID (on demand only).
+        staging_view: decoding a demoted column must not promote it."""
         import uuid as _uuidlib
-        W = np.asarray(_mr.host_fetch(self.words))[: self.nrows]
-        na = np.asarray(_mr.host_fetch(self.na))[: self.nrows]
+        words_np, na_np = self._uuid_chunk.staging_view()
+        W = np.asarray(words_np)[: self.nrows]
+        na = np.asarray(na_np)[: self.nrows]
         out = np.empty(self.nrows, object)
         for i in range(self.nrows):
             if na[i]:
@@ -609,7 +635,9 @@ class UuidVec(Vec):
         return jnp.asarray(self.na, jnp.float32)
 
     def na_cnt(self) -> int:
-        return int(_mr.host_fetch(self.na)[: self.nrows].sum())
+        # staging_view: rollups on a demoted column must not promote it
+        na_np = self._uuid_chunk.staging_view()[1]
+        return int(np.asarray(na_np)[: self.nrows].sum())
 
     def _compute_rollups(self) -> Rollups:
         return Rollups(min=math.nan, max=math.nan, mean=math.nan,
@@ -917,15 +945,18 @@ class Frame:
         return out
 
     def _tier_on_get(self):
-        """DKV.get hook: LRU-touch this frame's chunks — numeric planes
-        AND StrVec dictionary code planes; a whole-frame spill (every
-        chunk on disk) promotes its codec bytes back to host RAM, HBM
-        faults stay lazy (raw_get never calls this). UuidVec word planes
-        and SparseVec triplets stay untiered (documented out: their
-        layouts bypass the packed-plane codecs the pager ships)."""
-        chunks = [v._chunk for v in self.vecs]
-        chunks += [v._codes_chunk for v in self.vecs
-                   if getattr(v, "_codes_chunk", None) is not None]
+        """DKV.get hook: LRU-touch this frame's chunks — numeric planes,
+        StrVec dictionary code planes, SparseVec nz planes and UuidVec
+        word lanes alike; a whole-frame spill (every chunk on disk)
+        promotes its codec bytes back to host RAM, HBM faults stay lazy
+        (raw_get never calls this)."""
+        chunks = []
+        for v in self.vecs:
+            for attr in ("_chunk", "_codes_chunk", "_nzr_chunk",
+                         "_nzv_chunk", "_uuid_chunk"):
+                ch = getattr(v, attr, None)
+                if ch is not None:
+                    chunks.append(ch)
         _tiering.PAGER.on_frame_get(chunks)
 
     def _on_remove(self):

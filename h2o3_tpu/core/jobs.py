@@ -121,9 +121,20 @@ class Job:
                 self._done.set()
 
         if background:
-            self._thread = threading.Thread(target=_run, daemon=True,
-                                            name=f"job-{self.key}")
-            self._thread.start()
+            try:
+                self._thread = threading.Thread(target=_run, daemon=True,
+                                                name=f"job-{self.key}")
+                self._thread.start()
+            except BaseException as e:
+                # Thread.start() can fail under thread exhaustion — the
+                # worker that would have released the slot in its finally
+                # never runs, so the quota charge would leak until process
+                # death (R022 class: ISSUE-17's admission double-count)
+                self.exception = e
+                self.status = FAILED
+                _qos.release_job_slot(qos_slot)
+                self._done.set()
+                raise
         else:
             _run()
         return self

@@ -391,9 +391,9 @@ class _DKV:
             codes = getattr(vec, "_codes_chunk", None)
             if codes is not None:   # StrVec dictionary code plane
                 out.append(codes)
-            for attr in ("_nzr_chunk", "_nzv_chunk"):
+            for attr in ("_nzr_chunk", "_nzv_chunk", "_uuid_chunk"):
                 nz = getattr(vec, attr, None)
-                if nz is not None:  # SparseVec nz row/value planes
+                if nz is not None:  # SparseVec nz planes / UuidVec lanes
                     out.append(nz)
         return out
 

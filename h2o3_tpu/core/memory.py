@@ -24,7 +24,10 @@ from h2o3_tpu.io import spill as _spill
 def _frame_chunks(frame):
     out = []
     for v in frame.vecs:
-        for attr in ("_chunk", "_codes_chunk"):   # StrVec code planes tier too
+        # StrVec code planes, SparseVec nz planes and UuidVec word lanes
+        # are all pageable chunks alongside the dense packed plane
+        for attr in ("_chunk", "_codes_chunk", "_nzr_chunk",
+                     "_nzv_chunk", "_uuid_chunk"):
             c = getattr(v, attr, None)
             if c is not None:
                 out.append(c)
@@ -58,8 +61,9 @@ class MemoryManager:
         """MEMORY-resident packed bytes of the frame's pageable planes
         (HBM or host RAM) — the DKV census number. Chunks whose only
         copy is a spill file contribute 0, matching the old contract
-        where spilled frames dropped out of the census. Sparse/str/uuid
-        planes carry no chunk and are not pageable (yet) — see ROADMAP."""
+        where spilled frames dropped out of the census. Str code planes,
+        sparse nz planes and uuid word lanes all count: every column
+        layout is pageable now."""
         return sum(c.nbytes for c in _frame_chunks(frame)
                    if c.tier != _tiering.TIER_DISK)
 

@@ -425,7 +425,7 @@ def predict_ensemble(X, trees: TreeArrays, weights=None):
     tw = (jnp.asarray(weights, jnp.float32) if weights is not None
           else jnp.ones(trees.ntrees, jnp.float32))
     has_cat = trees.catbits is not None and trees.col_is_cat is not None \
-        and bool(np.any(np.asarray(trees.col_is_cat)))
+        and bool(np.any(np.asarray(trees.col_is_cat)))  # h2o3-ok: R025 col_is_cat is host numpy model metadata excluded from the serving params pytree — static per artifact; the export PR hoists has_cat into artifact metadata (covers the if below)
     if has_cat:
         catbits = jnp.asarray(trees.catbits)
         iscat = jnp.asarray(np.asarray(trees.col_is_cat))

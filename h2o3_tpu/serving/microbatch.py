@@ -345,9 +345,12 @@ class MicroBatcher:
             t_gate = time.perf_counter()
             took = _qos.GATE.acquire(batch[0].principal or _qos.ANONYMOUS,
                                      total)
-            t0 = time.perf_counter()
-            gate_s = t0 - t_gate
             try:
+                # timing reads live INSIDE the try: any statement between
+                # acquire and the finally is a path that leaks the slot
+                # if it raises (R022)
+                t0 = time.perf_counter()
+                gate_s = t0 - t_gate
                 with ctx as sp, _usage.capture_stages() as shared:
                     with _usage.stage("decode"):
                         raw = np.full((bucket, C), np.nan, np.float32)

@@ -391,7 +391,7 @@ class ParamStore:
                     with self._lock:
                         p.last = self._tick()
                     return placed
-                if self._try_reserve(p.nbytes, force=forced):
+                if self._try_reserve(p.nbytes, force=forced):  # h2o3-ok: R022 the commit CONVERTS the reservation to accounted bytes (self._reserved -= nbytes, reserved=False) inside its critical section; the finally releases exactly the uncommitted case — condition-variable pairing the path analysis cannot prove
                     stale_path = None
                     replaced_epoch = False
                     reserved = True

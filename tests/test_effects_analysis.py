@@ -422,7 +422,7 @@ def test_sarif_golden_file():
 def test_sarif_covers_every_rule_and_tracks_fingerprints():
     from h2o3_tpu.analysis import sarif
     assert set(sarif.RULE_SUMMARIES) == \
-        {f"R{i:03d}" for i in range(1, 22)}
+        {f"R{i:03d}" for i in range(1, 26)}
     f = engine.Finding("R018", "h2o3_tpu/x.py", 3, "m")
     f.snippet = "DKV.put('k', v)"
     log = sarif.to_sarif([f])
@@ -465,14 +465,40 @@ def test_json_reports_per_rule_timings():
     payload = json.loads(out.stdout)
     t = payload["rule_timings_s"]
     for key in ("callgraph:index", "effects:closure", "R018", "R019",
-                "R020", "R021"):
+                "R020", "R021", "lifecycle:index", "R022+R024", "R023",
+                "R025"):
         assert key in t and t[key] >= 0, (key, sorted(t))
 
 
+def test_json_reports_per_rule_finding_counts():
+    """--json carries a by_rule histogram next to rule_timings_s, so a
+    CI trend line can watch per-rule volume without re-parsing the
+    findings array."""
+    seed = ("import jax\n"
+            "def hot(x):\n"
+            "    return jax.jit(lambda a: a + 1)(x)\n")
+    fixture = os.path.join(REPO, "h2o3_tpu", "_fx_by_rule_tmp.py")
+    try:
+        with open(fixture, "w", encoding="utf-8") as fh:
+            fh.write(seed)
+        out = subprocess.run(
+            [sys.executable, "-m", "h2o3_tpu.analysis", fixture,
+             "--rules", "R001", "--json"],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        payload = json.loads(out.stdout)
+        assert payload["by_rule"].get("R001", 0) >= 1
+        assert sum(payload["by_rule"].values()) == payload["total"]
+    finally:
+        os.unlink(fixture)
+
+
 def test_full_package_wall_time_budget():
-    """All 21 rules over the package stay under 2x the pre-effects
-    analyzer baseline (~5.3s full-package) — the four new rules ride the
-    ONE interprocedural index instead of building their own."""
+    """All 25 rules over the package stay under 2x the pre-effects
+    analyzer baseline (~5.3s full-package) — the effect rules ride the
+    ONE interprocedural index, and the lifecycle rules (R022-R025) build
+    their exception-edge CFGs lazily per flagged-candidate function
+    behind terminal-name prefilters, so the CFG pass adds ~1s, not a
+    second whole-tree walk."""
     t0 = time.perf_counter()
     engine.run(paths=[engine.package_root()], baseline_path=BASELINE)
     elapsed = time.perf_counter() - t0

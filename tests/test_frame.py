@@ -114,6 +114,55 @@ def test_uuid_device_plane():
         v.as_f32()
 
 
+def test_uuid_word_lanes_tier_roundtrip_bit_exact(tmp_path):
+    """UuidVec's word + NA lanes ride ONE pager chunk like dense planes:
+    HBM → host i32 bytes → spill file → back, with all four word lanes
+    AND the NA lane bit-identical after the full ladder (128-bit exact,
+    no dtype drift) — closes the last ROADMAP column-layout tiering gap."""
+    import uuid
+    from h2o3_tpu.core import tiering
+    from h2o3_tpu.core.frame import UuidVec
+    from h2o3_tpu.core.memory import MANAGER
+
+    old_ice = MANAGER.ice_root
+    MANAGER.ice_root = str(tmp_path)
+    try:
+        ids = [uuid.uuid4() for _ in range(17)]
+        col = np.array([None if i % 5 == 2 else str(u)
+                        for i, u in enumerate(ids)], object)
+        v = UuidVec.encode(col)
+        ch = v._uuid_chunk
+        words0 = np.asarray(ch.staging_view()[0]).copy()
+        na0 = np.asarray(ch.staging_view()[1]).copy()
+        decoded0 = list(v.host_data)
+
+        tiering.PAGER.demote(ch, tiering.TIER_HOST)
+        assert ch.tier == "host"
+        tiering.PAGER.demote(ch, tiering.TIER_DISK)
+        assert ch.tier == "disk"
+
+        # padded_len is a shape read — it must answer without faulting
+        assert v.padded_len == words0.shape[0]
+        assert ch.tier == "disk"
+
+        # staging reads reload the spill file to host RAM, never HBM
+        assert v.na_cnt() == int(na0[: v.nrows].sum())
+        words1, na1 = ch.staging_view()
+        assert np.asarray(words1).dtype == words0.dtype
+        assert np.asarray(words1).tobytes() == words0.tobytes()
+        assert np.asarray(na1).tobytes() == na0.tobytes()
+        assert list(v.host_data) == decoded0
+        assert ch.tier == "host"
+
+        # device access (equality compare) faults the lanes back to HBM
+        eq = np.asarray(v.eq(v))[: v.nrows]
+        np.testing.assert_allclose(
+            eq, (na0[: v.nrows] == 0).astype(np.float32))
+        assert ch.tier == tiering.TIER_HBM
+    finally:
+        MANAGER.ice_root = old_ice
+
+
 def test_uuid_column_parses_from_csv(tmp_path):
     import uuid
     from h2o3_tpu.io.parser import parse, parse_setup

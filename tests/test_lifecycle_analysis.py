@@ -1,0 +1,679 @@
+"""Flow-sensitive lifecycle analyzer (R022–R025) + the runtime leak
+sanitizer (analysis/leaktrack.py).
+
+Mirrors tests/test_effects_analysis.py: each rule (a) fires on a seeded
+defect reproducing its bug class, (b) stays quiet on the sanctioned fix
+shape, and (c) reports zero unsuppressed findings over the real
+package + tests tree. The runtime half gets unit coverage (tracked
+tokens, finalizer leak reports, the end-of-request sweep) plus ONE
+end-to-end agreement test: the same seeded FairGate leak is named by
+the static rule AND by the armed sanitizer, at the same source line."""
+
+import gc
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from h2o3_tpu.analysis import engine, leaktrack
+
+REPO = engine.repo_root()
+BASELINE = os.path.join(REPO, "analysis_baseline.json")
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the exception-edge CFG underneath the rules
+def _cfg_of(src):
+    import ast as _ast
+
+    from h2o3_tpu.analysis import cfg as _cfg
+    fn = _ast.parse(src).body[0]
+    return _cfg.build(fn), fn
+
+
+def _bids_at_line(g, fn, line):
+    import ast as _ast
+    out = []
+    for st in _ast.walk(fn):
+        if isinstance(st, _ast.stmt) and getattr(st, "lineno", 0) == line:
+            out.extend(g.stmt_blocks.get(id(st), ()))
+    return out
+
+
+def test_cfg_try_finally_closes_every_path():
+    g, fn = _cfg_of(
+        "def f():\n"
+        "    tok = open_it()\n"        # line 2
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        close_it(tok)\n")     # line 6
+    starts = []
+    for bid in _bids_at_line(g, fn, 2):
+        starts.extend(g.norm_succs(bid))
+    closing = frozenset(_bids_at_line(g, fn, 6))
+    assert g.escape_path(starts, closing) is None
+
+
+def test_cfg_statement_before_try_escapes_on_its_raise_edge():
+    g, fn = _cfg_of(
+        "def f():\n"
+        "    tok = open_it()\n"        # line 2
+        "    stamp = clock()\n"        # line 3 — raises past the finally
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        close_it(tok)\n")     # line 7
+    starts = []
+    for bid in _bids_at_line(g, fn, 2):
+        starts.extend(g.norm_succs(bid))
+    closing = frozenset(_bids_at_line(g, fn, 7))
+    esc = g.escape_path(starts, closing)
+    assert esc is not None
+    kind, via = esc
+    assert kind == "raise" and via == 3
+
+
+def test_cfg_finally_duplicates_onto_return_and_raise_exits():
+    """The finally body appears once per crossing exit kind — which is
+    exactly why `finally: close()` proves closure with no special-casing
+    in the rules."""
+    g, fn = _cfg_of(
+        "def f(x):\n"
+        "    try:\n"
+        "        if x:\n"
+        "            return 1\n"
+        "        work()\n"
+        "    finally:\n"
+        "        close_it()\n")        # line 7
+    assert len(_bids_at_line(g, fn, 7)) >= 2
+
+
+def test_cfg_except_handler_is_an_exception_successor():
+    g, fn = _cfg_of(
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"             # line 3
+        "    except ValueError:\n"
+        "        recover()\n"          # line 5
+        "    done()\n")
+    from h2o3_tpu.analysis import cfg as _cfg
+    handler = set(_bids_at_line(g, fn, 5))
+    # the raising stmt's exc edge must reach the handler body
+    work_bids = _bids_at_line(g, fn, 3)
+    reached = set()
+    stack = [b for bid in work_bids
+             for b, kind in g.blocks[bid].succs if kind == "exc"]
+    while stack:
+        b = stack.pop()
+        if b in reached or b in (_cfg.EXIT, _cfg.RAISE):
+            continue
+        reached.add(b)
+        stack.extend(s for s, _ in g.blocks[b].succs)
+    assert handler & reached
+
+
+def test_cfg_while_true_has_no_normal_fallthrough():
+    from h2o3_tpu.analysis import cfg as _cfg
+    g, fn = _cfg_of(
+        "def f():\n"
+        "    while True:\n"
+        "        spin()\n")
+    # no normal-edge path from entry reaches EXIT (only RAISE escapes)
+    assert g.escape_path([g.entry], frozenset()) == ("raise", 3)
+
+
+# ---------------------------------------------------------------------------
+# R022 — paired-protocol leak on an exception edge.
+# The seeded shape is the microbatch bug this PR fixed: a statement
+# BETWEEN the acquire and the try/finally — a path that leaks the slot
+# when it raises.
+R022_SEED = {
+    "h2o3_tpu/fx22/mb.py": (
+        "import time\n"
+        "from h2o3_tpu.serving import qos as _qos\n"
+        "def dispatch(batch, total):\n"
+        "    took = _qos.GATE.acquire('p', total)\n"
+        "    t0 = time.perf_counter()\n"
+        "    try:\n"
+        "        return len(batch)\n"
+        "    finally:\n"
+        "        _qos.GATE.release(took)\n"),
+}
+
+
+def test_r022_flags_statement_between_acquire_and_finally():
+    found = [f for f in engine.analyze_sources(R022_SEED)
+             if f.rule == "R022"]
+    assert len(found) == 1, [str(f) for f in found]
+    assert found[0].line == 4          # the acquire, not the finally
+    assert "EVERY path" in found[0].message
+
+
+def test_r022_clean_when_try_follows_immediately():
+    srcs = {"h2o3_tpu/fx22b/mb.py": R022_SEED[
+        "h2o3_tpu/fx22/mb.py"].replace(
+        "    t0 = time.perf_counter()\n    try:\n",
+        "    try:\n        t0 = time.perf_counter()\n")}
+    assert "R022" not in _rules_of(engine.analyze_sources(srcs))
+
+
+def test_r022_clean_with_falsy_guard_before_try():
+    srcs = {
+        "h2o3_tpu/fx22c/mb.py": (
+            "from h2o3_tpu.serving import qos as _qos\n"
+            "def dispatch(total):\n"
+            "    took = _qos.GATE.acquire('p', total)\n"
+            "    if not took:\n"
+            "        return 0\n"          # unacquired: owes no release
+            "    try:\n"
+            "        return 1\n"
+            "    finally:\n"
+            "        _qos.GATE.release(took)\n"),
+    }
+    assert "R022" not in _rules_of(engine.analyze_sources(srcs))
+
+
+def test_r022_flags_branch_path_leak_inside_try():
+    """The compound-statement regression: a release buried in ONE branch
+    of an if must not count as closing the else path."""
+    srcs = {
+        "h2o3_tpu/fx22d/mb.py": (
+            "from h2o3_tpu.serving import qos as _qos\n"
+            "def dispatch(total, fast):\n"
+            "    took = _qos.GATE.acquire('p', total)\n"
+            "    if fast:\n"
+            "        _qos.GATE.release(took)\n"
+            "        return 1\n"
+            "    return 0\n"),            # else path: slot leaks
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R022"]
+    assert len(found) == 1 and found[0].line == 3
+
+
+def test_r022_suppression_and_test_relaxation():
+    srcs = {"h2o3_tpu/fx22e/mb.py": R022_SEED[
+        "h2o3_tpu/fx22/mb.py"].replace(
+        "    took = _qos.GATE.acquire('p', total)\n",
+        "    took = _qos.GATE.acquire('p', total)"
+        "  # h2o3-ok: R022 fixture: timing read cannot raise\n")}
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R022"]
+    assert len(found) == 1 and found[0].suppressed
+    relaxed = {"tests/test_fx22.py": R022_SEED["h2o3_tpu/fx22/mb.py"]}
+    assert "R022" not in _rules_of(engine.analyze_sources(relaxed))
+
+
+def test_r022_gauge_without_remove_is_flagged():
+    """The ISSUE-11 ghost-series class: labeled .set with no .remove."""
+    seed = {
+        "h2o3_tpu/fx22g/obs.py": (
+            "from h2o3_tpu.obs.metrics import gauge\n"
+            "G = gauge('h2o3_fx22_depth', 'fixture per-entity gauge')\n"
+            "def on_update(key, n):\n"
+            "    G.set(n, key=key)\n"),
+    }
+    found = [f for f in engine.analyze_sources(seed) if f.rule == "R022"]
+    assert len(found) == 1
+    assert "ghost series" in found[0].message
+    fixed = {"h2o3_tpu/fx22h/obs.py": seed[
+        "h2o3_tpu/fx22g/obs.py"] + (
+        "def on_delete(key):\n"
+        "    G.remove(key=key)\n")}
+    assert "R022" not in _rules_of(engine.analyze_sources(fixed))
+
+
+# ---------------------------------------------------------------------------
+# R023 — swallowed control-flow exceptions on a serving path.
+R023_SEED = {
+    "h2o3_tpu/serving/fx23.py": (
+        "from h2o3_tpu.serving.qos import RateLimited\n"
+        "def admit(principal):\n"
+        "    if principal == 'flood':\n"
+        "        raise RateLimited('p', 1.0)\n"
+        "def handle(req):\n"
+        "    try:\n"
+        "        admit(req['principal'])\n"
+        "    except Exception:\n"
+        "        return None\n"),          # 429 becomes a silent 200
+}
+
+
+def test_r023_flags_broad_swallow_of_control_exception():
+    found = [f for f in engine.analyze_sources(R023_SEED)
+             if f.rule == "R023"]
+    assert len(found) == 1, [str(f) for f in found]
+    assert found[0].line == 8
+    assert "RateLimited" in found[0].message
+
+
+def test_r023_clean_with_typed_arm_or_reraise():
+    base = R023_SEED["h2o3_tpu/serving/fx23.py"]
+    typed = {"h2o3_tpu/serving/fx23b.py": base.replace(
+        "    except Exception:\n",
+        "    except RateLimited:\n"
+        "        raise\n"
+        "    except Exception:\n")}
+    assert "R023" not in _rules_of(engine.analyze_sources(typed))
+    reraise = {"h2o3_tpu/serving/fx23c.py": base.replace(
+        "    except Exception:\n        return None\n",
+        "    except Exception as e:\n"
+        "        if isinstance(e, RateLimited):\n"
+        "            raise\n"
+        "        return None\n")}
+    assert "R023" not in _rules_of(engine.analyze_sources(reraise))
+
+
+def test_r023_quiet_when_no_control_exception_can_arrive():
+    """A loop swallowing socket errors owes nothing — the filter only
+    fires where the try body can actually raise a typed control
+    exception."""
+    srcs = {
+        "h2o3_tpu/serving/fx23d.py": (
+            "def heartbeat(sock):\n"
+            "    try:\n"
+            "        sock.send(b'ping')\n"
+            "    except Exception:\n"
+            "        return False\n"
+            "    return True\n"),
+    }
+    assert "R023" not in _rules_of(engine.analyze_sources(srcs))
+
+
+def test_r023_out_of_scope_paths_are_quiet():
+    srcs = {"h2o3_tpu/fx23e/util.py": R023_SEED[
+        "h2o3_tpu/serving/fx23.py"]}    # not api//serving//deploy/
+    assert "R023" not in _rules_of(engine.analyze_sources(srcs))
+
+
+# ---------------------------------------------------------------------------
+# R024 — leaked-return protocols.
+def test_r024_flags_discarded_token():
+    srcs = {
+        "h2o3_tpu/fx24/jobs.py": (
+            "from h2o3_tpu.serving import qos as _qos\n"
+            "def submit(job):\n"
+            "    _qos.acquire_job_slot()\n"      # token dropped on floor
+            "    return job\n"),
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R024"]
+    assert len(found) == 1 and found[0].line == 3
+    assert "DISCARDED" in found[0].message
+
+
+def test_r024_flags_returner_wrapper_whose_caller_leaks():
+    srcs = {
+        "h2o3_tpu/fx24b/jobs.py": (
+            "from h2o3_tpu.serving import qos as _qos\n"
+            "def take_slot():\n"
+            "    return _qos.acquire_job_slot()\n"   # ownership handed up
+            "def submit(job):\n"
+            "    take_slot()\n"                      # ...and dropped
+            "    return job\n"),
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R024"]
+    assert found, "wrapper caller discarding the token must be flagged"
+    assert all(f.file == "h2o3_tpu/fx24b/jobs.py" for f in found)
+
+
+def test_r024_clean_when_caller_closes():
+    srcs = {
+        "h2o3_tpu/fx24c/jobs.py": (
+            "from h2o3_tpu.serving import qos as _qos\n"
+            "def take_slot():\n"
+            "    return _qos.acquire_job_slot()\n"
+            "def submit(job):\n"
+            "    tok = take_slot()\n"
+            "    try:\n"
+            "        return job\n"
+            "    finally:\n"
+            "        _qos.release_job_slot(tok)\n"),
+    }
+    assert "R024" not in _rules_of(engine.analyze_sources(srcs))
+
+
+# ---------------------------------------------------------------------------
+# R025 — export contract for the scoring programs.
+def test_r025_flags_callback_in_scorer():
+    srcs = {
+        "h2o3_tpu/fx25/score.py": (
+            "import jax\n"
+            "def _score_with_params(params, X):\n"
+            "    jax.pure_callback(lambda a: a, X, X)\n"
+            "    return X\n"),
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R025"]
+    assert len(found) == 1 and found[0].line == 3
+    assert "host callback" in found[0].message
+
+
+def test_r025_flags_concretization_and_traced_branch():
+    srcs = {
+        "h2o3_tpu/fx25b/score.py": (
+            "def _score_with_params(params, X):\n"
+            "    lo = float(X)\n"                   # concretizes
+            "    if X > 0:\n"                       # traced branch
+            "        return lo\n"
+            "    return 0.0\n"),
+    }
+    found = sorted(f.line for f in engine.analyze_sources(srcs)
+                   if f.rule == "R025")
+    assert found == [2, 3], found
+
+
+def test_r025_flags_module_device_const_capture():
+    srcs = {
+        "h2o3_tpu/fx25c/score.py": (
+            "import jax.numpy as jnp\n"
+            "BIAS = jnp.zeros((4,))\n"
+            "def _score_with_params(params, X):\n"
+            "    return X + BIAS\n"),
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R025"]
+    assert len(found) == 1 and found[0].line == 4
+    assert "params pytree" in found[0].message
+
+
+def test_r025_static_shapes_are_exempt():
+    """Shape reads, `is None`, string-config dispatch and jit
+    static_argnames are all concrete under trace — zero findings."""
+    srcs = {
+        "h2o3_tpu/fx25d/score.py": (
+            "import functools\n"
+            "import jax\n"
+            "@functools.partial(jax.jit, static_argnames=('link',))\n"
+            "def _score_with_params(params, X, link, offset=None):\n"
+            "    if offset is None:\n"
+            "        n = int(X.shape[0])\n"
+            "    if link == 'logit':\n"
+            "        return X * 2\n"
+            "    if link in ('identity', 'log'):\n"
+            "        return X\n"
+            "    return X + 1\n"),
+    }
+    assert "R025" not in _rules_of(engine.analyze_sources(srcs))
+
+
+def test_r025_reaches_scorer_helpers_through_calls():
+    srcs = {
+        "h2o3_tpu/fx25e/score.py": (
+            "def _linkapply(eta):\n"
+            "    if eta > 0:\n"                     # traced branch
+            "        return eta\n"
+            "    return -eta\n"
+            "def _score_with_params(params, X):\n"
+            "    return _linkapply(X)\n"),
+    }
+    found = [f for f in engine.analyze_sources(srcs) if f.rule == "R025"]
+    assert len(found) == 1 and found[0].line == 2
+
+
+# ---------------------------------------------------------------------------
+# the PR gate: lifecycle rules at zero unsuppressed over package + tests
+def test_package_and_tests_zero_unsuppressed_for_lifecycle_rules():
+    findings = engine.run(paths=[engine.package_root(),
+                                 engine.tests_root()],
+                          baseline_path=BASELINE,
+                          rules=["R022", "R023", "R024", "R025"])
+    bad = engine.unsuppressed(findings)
+    assert not bad, "\n".join(str(f) for f in bad)
+
+
+def test_cli_exits_1_on_seeded_r022_and_r025(tmp_path):
+    """Acceptance: the CLI entry point fails on a seeded exception-path
+    leak and on a seeded callback-in-scorer."""
+    for rel, src, rule in (
+            ("h2o3_tpu/fx_cli22.py", R022_SEED["h2o3_tpu/fx22/mb.py"],
+             "R022"),
+            ("h2o3_tpu/fx_cli25.py",
+             "import jax\n"
+             "def _score_with_params(params, X):\n"
+             "    jax.pure_callback(lambda a: a, X, X)\n"
+             "    return X\n", "R025")):
+        path = tmp_path / os.path.basename(rel)
+        path.write_text(src)
+        out = subprocess.run(
+            [sys.executable, "-m", "h2o3_tpu.analysis", str(path),
+             "--rules", rule],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        assert out.returncode == 1, (rule, out.stdout + out.stderr)
+        assert rule in out.stdout
+
+
+# ===========================================================================
+# runtime half — analysis/leaktrack.py
+@pytest.fixture
+def armed():
+    leaktrack.enable("raise")
+    yield leaktrack
+    leaktrack.disable()
+
+
+def test_env_mode_mapping(monkeypatch):
+    for raw, want in [("", ""), ("0", ""), ("off", ""), ("False", ""),
+                      ("log", "log"), ("1", "raise"),
+                      ("raise", "raise"), ("on", "raise")]:
+        monkeypatch.setenv("H2O3_LEAKTRACK", raw)
+        assert leaktrack.env_mode() == want, raw
+    monkeypatch.delenv("H2O3_LEAKTRACK")
+    assert leaktrack.env_mode() == ""
+
+
+def test_token_release_cycle_leaves_nothing_open(armed):
+    from h2o3_tpu.serving import qos as _qos
+    took = _qos.GATE.acquire("lt_unit", 1)
+    assert took                       # truthiness delegates through
+    assert armed.open_counts().get("qos.gate") == 1
+    _qos.GATE.release(took)
+    assert "qos.gate" not in armed.open_counts()
+    assert armed.reports() == []
+    armed.raise_if_pending()          # nothing pending
+
+
+def test_dead_token_reports_acquisition_site(armed):
+    from h2o3_tpu.serving import qos as _qos
+    took = _qos.GATE.acquire("lt_leak", 1)
+    assert took
+    site = took.site
+    del took                          # dies unreleased
+    gc.collect()
+    reps = armed.reports()
+    assert ("qos.gate", site) in reps
+    assert __file__ in site           # names the caller, not leaktrack
+    with pytest.raises(leaktrack.LeakError) as ei:
+        armed.raise_if_pending()
+    assert "qos.gate" in str(ei.value)
+    armed.raise_if_pending()          # consumed: second call is a no-op
+    # the gate itself was NOT leaked a slot: the finalizer only reports,
+    # so drain the real slot to leave the singleton clean
+    _qos.GATE.release(True)
+
+
+def test_log_mode_counts_but_never_raises():
+    leaktrack.enable("log")
+    try:
+        from h2o3_tpu.serving import qos as _qos
+        took = _qos.GATE.acquire("lt_log", 1)
+        assert took
+        del took
+        gc.collect()
+        assert leaktrack.reports()
+        leaktrack.raise_if_pending()      # log mode: nothing pending
+        _qos.GATE.release(True)
+    finally:
+        leaktrack.disable()
+
+
+def test_request_scope_sweep_flags_unfinished_usage(armed):
+    from h2o3_tpu.obs import usage as _usage
+    _usage.begin_request()
+    assert armed.open_counts().get("usage.request") == 1
+    armed.sweep_request()
+    assert ("usage.request", "<request scope>") in armed.reports()
+    assert "usage.request" not in armed.open_counts()
+    with pytest.raises(leaktrack.LeakError):
+        armed.raise_if_pending()
+    _usage.clear_request()
+
+
+def test_request_scope_clean_when_finished(armed):
+    from h2o3_tpu.obs import usage as _usage
+    _usage.begin_request()
+    _usage.finish_request()
+    armed.sweep_request()
+    assert armed.reports() == []
+
+
+def test_disable_restores_wrapped_functions():
+    from h2o3_tpu.serving import qos as _qos
+    before = _qos.FairGate.acquire
+    leaktrack.enable("raise")
+    assert _qos.FairGate.acquire is not before
+    leaktrack.disable()
+    assert _qos.FairGate.acquire is before
+    assert not leaktrack.active()
+
+
+def test_open_gauge_series_registered(armed):
+    from h2o3_tpu.obs import metrics as _om
+    from h2o3_tpu.serving import qos as _qos
+    took = _qos.GATE.acquire("lt_gauge", 1)
+    text = _om.REGISTRY.prometheus_text()
+    assert 'h2o3_leaktrack_open{pair="qos.gate"} 1' in text
+    _qos.GATE.release(took)
+
+
+# ---------------------------------------------------------------------------
+# e2e: static rule and runtime sanitizer agree on the SAME seeded leak
+E2E_SRC = (
+    "from h2o3_tpu.serving import qos as _qos\n"
+    "def _validate(rows):\n"
+    "    if rows < 0:\n"
+    "        raise ValueError('bad rows')\n"
+    "def leaky_dispatch(rows):\n"
+    "    took = _qos.GATE.acquire('fx_e2e', rows)\n"
+    "    _validate(rows)\n"
+    "    _qos.GATE.release(took)\n"
+    "    return rows\n")
+
+
+def test_e2e_static_and_runtime_name_the_same_leak(tmp_path):
+    """The acceptance proof that the two halves compose: R022 flags the
+    acquire whose release is skipped on the ValueError edge, and the
+    armed sanitizer, driving that exact code, reports the leak at the
+    SAME file:line the static finding points at."""
+    # static half: the finding names the acquire line
+    found = [f for f in engine.analyze_sources(
+        {"h2o3_tpu/fxe2e/mb.py": E2E_SRC}) if f.rule == "R022"]
+    assert len(found) == 1
+    static_line = found[0].line
+    assert static_line == 6
+
+    # runtime half: execute the SAME source with leaktrack armed and
+    # drive the exception path the static rule proved leaky
+    path = tmp_path / "fxe2e_mb.py"
+    path.write_text(E2E_SRC)
+    ns: dict = {"__name__": "fxe2e_mb", "__file__": str(path)}
+    exec(compile(E2E_SRC, str(path), "exec"), ns)
+    leaktrack.enable("raise")
+    try:
+        with pytest.raises(ValueError):
+            ns["leaky_dispatch"](-1)
+        gc.collect()                   # the abandoned token dies here
+        reps = leaktrack.reports()
+        assert reps, "runtime sanitizer missed the seeded leak"
+        pair, site = reps[-1]
+        assert pair == "qos.gate"
+        assert site == f"{path}:{static_line}"
+        with pytest.raises(leaktrack.LeakError):
+            leaktrack.raise_if_pending()
+        from h2o3_tpu.serving import qos as _qos
+        _qos.GATE.release(True)        # drain the leaked real slot
+    finally:
+        leaktrack.disable()
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the real leaks this PR's triage fixed
+def test_job_slot_released_when_thread_start_fails(monkeypatch):
+    """jobs.py: Thread.start() failing under thread exhaustion must
+    release the admission charge — the worker finally never runs."""
+    from h2o3_tpu.core import jobs as _jobs
+    from h2o3_tpu.serving import qos as _qos
+
+    released = []
+    monkeypatch.setattr(_qos, "adopt_prepaid_job_slot", lambda: None)
+    monkeypatch.setattr(_qos, "acquire_job_slot", lambda: "slot-fx")
+    monkeypatch.setattr(_qos, "release_job_slot",
+                        lambda tok: released.append(tok))
+
+    class _BoomThread:
+        def __init__(self, *a, **k):
+            pass
+
+        def start(self):
+            raise RuntimeError("can't start new thread")
+
+    job = _jobs.Job("fx thread exhaustion")
+    monkeypatch.setattr(_jobs.threading, "Thread", _BoomThread)
+    with pytest.raises(RuntimeError):
+        job.start(lambda j: None, background=True)
+    assert released == ["slot-fx"]
+    assert job.status == _jobs.FAILED
+    assert isinstance(job.exception, RuntimeError)
+    assert job._done.is_set()          # wait()ers are not wedged
+
+
+def test_microbatch_gate_timing_lives_inside_try():
+    """microbatch.py: no statement may sit between GATE.acquire and the
+    protecting try — assert the fixed shape statically so the leak
+    cannot quietly come back."""
+    import ast as _ast
+    path = os.path.join(REPO, "h2o3_tpu", "serving", "microbatch.py")
+    with open(path, encoding="utf-8") as fh:
+        tree = _ast.parse(fh.read())
+    for fn in _ast.walk(tree):
+        if not isinstance(fn, _ast.FunctionDef):
+            continue
+        body_seqs = [n.body for n in _ast.walk(fn)
+                     if hasattr(n, "body") and isinstance(
+                         getattr(n, "body"), list)]
+        for seq in body_seqs:
+            for i, stmt in enumerate(seq):
+                src = _ast.dump(stmt)
+                if "GATE" in src and "acquire" in src \
+                        and isinstance(stmt, _ast.Assign):
+                    nxt = seq[i + 1] if i + 1 < len(seq) else None
+                    assert isinstance(nxt, _ast.Try), \
+                        "statement between GATE.acquire and try"
+
+
+def test_rest_request_sweep_runs_outside_watchdog_watch(armed):
+    """Regression: the end-of-request leaktrack sweep must run AFTER the
+    watchdog watch closes. The watch is itself a tracked scoped pair and
+    is legitimately open anywhere inside its with block — a sweep placed
+    inside it (the original placement, in _route_with_qos's finally)
+    reported a false 'watchdog.watch' leak on EVERY request."""
+    import urllib.request
+    from h2o3_tpu.api.server import H2OServer
+    s = H2OServer(port=0).start()
+    try:
+        for _ in range(2):      # second request also proves raise mode
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{s.port}/3/Cloud", timeout=30) as r:
+                assert r.status == 200
+        assert armed.reports() == []
+        # the watch exit + sweep land a hair AFTER the response bytes hit
+        # the socket (same class as the QoS latency observe) — poll
+        deadline = time.monotonic() + 5.0
+        while armed.open_counts() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert armed.open_counts() == {}
+        assert armed.reports() == []
+    finally:
+        s.stop()
