@@ -419,6 +419,23 @@ def scoring_bench() -> dict:
             dt_led_on = dt
             device_seconds = _usage.device_seconds_total() - d0
     _usage.set_enabled(None)             # back to the env default
+    # drift-monitor overhead (ISSUE 20): the SAME warm traced loop with
+    # the modelmon serving tap forced OFF vs ON. The tap self-bounds —
+    # one fold sees at most H2O3_MODELMON_TAP_ROWS stride-sampled rows
+    # and the duty-cycle throttle defers the next fold until the
+    # measured fold time amortizes under H2O3_MODELMON_TAP_PCT of wall
+    # — so the bound matches the ledger's: <1% on >=2 cores.
+    from h2o3_tpu.obs import modelmon as _mm
+    dt_mon_off = dt_mon_on = float("inf")
+    for _ in range(5):
+        tracing.set_current(tracing.new_trace_id())
+        _mm.set_enabled(False)
+        dt, out = timed_loop()
+        dt_mon_off = min(dt_mon_off, dt)
+        _mm.set_enabled(True)
+        dt, out = timed_loop()
+        dt_mon_on = min(dt_mon_on, dt)
+    _mm.set_enabled(None)                # back to the env default
     tracing.set_current(prev_trace)
     assert out is not None and len(out) >= batch
     warm_compiles = om.xla_compile_count() - c0
@@ -426,6 +443,8 @@ def scoring_bench() -> dict:
     overhead_pct = 100.0 * (dt_on - dt_off) / dt_off
     logging_overhead_pct = 100.0 * (dt_log - dt_on) / dt_on
     attribution_overhead_pct = 100.0 * (dt_led_on - dt_led_off) / dt_led_off
+    drift_monitor_overhead_pct = 100.0 * (dt_mon_on - dt_mon_off) \
+        / dt_mon_off
     devices = _jax.local_device_count()
     utilization_pct = (100.0 * device_seconds / (dt_led_on * devices)
                        if dt_led_on > 0 else 0.0)
@@ -459,9 +478,14 @@ def scoring_bench() -> dict:
            # share of wall time across the local devices
            "device_seconds": round(device_seconds, 4),
            "utilization_pct": round(utilization_pct, 2),
-           "attribution_overhead_pct": round(attribution_overhead_pct, 2)}
+           "attribution_overhead_pct": round(attribution_overhead_pct, 2),
+           # drift observability (ISSUE 20): the serving tap's warm-path
+           # cost — live-sketch folds per dispatch vs the tap disabled
+           "drift_monitor_overhead_pct":
+               round(drift_monitor_overhead_pct, 2)}
     if (overhead_pct > 5.0 or logging_overhead_pct > 1.0
-            or attribution_overhead_pct > 1.0) and cores < 2:
+            or attribution_overhead_pct > 1.0
+            or drift_monitor_overhead_pct > 1.0) and cores < 2:
         # structured bound-waiver (ISSUE 14 satellite): with one physical
         # core the instrumented and baseline loops time-slice against
         # every background thread in the process, so the <5%/<1% bounds
@@ -471,7 +495,8 @@ def scoring_bench() -> dict:
                      "against drain/GC threads; bounds need >=2 cores "
                      "(r06/r07 measured 0.09%/0.47% on 2 cores)",
             "bounds": {"tracing_pct": 5.0, "logging_pct": 1.0,
-                       "attribution_pct": 1.0}}
+                       "attribution_pct": 1.0,
+                       "drift_monitor_pct": 1.0}}
     for k in (fr.key, sf.key, m.key):
         DKV.remove(k)
     return rec

@@ -129,6 +129,19 @@ class H2OClient:
         return self.request("DELETE", path, params or None,
                             deadline_ms=deadline_ms)
 
+    # ---- named observability helpers -------------------------------------
+    def model_monitor(self, model: str, deadline_ms=None):
+        """GET /3/ModelMonitor/{model} — baseline-vs-live distribution
+        profiles and drift scores for one monitored model, cluster-merged
+        server-side. Same retry/deadline semantics as every other call."""
+        return self.get(f"/3/ModelMonitor/{urllib.parse.quote(model)}",
+                        deadline_ms=deadline_ms)
+
+    def alerts(self, deadline_ms=None):
+        """GET /3/Alerts — declared SLOs, live burn rates and per-SLO
+        alert states (latency, availability and drift SLIs alike)."""
+        return self.get("/3/Alerts", deadline_ms=deadline_ms)
+
     # ---- core ------------------------------------------------------------
     def _backoff_s(self, attempt: int, retry_after) -> float:
         """Capped exponential with full jitter; a server Retry-After hint

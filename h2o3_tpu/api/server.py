@@ -495,7 +495,8 @@ def _is_obs_path(path: str) -> bool:
                     "/3/Profiler", "/3/Traces", "/3/Alerts",
                     "/3/JStack", "/3/Usage", "/3/CloudHealth") \
         or path.startswith("/3/Logs") or path.startswith("/3/Trace/") \
-        or path.startswith("/3/Cloud/")
+        or path.startswith("/3/Cloud/") \
+        or path.startswith("/3/ModelMonitor/")
 
 
 def _json_default(o):
@@ -1291,6 +1292,36 @@ def _h_cloudhealth(h: _Handler):
     h._send(body)
 
 
+def _h_model_monitor(h: _Handler, mid):
+    """GET /3/ModelMonitor/{model} — baseline-vs-live distribution
+    profiles and drift scores for one monitored model, merged
+    cluster-wide over the `modelmon:` collect op: every host ships its
+    integer count sketches, the coordinator folds them and scores ONCE
+    over the sums, so host count and merge order never change a drift
+    score bit-for-bit. Lagging workers are absorbed within the collect
+    deadline like every other obs merge."""
+    from h2o3_tpu.obs import modelmon as _mm
+    snaps = [_mm.snapshot(mid)]
+    lagging = []
+    bc = getattr(h.server, "broadcaster", None)
+    if bc is not None:
+        for i, remote in enumerate(
+                bc.collect(f"modelmon:{mid}",
+                           timeout=_collect_timeout())):
+            if isinstance(remote, dict):
+                snaps.append(remote)
+            elif remote is None:
+                lagging.append(i + 1)
+    body = _mm.merged_report(mid, [s for s in snaps if s is not None])
+    if not body.get("monitored"):
+        from h2o3_tpu.core.kvstore import DKV
+        if DKV.get(mid) is None:
+            return h._error(f"model {mid} not found", 404)
+    body["__meta"] = {"schema_type": "ModelMonitorV3"}
+    body["lagging_hosts"] = lagging
+    h._send(body)
+
+
 def _cluster_metric_snapshots(h: _Handler):
     """[(host, registry-snapshot)] for every answering host, local first.
     A lagging worker is absorbed within the collect deadline: its slot is
@@ -1504,6 +1535,7 @@ ROUTES = [
     (re.compile(r"/3/Alerts"), "GET", _h_alerts),
     (re.compile(r"/3/Usage"), "GET", _h_usage),
     (re.compile(r"/3/CloudHealth"), "GET", _h_cloudhealth),
+    (re.compile(r"/3/ModelMonitor/([^/]+)"), "GET", _h_model_monitor),
     (re.compile(r"/metrics"), "GET", _h_metrics),
     (re.compile(r"/3/WaterMeter"), "GET", _h_watermeter),
     (re.compile(r"/3/Profiler"), "POST", _h_profiler),

@@ -689,6 +689,17 @@ def _collect_local(op: str):
             from h2o3_tpu.obs import profiler as _prof
             from h2o3_tpu.obs import timeline as _tl
             return {"host": _tl.host_id(), **(_prof.collect_op(op) or {})}
+        if op.startswith("modelmon:"):
+            # GET /3/ModelMonitor/{model} cluster merge: this host's
+            # live drift sketches for ONE model (integer counts — the
+            # coordinator's fold is order-independent). A host that
+            # does not monitor the model answers a bare marker so it
+            # is never mistaken for a lagging worker.
+            from h2o3_tpu.obs import modelmon as _mm
+            from h2o3_tpu.obs import timeline as _tl
+            mid = op[len("modelmon:"):]
+            return _mm.snapshot(mid) or {"host": _tl.host_id(),
+                                         "model": mid, "live": None}
     except Exception:   # noqa: BLE001 — a worker probe error must not kill the loop
         import traceback
         traceback.print_exc()

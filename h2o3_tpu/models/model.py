@@ -445,6 +445,13 @@ class ModelBase:
 
         job.start(work, background=False)
         job.join()
+        # drift baseline: profile the training distribution (features +
+        # predictions) and register the model for live monitoring BEFORE
+        # publish, so a retrain rotates generations before any request
+        # can score the new one (modelmon owns the try/except — a failed
+        # profile must never fail the train)
+        from h2o3_tpu.obs import modelmon as _modelmon
+        _modelmon.install_baseline(self, frame)
         DKV.put(self.key, self)
         # optional serving pre-warm on publish (H2O3_SCORER_PREWARM=1):
         # compile the most common row bucket in the background so the
@@ -534,10 +541,34 @@ class ModelBase:
             serving.CACHE.invalidate_key(self.key)
         except Exception:   # noqa: BLE001 — removal must not fail the DKV op
             pass
+        # per-model observability series leave /metrics exactly once:
+        # drift sketches + gauges (modelmon) and the usage ledger's
+        # attribution rows/counters. Both are idempotent no-ops when the
+        # model was never monitored/charged.
+        try:
+            from h2o3_tpu.obs import modelmon as _mm
+            _mm.forget(self.key)
+        except Exception:   # noqa: BLE001
+            pass
+        try:
+            from h2o3_tpu.obs import usage as _usage
+            _usage.forget_model(self.key)
+        except Exception:   # noqa: BLE001
+            pass
 
-    # a retrain overwriting this key is the same lifecycle event: the
-    # old generation's tiers are freed once (kvstore put's replace hook)
-    _on_replace = _on_remove
+    def _on_replace(self):
+        """A retrain overwriting this key frees the old generation's
+        serving tiers like a remove — but KEEPS the monitoring series:
+        modelmon retains the outgoing generation's live sketch for the
+        shadow-compare (rotation happened in install_baseline), and the
+        usage ledger keeps attributing to the key across generations."""
+        if not self.key:
+            return
+        try:
+            from h2o3_tpu import serving
+            serving.CACHE.invalidate_key(self.key)
+        except Exception:   # noqa: BLE001 — removal must not fail the DKV op
+            pass
 
     def _score_with_params(self, params, X):
         """_score_matrix with `params` (a `_serving_params()`-shaped

@@ -75,6 +75,14 @@ class Counter(_Metric):
         with self._lock:
             return self._series.get(_label_key(labels), 0.0)
 
+    def remove(self, **labels):
+        """Drop one label series — the per-entity hygiene discipline
+        (see Gauge.remove): a deleted model's counters must leave
+        /metrics entirely, not linger as frozen series. Scrapers see a
+        counter reset, which Prometheus-style rate() already handles."""
+        with self._lock:
+            self._series.pop(_label_key(labels), None)
+
     def _expose(self) -> list:
         with self._lock:
             items = sorted(self._series.items())

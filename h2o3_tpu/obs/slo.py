@@ -27,13 +27,29 @@ Outputs:
 
 SLO spec fields (JSON object per SLO):
   name          unique id (required)
-  metric        histogram name (default "h2o3_rest_request_seconds")
+  kind          "" (infer latency/availability from threshold_ms) or
+                "drift" — a model-drift SLI over the modelmon gauges
+  metric        histogram name (default "h2o3_rest_request_seconds");
+                for kind=drift a GAUGE name (default "h2o3_model_drift",
+                also works against h2o3_model_prediction_drift /
+                h2o3_model_generation_skew)
   route         regex matched against the series' route label ("" = all)
+  model         drift SLOs: regex over the series' model label ("" = all)
   objective     good-event fraction target, e.g. 0.99 (required)
   threshold_ms  latency SLO: observations over this are bad; omit for an
                 availability SLO (bad = series with a 5xx status label)
+  threshold     drift SLO: gauge value (PSI/JS) above which an
+                evaluation tick is bad (default 0.2)
   windows       [[short_s, long_s, burn_factor], ...] (default
                 [[300, 3600, 14.4], [1800, 21600, 6.0]])
+
+A drift SLI reads the modelmon gauges through the same sample ring as
+every other SLI: the gauges are LEVELS, not event counts, so each
+evaluation tick contributes one synthetic observation per matching
+series (bad when the level exceeds `threshold`) to an engine-held
+cumulative counter — the multi-window burn machinery then applies
+unchanged, and a drifting model fires at GET /3/Alerts with a pinned
+flight-recorder trace exactly like a latency breach.
 
 Durability: the sample ring is periodically persisted to
 `<ice_root>/obs/slo/samples-h<host>.json` and reloaded on start, so
@@ -81,8 +97,19 @@ def _window_label(seconds: float) -> str:
 class SLOSpec:
     def __init__(self, d: dict):
         self.name = str(d["name"])
-        self.metric = str(d.get("metric") or "h2o3_rest_request_seconds")
+        self.kind = str(d.get("kind") or "")
+        if self.kind not in ("", "drift"):
+            raise ValueError(f"slo {self.name}: unknown kind "
+                             f"{self.kind!r} (expected '' or 'drift')")
+        self.metric = str(d.get("metric") or (
+            "h2o3_model_drift" if self.kind == "drift"
+            else "h2o3_rest_request_seconds"))
         self.route = str(d.get("route") or "")
+        # drift SLOs: scope to models whose key matches, and call a tick
+        # bad when the drift gauge exceeds `threshold` (PSI/JS units)
+        self.model = str(d.get("model") or "")
+        self.threshold = float(d["threshold"]) if "threshold" in d \
+            else (0.2 if self.kind == "drift" else None)
         # per-tenant SLOs (multi-tenant QoS): a `principal` regex scopes
         # the SLI to series whose principal label matches — point the
         # spec at h2o3_qos_request_seconds{principal,status} and the
@@ -101,6 +128,7 @@ class SLOSpec:
         self._route_re = re.compile(self.route) if self.route else None
         self._principal_re = re.compile(self.principal) \
             if self.principal else None
+        self._model_re = re.compile(self.model) if self.model else None
 
     @property
     def budget(self) -> float:
@@ -109,11 +137,14 @@ class SLOSpec:
     def to_dict(self) -> dict:
         return {"name": self.name, "metric": self.metric,
                 "route": self.route, "principal": self.principal,
+                "model": self.model,
                 "objective": self.objective,
                 "threshold_ms": self.threshold_ms,
+                "threshold": self.threshold,
                 "windows": [list(w) for w in self.windows],
-                "kind": "latency" if self.threshold_ms is not None
-                        else "availability"}
+                "kind": self.kind or
+                        ("latency" if self.threshold_ms is not None
+                         else "availability")}
 
 
 def load_specs(path: str) -> list:
@@ -156,6 +187,7 @@ class SLOEngine:
         self._specs: list = list(specs or [])
         self._samples: dict = {}    # name -> deque[(ts, total, bad)]
         self._state: dict = {}      # name -> alert state dict
+        self._drift_counts: dict = {}   # name -> [ticks, bad_ticks]
         self._offset: dict = {}     # name -> (total0, bad0): restored
         #                             history's final cumulative counts,
         #                             added to fresh post-restart totals
@@ -194,6 +226,7 @@ class SLOEngine:
             self._samples.clear()
             self._state.clear()
             self._offset.clear()
+            self._drift_counts.clear()
             self._burn.clear()
             self._active.clear()
 
@@ -286,12 +319,38 @@ class SLOEngine:
         return got
 
     # ---- SLI extraction -------------------------------------------------
+    def _drift_totals(self, spec: SLOSpec):
+        """Cumulative (ticks, bad_ticks) for a drift SLI. The drift
+        metric is a gauge — a LEVEL, not an event stream — so each call
+        (one per evaluate) counts one synthetic observation per matching
+        {model=…} series, bad when the level exceeds spec.threshold, and
+        accumulates them engine-side. The counts are monotone, so the
+        sample ring and burn-rate deltas apply unchanged."""
+        ent = self._drift_counts.setdefault(  # h2o3-ok: R003 every caller
+            spec.name, [0, 0])  # (_totals via evaluate/_restore) holds
+        #                         self._lock; never called bare
+        g = self._registry.get(spec.metric)
+        if isinstance(g, _om.Gauge):
+            thr = spec.threshold if spec.threshold is not None else 0.2
+            for lkey, val in g._collect():
+                labels = dict(lkey)
+                if spec._model_re is not None and \
+                        not spec._model_re.search(labels.get("model", "")):
+                    continue
+                ent[0] += 1
+                if val > thr:
+                    ent[1] += 1
+        return ent[0], ent[1]
+
     def _totals(self, spec: SLOSpec):
         """(total, bad) cumulative event counts for one SLO, summed over
         the matching histogram series. Latency SLOs count observations
         over threshold_ms as bad via the cumulative buckets (a threshold
         between bucket bounds rounds the GOOD side down — conservative);
-        availability SLOs count series with a 5xx status label."""
+        availability SLOs count series with a 5xx status label; drift
+        SLOs tick against the modelmon gauges (_drift_totals)."""
+        if spec.kind == "drift":
+            return self._drift_totals(spec)
         h = self._registry.get(spec.metric)
         if not isinstance(h, _om.Histogram):
             return 0, 0

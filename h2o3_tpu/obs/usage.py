@@ -433,7 +433,8 @@ PRESSURE = _om.gauge(
     "h2o3_pressure",
     "synthesized capacity pressure per dimension (1.0 = saturated): "
     "slo_burn, queue, utilization, tier_occupancy, tier_faults, stalls, "
-    "and the overall max — refreshed by GET /3/CloudHealth evaluations",
+    "drift, and the overall max — refreshed by GET /3/CloudHealth "
+    "evaluations",
     fn=_pressure_series)
 
 
@@ -528,6 +529,18 @@ def evaluate_pressure(window_s=None) -> dict:
                             "trips": len(_wd.WATCHDOG.trips())}
     except Exception:   # noqa: BLE001
         pass
+    # model drift: worst monitored model's PSI/prediction drift against
+    # its training baseline, saturated at H2O3_MODELMON_PSI_SAT — a
+    # drifting fleet is a capacity problem for the RETRAIN pipeline even
+    # when serving latency looks healthy
+    try:
+        from h2o3_tpu.obs import modelmon as _mm
+        _mm.evaluate()
+        drift, ddetail = _mm.pressure()
+        dims["drift"] = round(drift, 4)
+        detail["drift"] = ddetail
+    except Exception:   # noqa: BLE001
+        pass
     epoch = 0
     try:
         from h2o3_tpu.deploy import membership as _mbr
@@ -563,6 +576,25 @@ def merge_cloudhealth(snaps) -> dict:
 
 def last_pressure() -> dict:
     return _LAST_PRESSURE
+
+
+def forget_model(key):
+    """Model DELETE hygiene: drop the model's attribution state — ledger
+    rows, the fold census slot, and every {model=…} series on the
+    device-seconds counter — exactly once (the ISSUE-11 Gauge.remove
+    discipline applied to usage). Idempotent; never raises."""
+    k = str(key)[:128]
+    try:
+        with _LOCK:
+            for lk in [lk for lk in _LEDGER if lk[1] == k]:
+                del _LEDGER[lk]
+            _KNOWN_MODELS.discard(k)
+        for row in MODEL_DEVICE_SECONDS._json():
+            lbl = row.get("labels") or {}
+            if lbl.get("model") == k:
+                MODEL_DEVICE_SECONDS.remove(**lbl)
+    except Exception:   # noqa: BLE001 — hygiene must not fail the DKV op
+        pass
 
 
 def reset():

@@ -82,6 +82,7 @@ import numpy as np
 
 from h2o3_tpu.analysis.lockdep import make_lock, make_rlock
 from h2o3_tpu.obs import metrics as _om
+from h2o3_tpu.obs import modelmon as _modelmon
 from h2o3_tpu.obs import tracing as _tracing
 from h2o3_tpu.obs import usage as _usage
 from h2o3_tpu.obs.timeline import span as _span
@@ -488,8 +489,15 @@ def score_rows(model, raw: np.ndarray, n: int, links=()) -> np.ndarray:
         # MRTask result-collection hop) — host_fetch owns that allgather.
         with _usage.stage("readback"):
             if isinstance(out, jax.Array) and not out.is_fully_addressable:
-                return np.asarray(_mrt.host_fetch(out))
-            return np.asarray(jax.device_get(out))
+                host = np.asarray(_mrt.host_fetch(out))
+            else:
+                host = np.asarray(jax.device_get(out))
+    # drift tap: fold the batch into the model's live sketch — pure
+    # host-side numpy over the ALREADY-staged raw buffer and the host
+    # result (zero extra device work); a no-op for unmonitored models
+    # and guaranteed never to break scoring (modelmon owns the guard)
+    _modelmon.observe(model, raw, host, n)
+    return host
 
 
 def _fast_scored(model, frame, with_response: bool):
